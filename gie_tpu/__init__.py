@@ -1,0 +1,27 @@
+"""gie_tpu — a TPU-native inference-gateway framework.
+
+Re-build of the capability surface of
+kubernetes-sigs/gateway-api-inference-extension (the Gateway API Inference
+Extension / Endpoint Picker), designed TPU-first: the per-request heuristic
+scorer chain of the reference (queue-depth, KV-cache, prefix-cache,
+LoRA-affinity — see reference docs/proposals/0845-scheduler-architecture-proposal)
+is replaced by a batched scheduling policy: N pending requests are scored and
+bin-packed against M model-server endpoints in a single jitted XLA call.
+
+Package map (SURVEY.md section 7.2 build order):
+  api/        InferencePool / InferencePoolImport types + validation + CRD gen
+  sched/      the batched TPU scheduler: filters, scorers, pickers, prefix index
+  models/     learned components (TTFT/TPOT latency predictor)
+  ops/        low-level kernels (pallas / XLA custom lowerings)
+  parallel/   mesh + sharding for multi-chip scheduling and training
+  datastore/  pool + endpoint cache (reference pkg/lwepp/datastore)
+  controller/ reconcilers over a watch-source abstraction
+  extproc/    Envoy ext-proc protocol: messages, server, handlers
+  metricsio/  model-server metrics protocol (scrape -> metrics tensor)
+  runtime/    options, health, logging, TLS, runner
+  simulator/  vLLM-dynamics model-server stub for benchmarks/tests
+"""
+
+from gie_tpu.version import BUNDLE_VERSION, __version__
+
+__all__ = ["BUNDLE_VERSION", "__version__"]

@@ -1,0 +1,43 @@
+"""Batched TPU scheduler: the decision core of the framework.
+
+See profile.scheduling_cycle for the full cycle and SURVEY.md section 7 for
+how this replaces the reference's per-request plugin chain.
+"""
+
+from gie_tpu.sched.constants import (
+    FALLBACKS,
+    M_MAX,
+    MAX_CHUNKS,
+    NUM_METRICS,
+    Criticality,
+    Metric,
+    Status,
+)
+from gie_tpu.sched.profile import ProfileConfig, Scheduler, scheduling_cycle
+from gie_tpu.sched.types import (
+    EndpointBatch,
+    PickResult,
+    PrefixTable,
+    RequestBatch,
+    SchedState,
+    Weights,
+)
+
+__all__ = [
+    "FALLBACKS",
+    "M_MAX",
+    "MAX_CHUNKS",
+    "NUM_METRICS",
+    "Criticality",
+    "Metric",
+    "Status",
+    "ProfileConfig",
+    "Scheduler",
+    "scheduling_cycle",
+    "EndpointBatch",
+    "PickResult",
+    "PrefixTable",
+    "RequestBatch",
+    "SchedState",
+    "Weights",
+]

@@ -1,0 +1,95 @@
+"""Scheduler tensor-layout constants.
+
+The reference's data layer hands the scheduler a set of per-endpoint structs
+(reference docs/proposals/1023-data-layer-architecture/README.md:104-164,
+docs/proposals/003-model-server-protocol/README.md:28-57). The TPU-native
+design flattens that into a dense `float32[M, NUM_METRICS]` tensor so one XLA
+call can score every (request, endpoint) pair. This module pins the column
+layout of that tensor and the global shape budget.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Metric(enum.IntEnum):
+    """Columns of the endpoint metrics tensor.
+
+    Names follow the model-server metrics protocol (reference
+    docs/proposals/003-model-server-protocol/README.md:28-57): required gauges
+    TotalQueuedRequests / TotalRunningRequests / KVCacheUtilization, optional
+    BlockSize / NumBlocks, and the vllm:lora_requests_info max_lora label.
+    """
+
+    QUEUE_DEPTH = 0        # TotalQueuedRequests
+    RUNNING_REQUESTS = 1   # TotalRunningRequests
+    KV_CACHE_UTIL = 2      # KVCacheUtilization, in [0, 1]
+    BLOCK_SIZE = 3         # optional; 0 when unreported
+    NUM_BLOCKS = 4         # optional; 0 when unreported
+    MAX_LORA = 5           # vllm:lora_requests_info max_lora label
+    WAITING_LORA = 6       # number of waiting adapters
+    METRICS_AGE_S = 7      # staleness of this row (seconds since scrape)
+
+
+NUM_METRICS = len(Metric)
+
+# Global endpoint-axis budget. The reference supports pods x up to 8 DP-rank
+# target ports (api/v1/inferencepool_types.go:72-81); 512 endpoint slots cover
+# the north-star 256-endpoint benchmark with headroom. All device state
+# (assumed load, prefix-table bitmasks) is laid out against this fixed axis so
+# pod churn never changes a compiled shape — rows are masked, not resized.
+M_MAX = 512
+
+# Words of a uint32 bitmask spanning M_MAX endpoints.
+M_WORDS = M_MAX // 32
+
+# Request-axis buckets: incoming micro-batches are padded up to the nearest
+# bucket so only a handful of shapes ever compile.
+N_BUCKETS = (1, 8, 64, 256, 1024)
+
+# Max rolling-hash chunks considered per request prompt (prefix-cache match
+# depth, reference docs/proposals/0602-prefix-cache/README.md:95-112).
+MAX_CHUNKS = 32
+
+# Default character-chunk size for the rolling hash. The reference leaves the
+# chunk size to plugin config ("prefix plugin config",
+# docs/proposals/003-model-server-protocol/README.md:33); 64 chars balances
+# match granularity against table pressure.
+CHUNK_BYTES = 64
+
+# Per-endpoint resident/waiting LoRA adapter slots in the dense view
+# (running_lora_adapters / waiting_lora_adapters labels, proposal 003).
+LORA_SLOTS = 8
+
+# Fallback list length returned per pick: primary + 3 fallbacks, matching the
+# ordered fallback-list semantics of the endpoint-picker protocol (reference
+# docs/proposals/004-endpoint-picker-protocol/README.md:50-82,
+# pkg/lwepp/handlers/server.go:72-77 PickResult.Fallbacks).
+FALLBACKS = 4
+
+# Prefix-table slot count (power of two).
+PREFIX_SLOTS = 1 << 15
+
+
+class Status(enum.IntEnum):
+    """Per-request scheduling outcome.
+
+    Error codes follow the endpoint-picker protocol (reference
+    docs/proposals/004-endpoint-picker-protocol/README.md:77-80): 503 when no
+    eligible endpoint exists (strict subsetting included), 429 when load is
+    shed for sheddable requests.
+    """
+
+    OK = 0
+    NO_CAPACITY = 1   # -> HTTP 503
+    SHED = 2          # -> HTTP 429
+
+
+class Criticality(enum.IntEnum):
+    """Request criticality bands (InferenceObjective, reference
+    docs/proposals/1199-inference-objectives/README.md:64-80)."""
+
+    CRITICAL = 0
+    STANDARD = 1
+    SHEDDABLE = 2

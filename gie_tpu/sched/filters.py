@@ -1,0 +1,90 @@
+"""Vectorized filter stage.
+
+The reference runs Filter plugins per request to prune candidate endpoints
+(reference docs/proposals/0845-scheduler-architecture-proposal/README.md:62-66;
+candidate subsetting pkg/lwepp/handlers/request.go:99-137). Here every filter
+is a boolean mask over the full [N, M_MAX] request x endpoint grid, AND-ed
+together — no control flow, one fused XLA kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.types import EndpointBatch, RequestBatch
+
+
+def base_mask(reqs: RequestBatch, eps: EndpointBatch) -> jnp.ndarray:
+    """Validity + subset-hint mask.
+
+    Strict subsetting semantics (reference
+    docs/proposals/004-endpoint-picker-protocol/README.md:28-44,
+    pkg/lwepp/handlers/request.go:130-133): the subset mask is honored even
+    when it leaves zero candidates; the empty case surfaces as a 503 in the
+    picker, never as a fallback to the full pool.
+    """
+    return reqs.valid[:, None] & eps.valid[None, :] & reqs.subset_mask
+
+
+def saturation_mask(
+    reqs: RequestBatch,
+    eps: EndpointBatch,
+    *,
+    queue_limit: float,
+    kv_limit: float,
+) -> jnp.ndarray:
+    """Drop saturated endpoints for non-critical traffic.
+
+    Mirrors the saturation/has-capacity predicate of the scheduler proposal
+    (reference docs/proposals/006-scheduler/README.md:150-156): endpoints with
+    queue depth or KV-cache utilization beyond the limits are ineligible for
+    STANDARD/SHEDDABLE requests; CRITICAL requests bypass the filter so they
+    degrade to best-effort instead of shedding.
+    """
+    queue = eps.metrics[:, C.Metric.QUEUE_DEPTH]
+    kv = eps.metrics[:, C.Metric.KV_CACHE_UTIL]
+    has_capacity = (queue < queue_limit) & (kv < kv_limit)
+    critical = reqs.criticality[:, None] == C.Criticality.CRITICAL
+    return critical | has_capacity[None, :]
+
+
+def lora_membership(
+    reqs: RequestBatch, eps: EndpointBatch
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-(request, endpoint) adapter residency: (active[N,M], waiting[N,M]).
+
+    Shared by the LoRA capacity filter and the LoRA affinity scorer so the
+    [N, M, LORA_SLOTS] comparison is computed once per cycle.
+    """
+    req_lora = reqs.lora_id[:, None, None]                     # [N, 1, 1]
+    active = jnp.any(req_lora == eps.lora_active[None, :, :], axis=-1)
+    waiting = jnp.any(req_lora == eps.lora_waiting[None, :, :], axis=-1)
+    return active, waiting
+
+
+def lora_capacity_mask(
+    reqs: RequestBatch,
+    eps: EndpointBatch,
+    membership: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+) -> jnp.ndarray:
+    """LoRA-affinity eligibility.
+
+    Re-design of the reference LoRA-affinity filter (BASELINE north star;
+    adapter residency from vllm:lora_requests_info, reference
+    docs/proposals/003-model-server-protocol/README.md:43-57). An endpoint is
+    eligible for an adapter request if the adapter is already active/waiting
+    there, or the endpoint still has free adapter slots (max_lora not yet
+    reached). Base-model requests (-1) match everything.
+    """
+    active, waiting = membership if membership is not None else lora_membership(reqs, eps)
+    resident = active | waiting                                # [N, M]
+
+    n_active = jnp.sum(eps.lora_active >= 0, axis=-1)          # [M]
+    n_waiting = jnp.sum(eps.lora_waiting >= 0, axis=-1)
+    max_lora = eps.metrics[:, C.Metric.MAX_LORA]
+    # max_lora == 0 means the server did not report LoRA metrics: no limit.
+    has_slot = (max_lora <= 0) | ((n_active + n_waiting) < max_lora)
+
+    is_base = reqs.lora_id[:, None] < 0
+    return is_base | resident | has_slot[None, :]

@@ -1,0 +1,58 @@
+"""Rolling prefix-chunk hashing (host side).
+
+Implements the chained chunk hash of the prefix-cache proposal (reference
+docs/proposals/0602-prefix-cache/README.md:99:
+``hash(chunk_i) = hash(content_i + hash(chunk_{i-1}))``): prompts are split
+into fixed-size character chunks and each chunk's hash folds in the previous
+chunk's hash, so equal hash at depth i implies equal prefix up to i.
+
+This is the reference implementation (a C++ fast path under native/ is
+planned and will dispatch from here once built). Hash 0 is reserved for
+"empty table slot" and remapped to 1.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from gie_tpu.sched import constants as C
+
+
+def chunk_hashes(
+    prompt: bytes,
+    *,
+    chunk_bytes: int = C.CHUNK_BYTES,
+    max_chunks: int = C.MAX_CHUNKS,
+) -> tuple[np.ndarray, int]:
+    """Hash one prompt -> (u32[max_chunks] zero-padded, n_chunks).
+
+    Only complete chunks are hashed (a trailing partial chunk can't match a
+    cached block boundary), matching the fixed-size-chunk split of the
+    reference design.
+    """
+    n = min(len(prompt) // chunk_bytes, max_chunks)
+    out = np.zeros((max_chunks,), np.uint32)
+    h = 0
+    for i in range(n):
+        chunk = prompt[i * chunk_bytes : (i + 1) * chunk_bytes]
+        h = zlib.crc32(chunk, h) & 0xFFFFFFFF
+        out[i] = h if h != 0 else 1
+    return out, n
+
+
+def batch_chunk_hashes(
+    prompts: list[bytes],
+    *,
+    chunk_bytes: int = C.CHUNK_BYTES,
+    max_chunks: int = C.MAX_CHUNKS,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Hash a batch of prompts -> (u32[N, max_chunks], i32[N])."""
+    hashes = np.zeros((len(prompts), max_chunks), np.uint32)
+    counts = np.zeros((len(prompts),), np.int32)
+    for i, p in enumerate(prompts):
+        hashes[i], counts[i] = chunk_hashes(
+            p, chunk_bytes=chunk_bytes, max_chunks=max_chunks
+        )
+    return hashes, counts

@@ -1,0 +1,93 @@
+"""Pick stage: scores + mask -> ordered endpoint lists + status.
+
+Batched re-design of the reference Picker plugins (reference
+docs/proposals/0845-scheduler-architecture-proposal/README.md:73-77 — exactly
+one Pick per profile run) and of the protocol's ordered-fallback-list
+semantics (reference docs/proposals/004-endpoint-picker-protocol/README.md:
+50-82). Status semantics: 503 when a request has no eligible endpoint
+(strict subsetting / no ready endpoints, 004 README:77-79), 429 when a
+SHEDDABLE request is load-shed (004 README:80).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.types import PickResult
+
+
+NEG = jnp.float32(-1e9)
+
+# Score quantization for tie-breaking: blended scores live in [0, 1]; deltas
+# below _TIE_RESOLUTION are treated as ties and broken by rotation. The
+# rotation increment stays strictly below one quantum so it can never invert
+# a genuine (super-quantum) ordering, and above float32 ulp(1.0) so it is not
+# absorbed.
+_TIE_RESOLUTION = jnp.float32(1.0 / 4096.0)          # ~2.4e-4
+_TIE_EPS = _TIE_RESOLUTION / jnp.float32(C.M_MAX + 1)  # ~4.8e-7 > ulp(1.0)
+
+
+def _finalize(
+    masked: jax.Array,  # f32[N, M] score matrix with ineligible lanes at NEG
+    mask: jax.Array,
+    shed: jax.Array,
+    valid: jax.Array,
+) -> PickResult:
+    """Shared pick postlude: top-k fallback list + status gating."""
+    top_scores, top_idx = jax.lax.top_k(masked, C.FALLBACKS)
+    ok = top_scores > NEG / 2
+    indices = jnp.where(ok, top_idx, -1).astype(jnp.int32)
+
+    any_candidate = jnp.any(mask, axis=-1)
+    status = jnp.where(any_candidate, C.Status.OK, C.Status.NO_CAPACITY)
+    status = jnp.where(shed, C.Status.SHED, status)
+    status = jnp.where(valid, status, C.Status.NO_CAPACITY).astype(jnp.int32)
+
+    indices = jnp.where((status == C.Status.OK)[:, None], indices, -1)
+    return PickResult(indices=indices, status=status, scores=top_scores)
+
+
+def topk_picker(
+    scores: jax.Array,   # f32[N, M_MAX]
+    mask: jax.Array,     # bool[N, M_MAX]
+    shed: jax.Array,     # bool[N] requests being shed (-> 429)
+    valid: jax.Array,    # bool[N]
+    rr: jax.Array,       # u32 tie-break counter
+) -> PickResult:
+    """Deterministic best-score picker with top-k fallback list.
+
+    Scores are quantized to _TIE_RESOLUTION and ties broken by a rotating
+    lane priority derived from `rr`, so equal-score endpoints round-robin
+    across cycles (reference RoundRobinPicker,
+    pkg/lwepp/handlers/server.go:85-101, generalized to the scored path)
+    while genuine score differences always dominate.
+    """
+    m = scores.shape[-1]
+    quantized = jnp.round(scores / _TIE_RESOLUTION) * _TIE_RESOLUTION
+    lane = jnp.arange(m, dtype=jnp.uint32)
+    rot = ((lane + rr) % jnp.uint32(m)).astype(jnp.float32)
+    masked = jnp.where(mask, quantized + rot * _TIE_EPS, NEG)
+    return _finalize(masked, mask, shed, valid)
+
+
+def weighted_random_picker(
+    scores: jax.Array,
+    mask: jax.Array,
+    shed: jax.Array,
+    valid: jax.Array,
+    key: jax.Array,
+    *,
+    temperature: float = 0.05,
+) -> PickResult:
+    """Gumbel-top-k sampling picker.
+
+    Spreads load across near-equal endpoints instead of herding every request
+    of a cycle onto the single argmax — the batched analogue of the
+    reference's weighted-random pick over normalized scores. Temperature
+    scales how much score difference dominates the noise.
+    """
+    g = jax.random.gumbel(key, scores.shape, jnp.float32) * temperature
+    masked = jnp.where(mask, scores + g, NEG)
+    return _finalize(masked, mask, shed, valid)

@@ -1,0 +1,124 @@
+"""Device-resident prefix-cache index: batched match + insert.
+
+TPU re-design of the prefix-cache-aware scorer of reference
+docs/proposals/0602-prefix-cache/README.md:95-129. The reference keeps an
+LRU-indexed hash -> servers table per EPP replica and walks it per request;
+here the table is dense device arrays (PrefixTable) and matching for the
+whole batch is one gather + cumprod:
+
+  slot(h)    = h & (S - 1)                       direct-mapped
+  hit(n,c)   = keys[slot(h_nc)] == h_nc          chunk known at all
+  on(n,c,m)  = present[slot(h_nc), m]            chunk plausibly cached on m
+  match(n,m) = sum_c prod_{c'<=c} on(n,c',m)     longest-prefix property
+  score      = match / n_chunks                  normalized [0, 1]
+
+Staleness: every touched slot is stamped with the cycle tick; match ignores
+slots older than `max_age` ticks (the LRU-decay analogue of the reference's
+index eviction, 0602 README:113-122). Endpoint churn is handled by
+`clear_endpoint`, which zeroes one endpoint's presence column when the
+datastore evicts a pod, so a reused slot never inherits a dead pod's cache.
+
+Inserts happen at pick time (assumed cache: the picked endpoint will hold
+these chunks after serving — the same optimistic update the reference does
+per pick), via dense scatters. Slot collisions overwrite the older key
+(LRU-ish by construction); within one batch, colliding lanes resolve by
+scatter order. The index is explicitly approximate — exactly as in the
+reference design (0602 README:101 "approximate index").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.types import PrefixTable, RequestBatch
+
+
+def _slots(hashes: jax.Array, table_slots: int) -> jax.Array:
+    return (hashes & jnp.uint32(table_slots - 1)).astype(jnp.int32)
+
+
+def match_scores(
+    table: PrefixTable,
+    reqs: RequestBatch,
+    tick: jax.Array,
+    *,
+    max_age: int,
+) -> jax.Array:
+    """Longest-prefix match fraction per (request, endpoint) -> f32[N, M_MAX]."""
+    slots = _slots(reqs.chunk_hashes, table.keys.shape[0])     # i32[N, C]
+    keys = table.keys[slots]                                   # u32[N, C]
+    chunk_valid = (
+        jnp.arange(C.MAX_CHUNKS, dtype=jnp.int32)[None, :] < reqs.n_chunks[:, None]
+    )
+    fresh = (tick - table.ages[slots]) <= jnp.uint32(max_age)  # [N, C]
+    hit = (keys == reqs.chunk_hashes) & (reqs.chunk_hashes != 0) & chunk_valid & fresh
+
+    on = table.present[slots] & hit[..., None]                 # bool[N, C, M]
+
+    # Longest-prefix property: a chunk only counts if every earlier chunk
+    # also matched on that endpoint (reference 0602 README:107-112).
+    prefix_run = jnp.cumprod(on.astype(jnp.int32), axis=1)     # [N, C, M]
+    matched = jnp.sum(prefix_run, axis=1).astype(jnp.float32)  # [N, M]
+    denom = jnp.maximum(reqs.n_chunks.astype(jnp.float32), 1.0)
+    return matched / denom[:, None]
+
+
+def insert(
+    table: PrefixTable,
+    reqs: RequestBatch,
+    picked: jax.Array,  # i32[N] primary endpoint slot per request (-1 = none)
+    tick: jax.Array,    # u32 scalar
+) -> PrefixTable:
+    """Optimistically record the batch's chunks as cached on their picked
+    endpoints (assumed-cache update, reference 0602 README:113-122).
+
+    Per (request, chunk) lane: if the slot already holds this hash, OR the
+    picked endpoint into its presence row; otherwise evict (clear the row,
+    write the new key) and set the bit. Evictions are applied first, then
+    presence bits scatter-OR (max) in. Invalid lanes scatter to index S,
+    which is out of bounds and therefore dropped (JAX scatter drop
+    semantics), so they never alias a real row.
+    """
+    n, cmax = reqs.chunk_hashes.shape
+    nslots = table.keys.shape[0]
+    flat_hash = reqs.chunk_hashes.reshape(-1)                       # [N*C]
+    flat_slot = _slots(flat_hash, nslots)
+    chunk_valid = (
+        jnp.arange(cmax, dtype=jnp.int32)[None, :] < reqs.n_chunks[:, None]
+    )
+    valid = (
+        chunk_valid & (reqs.chunk_hashes != 0) & (picked[:, None] >= 0)
+    ).reshape(-1)
+
+    ep = jnp.clip(picked, 0, C.M_MAX - 1)                           # [N]
+    ep = jnp.broadcast_to(ep[:, None], (n, cmax)).reshape(-1)       # [N*C]
+
+    # Out-of-bounds sentinel: dropped by scatter, aliases nothing.
+    drop = nslots
+    safe_slot = jnp.where(valid, flat_slot, drop)
+    evict = valid & (table.keys[flat_slot] != flat_hash)
+    evict_slot = jnp.where(evict, flat_slot, drop)
+
+    # 1) Evictions: clear presence row, stamp new key.
+    present = table.present.at[evict_slot].set(False, mode="drop")
+    keys = table.keys.at[safe_slot].set(flat_hash, mode="drop")
+
+    # 2) OR the picked-endpoint bit in (max == OR for bool).
+    onehot = (
+        jnp.arange(C.M_MAX, dtype=jnp.int32)[None, :] == ep[:, None]
+    ) & valid[:, None]
+    present = present.at[safe_slot].max(onehot, mode="drop")
+
+    ages = table.ages.at[safe_slot].set(
+        jnp.broadcast_to(tick, valid.shape), mode="drop"
+    )
+    return PrefixTable(keys=keys, present=present, ages=ages)
+
+
+def clear_endpoint(table: PrefixTable, slot: jax.Array) -> PrefixTable:
+    """Invalidate one endpoint's presence column (pod evicted/replaced —
+    reference analogue: per-pod index removal on datastore PodDelete,
+    pkg/lwepp/datastore/datastore.go:257-265)."""
+    return table.replace(present=table.present.at[:, slot].set(False))

@@ -1,0 +1,270 @@
+"""Profile composition: the full scheduling cycle as one jitted program.
+
+Reference architecture (docs/proposals/0845-scheduler-architecture-proposal/
+README.md:49-91): a scheduling cycle = ProfileHandler -> Filter* -> Score*
+(normalized, weighted) -> exactly one Pick -> ProcessProfilesResults. The
+TPU-native inversion: all plugins become masked tensor algebra over the full
+[N, M_MAX] grid and the cycle — including the assumed-load and prefix-index
+state updates — is a single XLA program per request-count bucket.
+
+Host-side, `Scheduler` is the facade the data plane calls: it pads incoming
+micro-batches to a bucket, invokes the compiled cycle (donating the state
+buffers so updates happen in place on device), and exposes the
+request-termination feedback hook that reconciles assumed load (reference
+docs/proposals/006-scheduler/README.md:156).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched import filters, pickers, prefix, scorers
+from gie_tpu.sched.types import (
+    EndpointBatch,
+    PickResult,
+    RequestBatch,
+    SchedState,
+    Weights,
+    bucket_for,
+    pad_requests,
+)
+
+# Optional learned scorer column: (params, reqs, eps) -> f32[N, M_MAX].
+PredictorFn = Callable[[object, RequestBatch, EndpointBatch], jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfileConfig:
+    """Static profile configuration — hashable, baked into the trace.
+
+    Mirrors the declarative plugin/profile configuration of reference
+    docs/proposals/0845-scheduler-architecture-proposal/README.md:92 (plugin
+    enablement + thresholds); blend weights are dynamic (`Weights`) so tuning
+    never recompiles.
+    """
+
+    queue_limit: float = 128.0   # saturation filter: max queue depth
+    kv_limit: float = 0.95       # saturation filter: max KV-cache utilization
+    queue_norm: float = 64.0     # queue scorer normalization
+    load_norm: float = 32.0      # assumed-load scorer normalization
+    load_decay: float = 0.95     # per-cycle exponential decay of assumed load
+    prefix_max_age: int = 50_000  # prefix-index staleness horizon, in cycles
+    enable_saturation: bool = True
+    enable_lora: bool = True
+    enable_prefix: bool = True
+    shed_sheddable: bool = True  # 429 sheddable traffic when saturated
+    picker: str = "topk"         # "topk" | "random"
+    sample_temperature: float = 0.05
+
+
+def request_cost(reqs: RequestBatch) -> jax.Array:
+    """Assumed cost of admitting each request, in normalized units.
+
+    1.0 for an average request, growing with prompt+decode length — the
+    'assumed load' a pick adds to its endpoint until termination feedback
+    arrives (reference docs/proposals/006-scheduler/README.md:156).
+    """
+    return jnp.clip((reqs.prompt_len + reqs.decode_len) / 2048.0, 0.25, 8.0)
+
+
+def scheduling_cycle(
+    state: SchedState,
+    reqs: RequestBatch,
+    eps: EndpointBatch,
+    weights: Weights,
+    key: jax.Array,
+    predictor_params,
+    *,
+    cfg: ProfileConfig,
+    predictor_fn: Optional[PredictorFn],
+) -> tuple[PickResult, SchedState]:
+    """One full scheduling cycle. Pure; jit-compiled per (N-bucket, cfg)."""
+    # ---- Filter stage ----------------------------------------------------
+    mask = filters.base_mask(reqs, eps)
+    membership = filters.lora_membership(reqs, eps) if cfg.enable_lora else None
+    if cfg.enable_lora:
+        mask &= filters.lora_capacity_mask(reqs, eps, membership)
+    pre_saturation = mask
+    if cfg.enable_saturation:
+        mask &= filters.saturation_mask(
+            reqs, eps, queue_limit=cfg.queue_limit, kv_limit=cfg.kv_limit
+        )
+
+    # Shedding: SHEDDABLE requests whose candidates exist but are all
+    # saturated get a 429 instead of best-effort queueing (004 README:80).
+    if cfg.shed_sheddable:
+        had_candidates = jnp.any(pre_saturation, axis=-1)
+        none_left = ~jnp.any(mask, axis=-1)
+        shed = (
+            (reqs.criticality == C.Criticality.SHEDDABLE)
+            & had_candidates
+            & none_left
+        )
+    else:
+        shed = jnp.zeros(reqs.valid.shape, bool)
+
+    # ---- Score stage -----------------------------------------------------
+    cols: list[jax.Array] = []
+    wts: list[jax.Array] = []
+    cols.append(jnp.broadcast_to(
+        scorers.queue_score(eps, queue_norm=cfg.queue_norm)[None, :], mask.shape))
+    wts.append(weights.queue)
+    cols.append(jnp.broadcast_to(scorers.kv_cache_score(eps)[None, :], mask.shape))
+    wts.append(weights.kv_cache)
+    cols.append(jnp.broadcast_to(
+        scorers.assumed_load_score(state.assumed_load, load_norm=cfg.load_norm)[None, :],
+        mask.shape))
+    wts.append(weights.assumed_load)
+    if cfg.enable_prefix:
+        cols.append(
+            prefix.match_scores(
+                state.prefix, reqs, state.tick, max_age=cfg.prefix_max_age
+            )
+        )
+        wts.append(weights.prefix)
+    if cfg.enable_lora:
+        cols.append(scorers.lora_affinity_score(reqs, eps, membership))
+        wts.append(weights.lora)
+    if predictor_fn is not None:
+        cols.append(predictor_fn(predictor_params, reqs, eps))
+        wts.append(weights.latency)
+
+    stacked = jnp.stack(cols)                       # [S, N, M]
+    wvec = jnp.stack(wts)                           # [S]
+    total = jnp.einsum("s,snm->nm", wvec, stacked) / jnp.maximum(
+        jnp.sum(wvec), jnp.float32(1e-6)
+    )
+
+    # ---- Pick stage ------------------------------------------------------
+    if cfg.picker == "random":
+        result = pickers.weighted_random_picker(
+            total, mask, shed, reqs.valid, key,
+            temperature=cfg.sample_temperature,
+        )
+    else:
+        result = pickers.topk_picker(total, mask, shed, reqs.valid, state.rr)
+
+    # ---- State update ----------------------------------------------------
+    primary = result.indices[:, 0]                  # i32[N], -1 on non-OK
+    picked_ok = primary >= 0
+    cost = jnp.where(picked_ok, request_cost(reqs), 0.0)
+    slot = jnp.where(picked_ok, primary, C.M_MAX - 1)
+    added = jnp.zeros((C.M_MAX,), jnp.float32).at[slot].add(cost)
+    new_load = state.assumed_load * cfg.load_decay + added
+
+    new_prefix = (
+        prefix.insert(state.prefix, reqs, primary, state.tick)
+        if cfg.enable_prefix
+        else state.prefix
+    )
+    new_state = SchedState(
+        prefix=new_prefix,
+        assumed_load=new_load,
+        rr=state.rr + jnp.uint32(1),
+        tick=state.tick + jnp.uint32(1),
+    )
+    return result, new_state
+
+
+def _complete_update(state: SchedState, slots: jax.Array, costs: jax.Array) -> SchedState:
+    """Request-termination feedback: subtract reconciled assumed load."""
+    safe = jnp.where(slots >= 0, slots, C.M_MAX - 1)
+    sub = jnp.zeros((C.M_MAX,), jnp.float32).at[safe].add(
+        jnp.where(slots >= 0, costs, 0.0)
+    )
+    return state.replace(assumed_load=jnp.maximum(state.assumed_load - sub, 0.0))
+
+
+class Scheduler:
+    """Host facade over the jitted scheduling cycle.
+
+    Thread-safe: the data plane's stream handlers enqueue picks from many
+    threads; calls serialize on a lock around the functional state (the
+    reference datastore serializes with RWMutex + sync.Map,
+    pkg/lwepp/datastore/datastore.go:99-104 — here the shared state is one
+    device pytree swapped atomically under the lock).
+    """
+
+    def __init__(
+        self,
+        cfg: ProfileConfig = ProfileConfig(),
+        weights: Optional[Weights] = None,
+        predictor_fn: Optional[PredictorFn] = None,
+        predictor_params=None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.weights = weights if weights is not None else Weights.default()
+        self.predictor_fn = predictor_fn
+        self.predictor_params = predictor_params
+        self.state = SchedState.init()
+        self._key = jax.random.PRNGKey(seed)
+        self._lock = threading.Lock()
+        self._complete = jax.jit(_complete_update, donate_argnums=0)
+        self._evict = jax.jit(
+            lambda st, slot: st.replace(prefix=prefix.clear_endpoint(st.prefix, slot)),
+            donate_argnums=0,
+        )
+        self._jit = jax.jit(
+            functools.partial(
+                scheduling_cycle, cfg=self.cfg, predictor_fn=self.predictor_fn
+            ),
+            donate_argnums=0,
+        )
+        self._warm_buckets: set[int] = set()
+        self._warm_lock = threading.Lock()
+
+    def _warm(self, reqs: RequestBatch, eps: EndpointBatch) -> None:
+        """Compile a bucket shape OUTSIDE the state lock by running the cycle
+        on a throwaway state, so first-use compilation never stalls
+        concurrent pick()/complete() calls. The throwaway state is donated
+        and discarded; the live state is untouched."""
+        self._jit(
+            SchedState.init(), reqs, eps, self.weights,
+            jax.random.PRNGKey(0), self.predictor_params,
+        )
+
+    def pick(self, reqs: RequestBatch, eps: EndpointBatch) -> PickResult:
+        """Schedule a micro-batch; returns host-side PickResult rows for the
+        original (pre-padding) batch."""
+        n = int(np.asarray(reqs.valid).shape[0])
+        bucket = bucket_for(n)
+        reqs = pad_requests(reqs, bucket)
+        if bucket not in self._warm_buckets:
+            with self._warm_lock:
+                if bucket not in self._warm_buckets:
+                    self._warm(reqs, eps)
+                    self._warm_buckets.add(bucket)
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            result, self.state = self._jit(
+                self.state, reqs, eps, self.weights, sub, self.predictor_params
+            )
+        return jax.tree.map(lambda x: np.asarray(x)[:n], result)
+
+    def complete(self, endpoint_slots: np.ndarray, costs: np.ndarray) -> None:
+        """Terminated-request feedback (served-endpoint signal, reference
+        docs/proposals/004-endpoint-picker-protocol/README.md:84-101)."""
+        slots = jnp.asarray(endpoint_slots, jnp.int32)
+        costs = jnp.asarray(costs, jnp.float32)
+        with self._lock:
+            self.state = self._complete(self.state, slots, costs)
+
+    def evict_endpoint(self, slot: int) -> None:
+        """Invalidate all prefix-cache knowledge of an endpoint slot (pod
+        deleted or slot reassigned). Called by the datastore on PodDelete
+        (reference pkg/lwepp/datastore/datastore.go:257-265)."""
+        with self._lock:
+            self.state = self._evict(self.state, jnp.int32(slot))
+
+    def snapshot_assumed_load(self) -> np.ndarray:
+        with self._lock:
+            return np.asarray(self.state.assumed_load)

@@ -1,0 +1,68 @@
+"""Vectorized score stage.
+
+Each scorer is the batched re-design of one reference Score plugin
+(reference docs/proposals/0845-scheduler-architecture-proposal/README.md:66-72:
+scores normalized to [0, 1], blended by profile-level weights). Instead of a
+per-request plugin loop, every scorer emits a full f32[N, M_MAX] column and
+the blend is one weighted sum — the exact seam the scheduler proposal leaves
+for an out-of-process batch scheduler (reference
+docs/proposals/006-scheduler/README.md:160-162).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.types import EndpointBatch, RequestBatch
+
+
+def queue_score(eps: EndpointBatch, *, queue_norm: float) -> jax.Array:
+    """Least-queue-depth scorer (reference default queue scorer; BASELINE
+    configs[0] 'least-kv-cache/queue' CPU baseline). 1 at empty queue,
+    0 at/after `queue_norm` outstanding requests. -> f32[M_MAX]."""
+    q = eps.metrics[:, C.Metric.QUEUE_DEPTH]
+    return jnp.clip(1.0 - q / queue_norm, 0.0, 1.0)
+
+
+def kv_cache_score(eps: EndpointBatch) -> jax.Array:
+    """Least-KV-cache-utilization scorer (KVCacheUtilization gauge, reference
+    docs/proposals/003-model-server-protocol/README.md:28-34). -> f32[M_MAX]."""
+    return jnp.clip(1.0 - eps.metrics[:, C.Metric.KV_CACHE_UTIL], 0.0, 1.0)
+
+
+def lora_affinity_score(
+    reqs: RequestBatch,
+    eps: EndpointBatch,
+    membership: tuple[jax.Array, jax.Array] | None = None,
+) -> jax.Array:
+    """LoRA-affinity scorer -> f32[N, M_MAX].
+
+    1.0 where the requested adapter is already running on the endpoint,
+    0.75 where it is queued to load (waiting), 0.25 where it would need a
+    fresh load, 1.0 everywhere for base-model requests. Mirrors the
+    affinity/cost trade-off of the reference LoRA scorer driven by
+    vllm:lora_requests_info (reference
+    docs/proposals/003-model-server-protocol/README.md:43-57).
+
+    `membership` is the precomputed filters.lora_membership result, reused
+    from the filter stage to avoid recomputing the slot comparison.
+    """
+    from gie_tpu.sched.filters import lora_membership
+
+    active, waiting = membership if membership is not None else lora_membership(reqs, eps)
+    is_base = reqs.lora_id[:, None] < 0
+    return jnp.where(
+        is_base,
+        1.0,
+        jnp.where(active, 1.0, jnp.where(waiting, 0.75, 0.25)),
+    )
+
+
+def assumed_load_score(assumed_load: jax.Array, *, load_norm: float) -> jax.Array:
+    """Penalty column for in-flight assumed load (reference
+    docs/proposals/006-scheduler/README.md:156 assumed-load accounting):
+    1 at zero assumed load, decaying to 0 at `load_norm` cost units.
+    -> f32[M_MAX]."""
+    return jnp.clip(1.0 - assumed_load / load_norm, 0.0, 1.0)

@@ -1,0 +1,202 @@
+"""Scheduler pytree types.
+
+The reference scheduler passes per-request CycleState and per-endpoint structs
+through a plugin chain (reference
+docs/proposals/0845-scheduler-architecture-proposal/README.md:17-23,49-91).
+Here the equivalent state is a set of fixed-shape pytrees so the whole
+scheduling cycle is one traced XLA program:
+
+  EndpointBatch  — dense view of every endpoint's live metrics   [M_MAX, ...]
+  RequestBatch   — dense view of N pending requests              [N, ...]
+  SchedState     — device-resident cross-request state (assumed load,
+                   prefix-cache index, RR counter) threaded functionally
+  PickResult     — per-request ordered endpoint list + status
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gie_tpu.sched import constants as C
+
+
+@flax.struct.dataclass
+class EndpointBatch:
+    """Dense endpoint-side inputs for one scheduling cycle.
+
+    Built by the datastore/metrics layer (reference equivalent:
+    pkg/lwepp/datastore/datastore.go:40-52 Endpoint/EndpointPool plus the
+    scraped PodMetrics of proposal 003). Row i is endpoint slot i; `valid`
+    masks unused slots so pod churn never changes the compiled shape.
+    """
+
+    metrics: jax.Array       # f32[M_MAX, NUM_METRICS]
+    valid: jax.Array         # bool[M_MAX]
+    lora_active: jax.Array   # i32[M_MAX, LORA_SLOTS], adapter ids, -1 = empty
+    lora_waiting: jax.Array  # i32[M_MAX, LORA_SLOTS]
+
+    @staticmethod
+    def empty(m: int = C.M_MAX) -> "EndpointBatch":
+        return EndpointBatch(
+            metrics=jnp.zeros((m, C.NUM_METRICS), jnp.float32),
+            valid=jnp.zeros((m,), bool),
+            lora_active=jnp.full((m, C.LORA_SLOTS), -1, jnp.int32),
+            lora_waiting=jnp.full((m, C.LORA_SLOTS), -1, jnp.int32),
+        )
+
+
+@flax.struct.dataclass
+class RequestBatch:
+    """Dense request-side inputs for one scheduling cycle.
+
+    One row per pending request. `subset_mask` carries the data plane's
+    candidate-subset hint (`envoy.lb.subset_hint` filter metadata, reference
+    docs/proposals/004-endpoint-picker-protocol/README.md:28-44,
+    pkg/lwepp/handlers/request.go:51-77): all-True when no hint was present,
+    and a strict mask otherwise — an all-False row must yield a 503, never a
+    silent fallback.
+    """
+
+    valid: jax.Array         # bool[N]
+    lora_id: jax.Array       # i32[N], -1 = base model
+    criticality: jax.Array   # i32[N], constants.Criticality
+    prompt_len: jax.Array    # f32[N], prompt length (chars)
+    decode_len: jax.Array    # f32[N], expected/actual output length hint
+    chunk_hashes: jax.Array  # u32[N, MAX_CHUNKS] rolling prefix-chunk hashes
+    n_chunks: jax.Array      # i32[N] number of valid chunk hashes
+    subset_mask: jax.Array   # bool[N, M_MAX]
+    had_subset_hint: jax.Array  # bool[N] — True if the request carried a hint
+
+    @staticmethod
+    def empty(n: int, m: int = C.M_MAX) -> "RequestBatch":
+        return RequestBatch(
+            valid=jnp.zeros((n,), bool),
+            lora_id=jnp.full((n,), -1, jnp.int32),
+            criticality=jnp.full((n,), C.Criticality.STANDARD, jnp.int32),
+            prompt_len=jnp.zeros((n,), jnp.float32),
+            decode_len=jnp.zeros((n,), jnp.float32),
+            chunk_hashes=jnp.zeros((n, C.MAX_CHUNKS), jnp.uint32),
+            n_chunks=jnp.zeros((n,), jnp.int32),
+            subset_mask=jnp.ones((n, m), bool),
+            had_subset_hint=jnp.zeros((n,), bool),
+        )
+
+
+@flax.struct.dataclass
+class PrefixTable:
+    """Fixed-capacity, direct-mapped chunk-hash -> endpoint-set index.
+
+    TPU-native re-design of the approximate prefix-cache index of reference
+    docs/proposals/0602-prefix-cache/README.md:95-129 (chunk-hash -> servers
+    map with LRU): a direct-mapped table of PREFIX_SLOTS rows, each holding a
+    32-bit chunk-hash key, a per-endpoint presence row (who plausibly has
+    this chunk cached), and an age tick for staleness decay. Collisions
+    overwrite (the index is explicitly approximate in the reference design
+    too); XLA sees only dense scatter/gather.
+    """
+
+    keys: jax.Array     # u32[PREFIX_SLOTS], 0 = empty
+    present: jax.Array  # bool[PREFIX_SLOTS, M_MAX] endpoint presence per chunk
+    ages: jax.Array     # u32[PREFIX_SLOTS] last-touch tick
+
+    @staticmethod
+    def empty(slots: int = C.PREFIX_SLOTS) -> "PrefixTable":
+        return PrefixTable(
+            keys=jnp.zeros((slots,), jnp.uint32),
+            present=jnp.zeros((slots, C.M_MAX), bool),
+            ages=jnp.zeros((slots,), jnp.uint32),
+        )
+
+
+@flax.struct.dataclass
+class SchedState:
+    """Cross-cycle device-resident scheduler state, threaded functionally.
+
+    `assumed_load` implements the assumed-load accounting the scheduler
+    proposal mandates (reference docs/proposals/006-scheduler/README.md:156:
+    loads are assumed at pick time and reconciled when the request is observed
+    to terminate / metrics catch up). `rr` seeds deterministic tie-breaking
+    (reference round-robin picker pkg/lwepp/handlers/server.go:85-101).
+    """
+
+    prefix: PrefixTable
+    assumed_load: jax.Array  # f32[M_MAX] in normalized request-cost units
+    rr: jax.Array            # u32 scalar round-robin / tie-break counter
+    tick: jax.Array          # u32 scalar cycle counter
+
+    @staticmethod
+    def init(slots: int = C.PREFIX_SLOTS) -> "SchedState":
+        return SchedState(
+            prefix=PrefixTable.empty(slots),
+            assumed_load=jnp.zeros((C.M_MAX,), jnp.float32),
+            rr=jnp.zeros((), jnp.uint32),
+            tick=jnp.zeros((), jnp.uint32),
+        )
+
+
+@flax.struct.dataclass
+class PickResult:
+    """Per-request scheduling outcome.
+
+    `indices[n]` is the ordered endpoint slot list (primary + fallbacks,
+    -1 padded) matching the comma-separated ordered fallback list of the
+    endpoint-picker protocol (reference
+    docs/proposals/004-endpoint-picker-protocol/README.md:50-82). `status`
+    uses constants.Status (OK / NO_CAPACITY->503 / SHED->429).
+    """
+
+    indices: jax.Array  # i32[N, FALLBACKS]
+    status: jax.Array   # i32[N]
+    scores: jax.Array   # f32[N, FALLBACKS] total score of each chosen endpoint
+
+
+@flax.struct.dataclass
+class Weights:
+    """Scorer blend weights — the profile-level weighted sum of reference
+    docs/proposals/0845-scheduler-architecture-proposal/README.md:68-72
+    (normalized scores, weighted at profile level), as a dynamic argument so
+    retuning never recompiles."""
+
+    queue: jax.Array         # f32 scalar
+    kv_cache: jax.Array
+    prefix: jax.Array
+    lora: jax.Array
+    assumed_load: jax.Array  # penalty weight on in-flight assumed load
+    latency: jax.Array       # learned TTFT/TPOT predictor column
+
+    @staticmethod
+    def default() -> "Weights":
+        return Weights(
+            queue=jnp.float32(1.0),
+            kv_cache=jnp.float32(1.0),
+            prefix=jnp.float32(2.0),
+            lora=jnp.float32(1.0),
+            assumed_load=jnp.float32(1.0),
+            latency=jnp.float32(0.0),
+        )
+
+
+def pad_requests(reqs: RequestBatch, n_bucket: int) -> RequestBatch:
+    """Pad a RequestBatch up to `n_bucket` rows (host-side helper)."""
+    n = int(reqs.valid.shape[0])
+    if n == n_bucket:
+        return reqs
+    if n > n_bucket:
+        raise ValueError(f"batch of {n} does not fit bucket {n_bucket}")
+    pad = n_bucket - n
+
+    def _pad(x):
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(np.asarray(x), widths)
+
+    return jax.tree.map(_pad, reqs)
+
+
+def bucket_for(n: int) -> int:
+    for b in C.N_BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} exceeds max bucket {C.N_BUCKETS[-1]}")

@@ -1,0 +1,110 @@
+"""Builder-pattern test fixtures.
+
+Analogue of the reference's PodWrapper/InferencePoolWrapper builders
+(pkg/lwepp/util/testing/wrappers.go:30-166): compact constructors for dense
+scheduler inputs used across unit tests, conformance, and benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.hashing import batch_chunk_hashes
+from gie_tpu.sched.types import EndpointBatch, RequestBatch
+
+
+def make_endpoints(
+    m: int,
+    *,
+    queue: Optional[Sequence[float]] = None,
+    kv: Optional[Sequence[float]] = None,
+    running: Optional[Sequence[float]] = None,
+    max_lora: float = 0.0,
+    lora_active: Optional[Sequence[Sequence[int]]] = None,
+    lora_waiting: Optional[Sequence[Sequence[int]]] = None,
+) -> EndpointBatch:
+    """Build an EndpointBatch with `m` valid endpoint slots."""
+    metrics = np.zeros((C.M_MAX, C.NUM_METRICS), np.float32)
+    if queue is not None:
+        metrics[:m, C.Metric.QUEUE_DEPTH] = np.asarray(queue, np.float32)
+    if kv is not None:
+        metrics[:m, C.Metric.KV_CACHE_UTIL] = np.asarray(kv, np.float32)
+    if running is not None:
+        metrics[:m, C.Metric.RUNNING_REQUESTS] = np.asarray(running, np.float32)
+    metrics[:m, C.Metric.MAX_LORA] = max_lora
+
+    active = np.full((C.M_MAX, C.LORA_SLOTS), -1, np.int32)
+    waiting = np.full((C.M_MAX, C.LORA_SLOTS), -1, np.int32)
+    for table, src in ((active, lora_active), (waiting, lora_waiting)):
+        if src is not None:
+            for i, ids in enumerate(src):
+                for j, a in enumerate(ids):
+                    table[i, j] = a
+
+    valid = np.zeros((C.M_MAX,), bool)
+    valid[:m] = True
+    return EndpointBatch(
+        metrics=jnp.asarray(metrics),
+        valid=jnp.asarray(valid),
+        lora_active=jnp.asarray(active),
+        lora_waiting=jnp.asarray(waiting),
+    )
+
+
+def make_requests(
+    n: int,
+    *,
+    prompts: Optional[Sequence[bytes]] = None,
+    lora_id: Optional[Sequence[int]] = None,
+    criticality: Optional[Sequence[int]] = None,
+    subset: Optional[Sequence[Optional[Sequence[int]]]] = None,
+    prompt_len: Optional[Sequence[float]] = None,
+) -> RequestBatch:
+    """Build a RequestBatch of `n` valid requests.
+
+    `subset[i]` = endpoint-slot allowlist for request i (strict subsetting
+    hint), or None for "no hint".
+    """
+    valid = np.ones((n,), bool)
+    lora = np.asarray(lora_id, np.int32) if lora_id is not None else np.full((n,), -1, np.int32)
+    crit = (
+        np.asarray(criticality, np.int32)
+        if criticality is not None
+        else np.full((n,), C.Criticality.STANDARD, np.int32)
+    )
+    if prompts is not None:
+        hashes, counts = batch_chunk_hashes(list(prompts))
+        plen = np.asarray([len(p) for p in prompts], np.float32)
+    else:
+        hashes = np.zeros((n, C.MAX_CHUNKS), np.uint32)
+        counts = np.zeros((n,), np.int32)
+        plen = np.zeros((n,), np.float32)
+    if prompt_len is not None:
+        plen = np.asarray(prompt_len, np.float32)
+
+    mask = np.ones((n, C.M_MAX), bool)
+    hint = np.zeros((n,), bool)
+    if subset is not None:
+        for i, allow in enumerate(subset):
+            if allow is None:
+                continue
+            hint[i] = True
+            mask[i] = False
+            for s in allow:
+                mask[i, s] = True
+
+    return RequestBatch(
+        valid=jnp.asarray(valid),
+        lora_id=jnp.asarray(lora),
+        criticality=jnp.asarray(crit),
+        prompt_len=jnp.asarray(plen),
+        decode_len=jnp.zeros((n,), jnp.float32),
+        chunk_hashes=jnp.asarray(hashes),
+        n_chunks=jnp.asarray(counts),
+        subset_mask=jnp.asarray(mask),
+        had_subset_hint=jnp.asarray(hint),
+    )

@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; all sharding/collective
+behavior is validated on a virtual 8-device CPU platform (the driver
+separately dry-run-compiles the multi-chip path via __graft_entry__).
+Environment must be set before jax initializes.
+"""
+
+import os
+
+# Force-override: the environment pins JAX_PLATFORMS to the axon TPU tunnel,
+# but the test tier must run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,256 @@
+"""Scheduler-core unit tests.
+
+Coverage model follows the reference's handler/scorer unit tier (SURVEY.md
+section 4: subset parsing variants, strict-subset 503, concurrency) mapped to
+the batched pipeline: masks, scorer ordering, fallback lists, status codes,
+assumed-load dynamics, prefix affinity.
+"""
+
+import numpy as np
+import pytest
+
+from gie_tpu.sched import (
+    Criticality,
+    ProfileConfig,
+    Scheduler,
+    Status,
+    Weights,
+)
+from gie_tpu.utils.testing import make_endpoints, make_requests
+
+
+def test_picks_least_loaded_endpoint():
+    """Default blend prefers the endpoint with least queue + kv pressure
+    (reference default least-kv-cache scorer, BASELINE configs[0])."""
+    sched = Scheduler()
+    eps = make_endpoints(4, queue=[10, 0, 10, 10], kv=[0.9, 0.1, 0.9, 0.9])
+    reqs = make_requests(3)
+    res = sched.pick(reqs, eps)
+    assert res.status.tolist() == [Status.OK] * 3
+    assert all(res.indices[i, 0] == 1 for i in range(3))
+
+
+def test_strict_subset_empty_gives_503():
+    """An explicit empty/unsatisfiable subset hint must 503, never fall back
+    to the full pool (reference request.go:130-133, 004 README:28-44)."""
+    sched = Scheduler()
+    eps = make_endpoints(2, queue=[0, 0])
+    # Request 0 restricted to invalid slot 7 (not a valid endpoint);
+    # request 1 unrestricted.
+    reqs = make_requests(2, subset=[[7], None])
+    res = sched.pick(reqs, eps)
+    assert res.status[0] == Status.NO_CAPACITY
+    assert (res.indices[0] == -1).all()
+    assert res.status[1] == Status.OK
+
+
+def test_subset_honored_when_nonempty():
+    sched = Scheduler()
+    # Slot 1 is far better, but request is pinned to slot 0 and 3.
+    eps = make_endpoints(4, queue=[50, 0, 0, 40], kv=[0.5, 0.0, 0.0, 0.4])
+    reqs = make_requests(1, subset=[[0, 3]])
+    res = sched.pick(reqs, eps)
+    assert res.status[0] == Status.OK
+    assert res.indices[0, 0] == 3  # better of the two allowed
+    picked = set(int(i) for i in res.indices[0] if i >= 0)
+    assert picked <= {0, 3}
+
+
+def test_no_endpoints_gives_503():
+    sched = Scheduler()
+    eps = make_endpoints(0)
+    reqs = make_requests(2)
+    res = sched.pick(reqs, eps)
+    assert res.status.tolist() == [Status.NO_CAPACITY] * 2
+
+
+def test_sheddable_gets_429_when_saturated_critical_does_not():
+    """Saturation sheds SHEDDABLE traffic with 429 while CRITICAL bypasses
+    the filter (004 README:77-80; 006 README saturation semantics)."""
+    cfg = ProfileConfig(queue_limit=10, kv_limit=0.9)
+    sched = Scheduler(cfg)
+    eps = make_endpoints(2, queue=[50, 60], kv=[0.99, 0.99])
+    reqs = make_requests(
+        2, criticality=[Criticality.SHEDDABLE, Criticality.CRITICAL]
+    )
+    res = sched.pick(reqs, eps)
+    assert res.status[0] == Status.SHED
+    assert (res.indices[0] == -1).all()
+    assert res.status[1] == Status.OK
+    assert res.indices[1, 0] >= 0
+
+
+def test_fallback_list_ordered_and_distinct():
+    """Ordered fallback list semantics (004 README:50-82)."""
+    sched = Scheduler()
+    eps = make_endpoints(8, queue=[0, 1, 2, 3, 4, 5, 6, 7])
+    reqs = make_requests(1)
+    res = sched.pick(reqs, eps)
+    idx = [int(i) for i in res.indices[0]]
+    assert len(set(idx)) == len(idx)
+    scores = [float(s) for s in res.scores[0]]
+    assert scores == sorted(scores, reverse=True)
+    assert idx[0] == 0  # least queue wins
+
+
+def test_lora_affinity_prefers_resident_adapter():
+    sched = Scheduler(weights=Weights.default())
+    eps = make_endpoints(
+        3,
+        queue=[0, 0, 0],
+        max_lora=4,
+        lora_active=[[7], [], []],
+    )
+    reqs = make_requests(1, lora_id=[7])
+    res = sched.pick(reqs, eps)
+    assert res.indices[0, 0] == 0
+
+
+def test_lora_capacity_filter_blocks_full_endpoints():
+    """Endpoint at max_lora with the adapter absent is ineligible."""
+    sched = Scheduler()
+    eps = make_endpoints(
+        2,
+        queue=[0, 50],
+        max_lora=1,
+        lora_active=[[3], []],  # slot 0 full with adapter 3; slot 1 has room
+    )
+    reqs = make_requests(1, lora_id=[9])
+    res = sched.pick(reqs, eps)
+    # Slot 0 is better on queue but full for adapter 9 -> must pick 1.
+    assert res.indices[0, 0] == 1
+
+
+def test_assumed_load_spreads_consecutive_batches():
+    """Assumed-load accounting must push later picks off the argmax endpoint
+    before metrics refresh (006 README:156)."""
+    cfg = ProfileConfig(load_decay=1.0, load_norm=4.0, enable_prefix=False)
+    w = Weights.default().replace(assumed_load=np.float32(4.0))
+    sched = Scheduler(cfg, weights=w)
+    eps = make_endpoints(4, queue=[0, 0, 0, 0])
+    seen = set()
+    for _ in range(4):
+        res = sched.pick(make_requests(8, prompt_len=[4096.0] * 8), eps)
+        seen.update(int(i) for i in res.indices[:, 0])
+    assert len(seen) >= 3  # load spread, not herded on one endpoint
+
+
+def test_complete_feedback_releases_assumed_load():
+    cfg = ProfileConfig(load_decay=1.0)
+    sched = Scheduler(cfg)
+    eps = make_endpoints(2, queue=[0, 0])
+    res = sched.pick(make_requests(4, prompt_len=[2048.0] * 4), eps)
+    load_after_pick = sched.snapshot_assumed_load()
+    assert load_after_pick.sum() > 0
+    slots = np.asarray(res.indices[:, 0])
+    sched.complete(slots, np.full(slots.shape, 1.0, np.float32))
+    assert sched.snapshot_assumed_load().sum() < load_after_pick.sum()
+
+
+def test_prefix_affinity_routes_repeat_prefix_to_same_endpoint():
+    """Prefix-cache-aware scheduling (0602 README:95-129): a request whose
+    prompt shares a long prefix with an earlier one should land on the same
+    endpoint even if another endpoint is slightly less loaded."""
+    cfg = ProfileConfig(load_decay=0.0)
+    w = Weights.default().replace(prefix=np.float32(3.0))
+    sched = Scheduler(cfg, weights=w)
+    eps = make_endpoints(4, queue=[1, 1, 1, 1])
+    sys_prompt = b"You are a helpful assistant. " * 40  # >> chunk size
+    res1 = sched.pick(make_requests(1, prompts=[sys_prompt + b"Q1"]), eps)
+    first = int(res1.indices[0, 0])
+    # Make every other endpoint slightly better on queue.
+    queue = [0.5] * 4
+    queue[first] = 1.0
+    eps2 = make_endpoints(4, queue=queue)
+    res2 = sched.pick(make_requests(1, prompts=[sys_prompt + b"Q2"]), eps2)
+    assert int(res2.indices[0, 0]) == first
+
+
+def test_prefix_no_false_match_for_different_prompts():
+    cfg = ProfileConfig(load_decay=0.0)
+    w = Weights.default().replace(prefix=np.float32(3.0))
+    sched = Scheduler(cfg, weights=w)
+    eps = make_endpoints(4, queue=[3, 3, 3, 0])
+    res1 = sched.pick(make_requests(1, prompts=[b"A" * 4096]), eps)
+    first = int(res1.indices[0, 0])
+    assert first == 3
+    # A totally different prompt should go to the least-loaded endpoint, not
+    # chase the other prompt's cache.
+    eps2 = make_endpoints(4, queue=[0, 3, 3, 3])
+    res2 = sched.pick(make_requests(1, prompts=[b"B" * 4096]), eps2)
+    assert int(res2.indices[0, 0]) == 0
+
+
+def test_random_picker_spreads_and_respects_mask():
+    cfg = ProfileConfig(picker="random", enable_prefix=False)
+    sched = Scheduler(cfg)
+    eps = make_endpoints(4, queue=[0, 0, 0, 50])
+    reqs = make_requests(64, subset=[[0, 1, 2]] * 64)
+    res = sched.pick(reqs, eps)
+    picks = set(int(i) for i in res.indices[:, 0])
+    assert picks <= {0, 1, 2}
+    assert len(picks) >= 2  # sampling spreads across equals
+
+
+def test_invalid_rows_padded_batches():
+    """Bucket padding must not leak picks into padded rows."""
+    sched = Scheduler()
+    eps = make_endpoints(2, queue=[0, 0])
+    reqs = make_requests(3)  # pads to bucket 8
+    res = sched.pick(reqs, eps)
+    assert res.indices.shape[0] == 3  # trimmed back to caller's batch
+
+
+def test_large_batch_256x_all_ok():
+    sched = Scheduler()
+    eps = make_endpoints(64, queue=list(np.arange(64) % 7))
+    reqs = make_requests(200)
+    res = sched.pick(reqs, eps)
+    assert (np.asarray(res.status) == Status.OK).all()
+    assert (np.asarray(res.indices[:, 0]) >= 0).all()
+
+
+def test_concurrent_picks_thread_safe():
+    """Analogue of the reference datastore concurrency tests
+    (datastore_test.go:61,867): concurrent picks + completes must not race or
+    deadlock."""
+    import threading
+
+    sched = Scheduler()
+    eps = make_endpoints(8, queue=[0] * 8)
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(5):
+                res = sched.pick(make_requests(4), eps)
+                sched.complete(
+                    np.asarray(res.indices[:, 0]), np.ones((4,), np.float32)
+                )
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs
+
+
+def test_evict_endpoint_clears_prefix_affinity():
+    """A dead pod's slot must not attract prefix-affine traffic after
+    eviction (datastore PodDelete analogue)."""
+    cfg = ProfileConfig(load_decay=0.0)
+    w = Weights.default().replace(prefix=np.float32(3.0))
+    sched = Scheduler(cfg, weights=w)
+    eps = make_endpoints(4, queue=[1, 1, 1, 1])
+    prompt = b"shared prefix " * 100
+    res1 = sched.pick(make_requests(1, prompts=[prompt + b"a"]), eps)
+    home = int(res1.indices[0, 0])
+    sched.evict_endpoint(home)
+    queue = [0.0] * 4
+    queue[home] = 0.0
+    other_best = (home + 1) % 4
+    queue2 = [1.0] * 4
+    queue2[other_best] = 0.0
+    res2 = sched.pick(make_requests(1, prompts=[prompt + b"b"]), make_endpoints(4, queue=queue2))
+    assert int(res2.indices[0, 0]) == other_best
