@@ -1,0 +1,36 @@
+"""InferencePool / InferencePoolImport API layer.
+
+Python-native equivalent of the reference CRD packages (api/v1 +
+apix/v1alpha1): typed objects, defaulting, CEL-equivalent validation, and a
+CRD-YAML generator for cluster installation.
+"""
+
+from gie_tpu.api.types import (
+    Condition,
+    EndpointPickerRef,
+    FailureMode,
+    InferencePool,
+    InferencePoolImport,
+    InferencePoolSpec,
+    InferencePoolStatus,
+    LabelSelector,
+    ParentReference,
+    ParentStatus,
+    Port,
+    ValidationError,
+)
+
+__all__ = [
+    "Condition",
+    "EndpointPickerRef",
+    "FailureMode",
+    "InferencePool",
+    "InferencePoolImport",
+    "InferencePoolSpec",
+    "InferencePoolStatus",
+    "LabelSelector",
+    "ParentReference",
+    "ParentStatus",
+    "Port",
+    "ValidationError",
+]

@@ -1,0 +1,16 @@
+"""Reconcile layer: watch events -> datastore updates."""
+
+from gie_tpu.controller.cluster import FakeCluster, WatchEvent
+from gie_tpu.controller.reconcilers import (
+    InferencePoolReconciler,
+    PodReconciler,
+    RequeueAfter,
+)
+
+__all__ = [
+    "FakeCluster",
+    "WatchEvent",
+    "InferencePoolReconciler",
+    "PodReconciler",
+    "RequeueAfter",
+]

@@ -1,0 +1,103 @@
+"""Cluster client abstraction + in-memory fake.
+
+The reference consumes the kube-apiserver through controller-runtime's cached
+client with namespace/name-scoped caches (reference
+pkg/lwepp/server/controller_manager.go:45-68). This module defines the narrow
+client surface the reconcilers need (get/list/watch) and an in-memory
+FakeCluster implementing it — the test tier's stand-in for envtest/fake
+client (reference test strategy, SURVEY.md section 4), and the seam where a
+real kubernetes client plugs in when one is available.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Iterator, Optional, Protocol
+
+from gie_tpu.api.types import InferencePool
+from gie_tpu.datastore.objects import Pod
+
+
+@dataclasses.dataclass
+class WatchEvent:
+    """ADDED / MODIFIED / DELETED event for a Pod or InferencePool."""
+
+    type: str        # "ADDED" | "MODIFIED" | "DELETED"
+    kind: str        # "Pod" | "InferencePool"
+    namespace: str
+    name: str
+
+
+class ClusterClient(Protocol):
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]: ...
+
+    def list_pods(self, namespace: str) -> list[Pod]: ...
+
+    def get_pool(self, namespace: str, name: str) -> Optional[InferencePool]: ...
+
+
+class FakeCluster:
+    """In-memory apiserver: objects + synchronous watch fan-out."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._pods: dict[tuple[str, str], Pod] = {}
+        self._pools: dict[tuple[str, str], InferencePool] = {}
+        self._subscribers: list[Callable[[WatchEvent], None]] = []
+
+    # -- client surface ----------------------------------------------------
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        with self._lock:
+            return self._pods.get((namespace, name))
+
+    def list_pods(self, namespace: str) -> list[Pod]:
+        with self._lock:
+            return [p for (ns, _), p in self._pods.items() if ns == namespace]
+
+    def get_pool(self, namespace: str, name: str) -> Optional[InferencePool]:
+        with self._lock:
+            return self._pools.get((namespace, name))
+
+    # -- mutation (test driver / simulator side) ---------------------------
+
+    def apply_pod(self, pod: Pod) -> None:
+        with self._lock:
+            key = (pod.namespace, pod.name)
+            etype = "MODIFIED" if key in self._pods else "ADDED"
+            self._pods[key] = pod
+        self._emit(WatchEvent(etype, "Pod", pod.namespace, pod.name))
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._pods.pop((namespace, name), None)
+        self._emit(WatchEvent("DELETED", "Pod", namespace, name))
+
+    def apply_pool(self, pool: InferencePool) -> None:
+        pool.validate()
+        with self._lock:
+            key = (pool.metadata.namespace, pool.metadata.name)
+            etype = "MODIFIED" if key in self._pools else "ADDED"
+            self._pools[key] = pool
+        self._emit(
+            WatchEvent(etype, "InferencePool", pool.metadata.namespace,
+                       pool.metadata.name)
+        )
+
+    def delete_pool(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._pools.pop((namespace, name), None)
+        self._emit(WatchEvent("DELETED", "InferencePool", namespace, name))
+
+    # -- watch -------------------------------------------------------------
+
+    def subscribe(self, fn: Callable[[WatchEvent], None]) -> None:
+        self._subscribers.append(fn)
+
+    def _emit(self, event: WatchEvent) -> None:
+        for fn in list(self._subscribers):
+            fn(event)
+
+    def events(self) -> Iterator[WatchEvent]:  # pragma: no cover - helper
+        raise NotImplementedError

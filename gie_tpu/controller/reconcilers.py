@@ -1,0 +1,97 @@
+"""Pod + InferencePool reconcilers.
+
+Faithful behavioral port of reference pkg/lwepp/controller/
+{inferencepool,pod}_reconciler.go onto the ClusterClient abstraction:
+
+  InferencePoolReconciler (inferencepool_reconciler.go:37-78):
+    not-found / deleting  -> datastore.clear()
+    otherwise             -> to_endpoint_pool -> pool_set (with pod lister
+                             for the resync-on-change path)
+
+  PodReconciler (pod_reconciler.go:37-102):
+    pool not synced       -> requeue 5 s
+    not-found             -> pod_delete
+    ready && labels match -> pod_update_or_add, else pod_delete
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from gie_tpu.controller.cluster import ClusterClient, WatchEvent
+from gie_tpu.datastore.datastore import Datastore
+from gie_tpu.utils.kubemeta import GKNN
+from gie_tpu.utils.podutil import is_pod_ready, to_endpoint_pool
+
+
+@dataclasses.dataclass
+class RequeueAfter:
+    """Reconcile result asking the driver to retry later (reference
+    pod_reconciler.go:44-47 requeue-5s-until-pool-synced)."""
+
+    seconds: float
+
+
+class InferencePoolReconciler:
+    def __init__(self, client: ClusterClient, datastore: Datastore, pool_gknn: GKNN):
+        self.client = client
+        self.datastore = datastore
+        self.pool_gknn = pool_gknn
+
+    def reconcile(self, namespace: str, name: str) -> Optional[RequeueAfter]:
+        # Scoped cache: only the configured pool identity is watched
+        # (reference controller_manager.go:45-68 field-selector scoping).
+        if (namespace, name) != (self.pool_gknn.namespace, self.pool_gknn.name):
+            return None
+        pool = self.client.get_pool(namespace, name)
+        if pool is None or pool.metadata.deletionTimestamp is not None:
+            self.datastore.clear()
+            return None
+        self.datastore.pool_set(
+            to_endpoint_pool(pool),
+            pod_lister=lambda: self.client.list_pods(namespace),
+        )
+        return None
+
+
+class PodReconciler:
+    def __init__(self, client: ClusterClient, datastore: Datastore):
+        self.client = client
+        self.datastore = datastore
+
+    def reconcile(self, namespace: str, name: str) -> Optional[RequeueAfter]:
+        if not self.datastore.pool_has_synced():
+            return RequeueAfter(5.0)
+        pool = self.datastore.pool_get()
+        if namespace != pool.namespace:
+            return None
+        pod = self.client.get_pod(namespace, name)
+        if pod is None:
+            self.datastore.pod_delete(namespace, name)
+            return None
+        labels_match = all(
+            pod.labels.get(k) == v for k, v in pool.selector.items()
+        )
+        if is_pod_ready(pod) and labels_match:
+            self.datastore.pod_update_or_add(pod)
+        else:
+            self.datastore.pod_delete(namespace, name)
+        return None
+
+
+def wire(
+    cluster,
+    pool_reconciler: InferencePoolReconciler,
+    pod_reconciler: PodReconciler,
+) -> None:
+    """Subscribe both reconcilers to a cluster's watch stream (the manager
+    wiring of reference runserver.go:78-93)."""
+
+    def on_event(ev: WatchEvent) -> None:
+        if ev.kind == "InferencePool":
+            pool_reconciler.reconcile(ev.namespace, ev.name)
+        elif ev.kind == "Pod":
+            pod_reconciler.reconcile(ev.namespace, ev.name)
+
+    cluster.subscribe(on_event)

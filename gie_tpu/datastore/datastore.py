@@ -1,0 +1,225 @@
+"""Thread-safe datastore: pool spec + per-(pod, rank) endpoints + slot map.
+
+Re-design of reference pkg/lwepp/datastore/datastore.go:67-334 with one TPU
+addition: a dense slot allocator. Every endpoint owns a stable slot in
+[0, M_MAX) for as long as it lives; the scheduler's device state (assumed
+load, prefix presence columns) is indexed by slot, so pod churn translates to
+mask flips and column clears — never to a shape change or recompile.
+
+Semantics preserved from the reference:
+  - pool must be set before pods are admitted (errPoolNotSynced,
+    datastore.go:54)
+  - one endpoint per (pod, targetPort index "rank"), named
+    `<pod>-rank-<idx>` (datastore.go:329-334)
+  - the `inference.networking.k8s.io/active-ports` annotation filters which
+    ranks are active per pod, as a comma-separated port list restricted to
+    the pool's targetPorts (datastore.go:307-325)
+  - selector/targetPorts change triggers a full resync against a pod lister
+    (datastore.go:131-147, 267-304)
+  - Clear() drops everything (pool deletion, datastore.go:111-116)
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Callable, Iterable, Optional
+
+from gie_tpu.datastore.objects import Endpoint, EndpointPool, Pod
+from gie_tpu.sched import constants as C
+from gie_tpu.api.types import ACTIVE_PORTS_ANNOTATION
+
+
+class PoolNotSyncedError(RuntimeError):
+    """InferencePool is not initialized in the data store."""
+
+
+# Called with the freed slot whenever an endpoint is removed, so the
+# scheduler can invalidate per-slot device state (prefix presence, assumed
+# load) before the slot is reused.
+SlotReclaimedFn = Callable[[int], None]
+
+
+def _active_ports(pod: Pod, target_ports: list[int]) -> list[int]:
+    """Parse the active-ports annotation (reference datastore.go:307-325):
+    absent -> all target ports; present -> intersection with targetPorts."""
+    raw = pod.annotations.get(ACTIVE_PORTS_ANNOTATION)
+    if raw is None:
+        return list(target_ports)
+    allowed = set(target_ports)
+    active = []
+    for part in raw.split(","):
+        part = part.strip()
+        try:
+            num = int(part)
+        except ValueError:
+            continue
+        if num > 0 and num in allowed:
+            active.append(num)
+    return active
+
+
+class Datastore:
+    """In-memory cache shared by reconcilers (writers) and the request path
+    (readers). Reference interface: datastore.go:67-84."""
+
+    def __init__(
+        self,
+        on_slot_reclaimed: Optional[SlotReclaimedFn] = None,
+        max_slots: int = C.M_MAX,
+    ):
+        self._lock = threading.RLock()
+        self._pool: Optional[EndpointPool] = None
+        self._endpoints: dict[str, Endpoint] = {}  # key: "<ns>/<pod>-rank-<i>"
+        self._free_slots: list[int] = list(range(max_slots))
+        heapq.heapify(self._free_slots)
+        self._on_slot_reclaimed = on_slot_reclaimed
+        self._max_slots = max_slots
+
+    # ---- pool ------------------------------------------------------------
+
+    def pool_set(
+        self,
+        pool: EndpointPool,
+        pod_lister: Optional[Callable[[], Iterable[Pod]]] = None,
+    ) -> None:
+        """Install/replace the pool spec. If the selector or targetPorts
+        changed, resync all endpoints from `pod_lister` (reference
+        datastore.go:119-150 + podResyncAll :267-304)."""
+        with self._lock:
+            old = self._pool
+            self._pool = pool
+            changed = old is not None and (
+                old.selector != pool.selector
+                or old.target_ports != pool.target_ports
+            )
+            if (old is None or changed) and pod_lister is not None:
+                self._resync_all(pod_lister())
+
+    def pool_get(self) -> EndpointPool:
+        with self._lock:
+            if self._pool is None:
+                raise PoolNotSyncedError(
+                    "InferencePool is not initialized in data store"
+                )
+            return self._pool
+
+    def pool_has_synced(self) -> bool:
+        with self._lock:
+            return self._pool is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._pool = None
+            for key in list(self._endpoints):
+                self._remove_endpoint(key)
+
+    # ---- pods / endpoints ------------------------------------------------
+
+    def pod_update_or_add(self, pod: Pod) -> None:
+        """Admit/refresh a ready, label-matching pod: ensure exactly one
+        endpoint per active rank (reference PodUpdateOrAddIfNotExist,
+        datastore.go:195-255)."""
+        with self._lock:
+            pool = self.pool_get()
+            active = set(_active_ports(pod, pool.target_ports))
+            for idx, port in enumerate(pool.target_ports):
+                key = self._key(pod.namespace, pod.name, idx)
+                existing = self._endpoints.get(key)
+                if port in active:
+                    if existing is None:
+                        slot = self._alloc_slot()
+                        self._endpoints[key] = Endpoint(
+                            name=f"{pod.name}-rank-{idx}",
+                            namespace=pod.namespace,
+                            pod_name=pod.name,
+                            address=pod.ip,
+                            port=port,
+                            rank=idx,
+                            slot=slot,
+                            labels=dict(pod.labels),
+                        )
+                    else:
+                        # Refresh mutable fields in place; slot is sticky.
+                        existing.address = pod.ip
+                        existing.labels = dict(pod.labels)
+                else:
+                    if existing is not None:
+                        self._remove_endpoint(key)
+            # Drop stale ranks beyond the current targetPorts length
+            # (targetPorts shrink during resync, datastore.go:267-304).
+            rank = len(pool.target_ports)
+            while True:
+                key = self._key(pod.namespace, pod.name, rank)
+                if key not in self._endpoints:
+                    break
+                self._remove_endpoint(key)
+                rank += 1
+
+    def pod_delete(self, namespace: str, pod_name: str) -> None:
+        """Drop all rank endpoints of a pod (reference PodDelete,
+        datastore.go:257-265)."""
+        with self._lock:
+            prefix = f"{namespace}/{pod_name}-rank-"
+            for key in [k for k in self._endpoints if k.startswith(prefix)]:
+                self._remove_endpoint(key)
+
+    def endpoints(
+        self, predicate: Optional[Callable[[Endpoint], bool]] = None
+    ) -> list[Endpoint]:
+        """Snapshot of endpoints (reference PodList, datastore.go:181-193)."""
+        with self._lock:
+            eps = list(self._endpoints.values())
+        if predicate is not None:
+            eps = [e for e in eps if predicate(e)]
+        return eps
+
+    def endpoint_by_hostport(self, hostport: str) -> Optional[Endpoint]:
+        with self._lock:
+            for e in self._endpoints.values():
+                if e.hostport == hostport:
+                    return e
+            return None
+
+    def slot_map(self) -> dict[str, int]:
+        """hostport -> slot for subset-mask construction."""
+        with self._lock:
+            return {e.hostport: e.slot for e in self._endpoints.values()}
+
+    # ---- internals -------------------------------------------------------
+
+    @staticmethod
+    def _key(namespace: str, pod_name: str, rank: int) -> str:
+        return f"{namespace}/{pod_name}-rank-{rank}"
+
+    def _alloc_slot(self) -> int:
+        if not self._free_slots:
+            raise RuntimeError(
+                f"endpoint count exceeds scheduler capacity M_MAX={self._max_slots}"
+            )
+        return heapq.heappop(self._free_slots)
+
+    def _remove_endpoint(self, key: str) -> None:
+        ep = self._endpoints.pop(key)
+        heapq.heappush(self._free_slots, ep.slot)
+        if self._on_slot_reclaimed is not None:
+            self._on_slot_reclaimed(ep.slot)
+
+    def _resync_all(self, pods: Iterable[Pod]) -> None:
+        """Full diff against the lister (reference podResyncAll,
+        datastore.go:267-304): admit matching+ready pods, evict the rest."""
+        assert self._pool is not None
+        matching: set[str] = set()
+        from gie_tpu.utils.podutil import is_pod_ready
+
+        for pod in pods:
+            labels_match = all(
+                pod.labels.get(k) == v for k, v in self._pool.selector.items()
+            )
+            if labels_match and is_pod_ready(pod):
+                matching.add(f"{pod.namespace}/{pod.name}")
+                self.pod_update_or_add(pod)
+        for key in list(self._endpoints):
+            ep = self._endpoints[key]
+            if f"{ep.namespace}/{ep.pod_name}" not in matching:
+                self._remove_endpoint(key)

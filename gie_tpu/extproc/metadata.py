@@ -1,0 +1,27 @@
+"""Canonical protocol keys (reference pkg/lwepp/metadata/consts.go:26-38)."""
+
+# Outer namespace wrapping the subset filter in request metadata.
+SUBSET_FILTER_NAMESPACE = "envoy.lb.subset_hint"
+# Candidate-endpoints key inside the subset namespace (string or array).
+SUBSET_FILTER_KEY = "x-gateway-destination-endpoint-subset"
+# Outer namespace for the destination endpoint in response dynamic metadata.
+DESTINATION_ENDPOINT_NAMESPACE = "envoy.lb"
+# Header + metadata key carrying the picked endpoint(s).
+DESTINATION_ENDPOINT_KEY = "x-gateway-destination-endpoint"
+# Response-phase metadata key reporting which endpoint actually served.
+DESTINATION_ENDPOINT_SERVED_KEY = "x-gateway-destination-endpoint-served"
+# Conformance echo header (reference Appendix B test affordances).
+CONFORMANCE_TEST_RESULT_HEADER = "x-conformance-test-served-endpoint"
+# Flow-control fairness ID header (proposal 1199 / flow control).
+FLOW_FAIRNESS_ID_KEY = "x-gateway-inference-fairness-id"
+# Request objective/criticality header (proposal 1199).
+OBJECTIVE_KEY = "x-gateway-inference-objective"
+# Model-name rewrite header (proposal 1816).
+MODEL_NAME_REWRITE_KEY = "x-gateway-model-name-rewrite"
+
+# Test-only steering header (reference request.go:84-97 + conformance
+# utils/headers/headers.go:19-22).
+TEST_ENDPOINT_SELECTION_HEADER = "test-epp-endpoint-selection"
+
+# Debug header set on response headers (reference response.go:57-62).
+WENT_INTO_RESP_HEADERS = "x-went-into-resp-headers"

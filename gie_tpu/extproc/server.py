@@ -1,0 +1,318 @@
+"""ext-proc StreamingServer: the request data path.
+
+Behavioral port of reference pkg/lwepp/handlers/{server,request,response}.go
+onto a transport-agnostic stream (recv/send), so the same Process loop runs
+under the real gRPC service and under in-memory test streams (the
+mockProcessServer pattern of reference handlers/server_test.go:33-59).
+
+Choreography (reference server.go:105-287):
+  RequestHeaders  -> parse headers + subset hint; pick immediately iff
+                     end_of_stream, else defer until the body completes
+  RequestBody     -> accumulate (10 MiB cap); on end_of_stream pick, emit the
+                     deferred headers response, then the body response
+  ResponseHeaders -> echo the served endpoint from envoy.lb metadata +
+                     feed the served signal back to the picker
+  ResponseBody    -> empty passthrough
+
+Errors follow lwepp: no pods / no candidates -> gRPC UNAVAILABLE (the data
+plane converts per FailureMode); shed -> ImmediateResponse 429 per the
+endpoint-picker protocol (004 README:80).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Protocol
+
+import grpc
+
+from gie_tpu.extproc import envoy, metadata, pb
+
+MAX_REQUEST_BODY_SIZE = 10 * 1024 * 1024  # reference server.go:103
+
+
+class ExtProcError(Exception):
+    """Stream-fatal protocol error -> gRPC status."""
+
+    def __init__(self, code: grpc.StatusCode, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class ShedError(Exception):
+    """Request shed under load -> ImmediateResponse 429 (004 README:80)."""
+
+
+@dataclasses.dataclass
+class PickRequest:
+    """reference handlers/server.go:65-69."""
+
+    headers: dict[str, list[str]]
+    body: Optional[bytes] = None
+    model: str = ""
+
+
+@dataclasses.dataclass
+class PickResult:
+    """reference handlers/server.go:72-77."""
+
+    endpoint: str                       # primary "ip:port"
+    fallbacks: list[str] = dataclasses.field(default_factory=list)
+    mutated_body: Optional[bytes] = None
+    extra_headers: dict[str, str] = dataclasses.field(default_factory=dict)
+    # Assumed-load units this pick added (released on served feedback).
+    assumed_cost: float = 1.0
+
+    @property
+    def destination_value(self) -> str:
+        """Comma-separated ordered fallback list (004 README:50-82)."""
+        return ",".join([self.endpoint] + self.fallbacks)
+
+
+class EndpointPicker(Protocol):
+    """reference handlers/server.go:80-82."""
+
+    def pick(self, req: PickRequest, candidates: list) -> PickResult: ...
+
+
+class RoundRobinPicker:
+    """reference handlers/server.go:85-101."""
+
+    def __init__(self) -> None:
+        self._i = 0
+
+    def pick(self, req: PickRequest, candidates: list) -> PickResult:
+        if not candidates:
+            raise ExtProcError(
+                grpc.StatusCode.UNAVAILABLE, "no endpoints available"
+            )
+        self._i += 1
+        ep = candidates[self._i % len(candidates)]
+        return PickResult(endpoint=ep.hostport)
+
+
+@dataclasses.dataclass
+class RequestContext:
+    headers: dict[str, list[str]] = dataclasses.field(default_factory=dict)
+    candidates: list = dataclasses.field(default_factory=list)
+    target_endpoint: str = ""
+    selected_pod_ip: str = ""
+
+
+class Stream(Protocol):
+    def recv(self) -> Optional[pb.ProcessingRequest]: ...
+
+    def send(self, resp: pb.ProcessingResponse) -> None: ...
+
+
+class StreamingServer:
+    """One instance serves all streams; Process is invoked per HTTP request
+    (Envoy opens an ext-proc stream per request)."""
+
+    def __init__(self, datastore, picker: EndpointPicker, on_served=None):
+        self.datastore = datastore
+        self.picker = picker
+        # Served-endpoint feedback hook (004 README:84-101): called with the
+        # hostport reported by the data plane at response time.
+        self.on_served = on_served
+
+    # ------------------------------------------------------------------ #
+
+    def process(self, stream: Stream) -> None:
+        ctx = RequestContext()
+        body = bytearray()
+        headers_deferred = False
+        while True:
+            req = stream.recv()
+            if req is None:
+                return
+            which = req.WhichOneof("request")
+            if which == "request_headers":
+                self._handle_request_headers(ctx, req)
+                if req.request_headers.end_of_stream:
+                    self._pick(ctx, None)
+                    stream.send(self._headers_response(ctx))
+                else:
+                    headers_deferred = True
+            elif which == "request_body":
+                chunk = req.request_body.body
+                if len(body) + len(chunk) > MAX_REQUEST_BODY_SIZE:
+                    raise ExtProcError(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"request body size limit of {MAX_REQUEST_BODY_SIZE} "
+                        "bytes exceeded",
+                    )
+                body.extend(chunk)
+                if req.request_body.end_of_stream:
+                    try:
+                        result = self._pick(ctx, bytes(body))
+                    except ShedError:
+                        stream.send(
+                            pb.ProcessingResponse(
+                                immediate_response=pb.ImmediateResponse(
+                                    status_code=429, details="request shed"
+                                )
+                            )
+                        )
+                        return
+                    if headers_deferred:
+                        stream.send(self._headers_response(ctx))
+                        headers_deferred = False
+                    if result.mutated_body is not None:
+                        for resp in envoy.build_chunked_body_responses(
+                            result.mutated_body, request_path=True
+                        ):
+                            stream.send(resp)
+                    else:
+                        stream.send(
+                            pb.ProcessingResponse(
+                                request_body=pb.BodyResponse(
+                                    response=pb.CommonResponse()
+                                )
+                            )
+                        )
+                else:
+                    # Intermediate chunks need no reply in buffered-partial
+                    # mode; continue receiving.
+                    continue
+            elif which == "response_headers":
+                stream.send(self._handle_response_headers(ctx, req))
+            elif which == "response_body":
+                stream.send(
+                    pb.ProcessingResponse(
+                        response_body=pb.BodyResponse(response=pb.CommonResponse())
+                    )
+                )
+            else:  # trailers etc. — ignored (reference server.go:283-285)
+                continue
+
+    # ------------------------------------------------------------------ #
+
+    def _handle_request_headers(
+        self, ctx: RequestContext, req: pb.ProcessingRequest
+    ) -> None:
+        """reference handlers/request.go:34-139."""
+        hdrs = req.request_headers
+        for h in hdrs.headers.headers:
+            ctx.headers.setdefault(h.key, []).append(envoy.get_header_value(h))
+
+        # Subset hint from filter metadata: string ("ip1,ip2") or array forms
+        # (reference request.go:51-77 — both Envoy pathways supported).
+        md = envoy.extract_metadata_values(req)
+        has_subset_filter = False
+        metadata_endpoints: list[str] = []
+        subset_ns = md.get(metadata.SUBSET_FILTER_NAMESPACE)
+        if isinstance(subset_ns, dict) and metadata.SUBSET_FILTER_KEY in subset_ns:
+            has_subset_filter = True
+            val = subset_ns[metadata.SUBSET_FILTER_KEY]
+            if isinstance(val, str):
+                parts = val.split(",")
+            elif isinstance(val, list):
+                parts = []
+                for item in val:
+                    if isinstance(item, str):
+                        parts.extend(item.split(","))
+            else:
+                parts = []
+            metadata_endpoints = [p.strip() for p in parts if p.strip()]
+
+        # Test steering header takes priority (reference request.go:84-97).
+        filter_endpoints: list[str] = []
+        test_val = envoy.extract_header_value(
+            hdrs, metadata.TEST_ENDPOINT_SELECTION_HEADER
+        )
+        if test_val:
+            filter_endpoints = [p.strip() for p in test_val.split(",") if p.strip()]
+        if not filter_endpoints and metadata_endpoints:
+            filter_endpoints = metadata_endpoints
+
+        all_eps = self.datastore.endpoints()
+        if not all_eps:
+            raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "no pods available")
+
+        if has_subset_filter or filter_endpoints:
+            # ip or ip:port entries; bare ip allows all ports
+            # (reference request.go:104-129).
+            allow_all_ports: set[str] = set()
+            allowed: set[str] = set()
+            for e in filter_endpoints:
+                if ":" in e:
+                    allowed.add(e)
+                else:
+                    allow_all_ports.add(e)
+            ctx.candidates = [
+                ep
+                for ep in all_eps
+                if ep.address in allow_all_ports or ep.hostport in allowed
+            ]
+            # Strict subsetting: empty candidate set stays empty
+            # (request.go:130-133) -> UNAVAILABLE at pick time.
+            return
+        ctx.candidates = all_eps
+
+    def _pick(self, ctx: RequestContext, body: Optional[bytes]) -> PickResult:
+        """reference handlers/request.go:141-163."""
+        model = ""
+        rewrite = ctx.headers.get(metadata.MODEL_NAME_REWRITE_KEY)
+        if rewrite:
+            model = rewrite[0]
+        result = self.picker.pick(
+            PickRequest(headers=ctx.headers, body=body, model=model),
+            ctx.candidates,
+        )
+        ctx.target_endpoint = result.destination_value
+        ctx.selected_pod_ip = result.endpoint.rsplit(":", 1)[0]
+        ctx.pick_result = result
+        return result
+
+    def _headers_response(self, ctx: RequestContext) -> pb.ProcessingResponse:
+        """Destination via BOTH header and envoy.lb dynamic metadata
+        (004 README:46-82; reference server.go:148-190)."""
+        set_headers = {
+            metadata.DESTINATION_ENDPOINT_KEY: ctx.target_endpoint,
+            # Conformance affordance: ask the echo backend to reflect the
+            # served endpoint (reference server.go:162-166, Appendix B).
+            "X-Echo-Set-Header": (
+                metadata.CONFORMANCE_TEST_RESULT_HEADER + ":" + ctx.target_endpoint
+            ),
+        }
+        extra = getattr(ctx, "pick_result", None)
+        if extra is not None:
+            set_headers.update(extra.extra_headers)
+        return pb.ProcessingResponse(
+            request_headers=pb.HeadersResponse(
+                response=pb.CommonResponse(
+                    clear_route_cache=True,
+                    header_mutation=envoy.generate_headers_mutation(set_headers),
+                )
+            ),
+            dynamic_metadata=envoy.make_dynamic_metadata(
+                metadata.DESTINATION_ENDPOINT_NAMESPACE,
+                {metadata.DESTINATION_ENDPOINT_KEY: ctx.target_endpoint},
+            ),
+        )
+
+    def _handle_response_headers(
+        self, ctx: RequestContext, req: pb.ProcessingRequest
+    ) -> pb.ProcessingResponse:
+        """reference handlers/response.go:30-92."""
+        md = envoy.extract_metadata_values(req)
+        served = ""
+        lb = md.get(metadata.DESTINATION_ENDPOINT_NAMESPACE)
+        if isinstance(lb, dict):
+            v = lb.get(metadata.DESTINATION_ENDPOINT_SERVED_KEY)
+            if isinstance(v, str):
+                served = v
+        if served and self.on_served is not None:
+            self.on_served(served, ctx)
+        set_headers = {metadata.WENT_INTO_RESP_HEADERS: "true"}
+        if served:
+            set_headers[metadata.CONFORMANCE_TEST_RESULT_HEADER] = served
+        return pb.ProcessingResponse(
+            response_headers=pb.HeadersResponse(
+                response=pb.CommonResponse(
+                    header_mutation=envoy.generate_headers_mutation(set_headers)
+                )
+            )
+        )

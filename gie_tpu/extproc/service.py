@@ -1,0 +1,77 @@
+"""gRPC service registration for the ext-proc StreamingServer.
+
+Registers under Envoy's service name
+(`envoy.service.ext_proc.v3.ExternalProcessor`, method `Process`) via grpc
+generic handlers — no protoc-gen-grpc plugin needed — so an Envoy configured
+for a standard ext-proc cluster reaches us without config changes (reference
+runserver.go:115 RegisterExternalProcessorServer).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import grpc
+
+from gie_tpu.extproc import pb
+from gie_tpu.extproc.server import ExtProcError, StreamingServer
+
+SERVICE_NAME = "envoy.service.ext_proc.v3.ExternalProcessor"
+
+
+def _process_handler(server: StreamingServer):
+    def process(request_iterator, context: grpc.ServicerContext):
+        out: queue.Queue = queue.Queue()
+        done = object()
+
+        class _Stream:
+            def recv(self):
+                try:
+                    return next(request_iterator)
+                except StopIteration:
+                    return None
+                except grpc.RpcError:
+                    return None
+
+            def send(self, resp: pb.ProcessingResponse) -> None:
+                out.put(resp)
+
+        failure: list[ExtProcError] = []
+
+        def run() -> None:
+            try:
+                server.process(_Stream())
+            except ExtProcError as e:
+                failure.append(e)
+            except Exception as e:  # stream-fatal internal error
+                failure.append(
+                    ExtProcError(grpc.StatusCode.INTERNAL, f"internal error: {e}")
+                )
+            finally:
+                out.put(done)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        while True:
+            item = out.get()
+            if item is done:
+                break
+            yield item
+        t.join()
+        if failure:
+            context.abort(failure[0].code, failure[0].message)
+
+    return process
+
+
+def add_extproc_service(grpc_server: grpc.Server, server: StreamingServer) -> None:
+    handler = grpc.stream_stream_rpc_method_handler(
+        _process_handler(server),
+        request_deserializer=pb.ProcessingRequest.FromString,
+        response_serializer=pb.ProcessingResponse.SerializeToString,
+    )
+    generic = grpc.method_handlers_generic_handler(
+        SERVICE_NAME, {"Process": handler}
+    )
+    grpc_server.add_generic_rpc_handlers((generic,))

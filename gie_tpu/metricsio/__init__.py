@@ -1,0 +1,11 @@
+"""Model-server metrics layer: scrape -> flat [M, K] metrics tensor.
+
+Implements the model-server metrics protocol of reference
+docs/proposals/003-model-server-protocol/README.md and the data-layer
+architecture of docs/proposals/1023-data-layer-architecture/README.md, with
+the TPU twist that the sink is a dense tensor view, not per-endpoint structs.
+"""
+
+from gie_tpu.metricsio.store import MetricsStore
+
+__all__ = ["MetricsStore"]

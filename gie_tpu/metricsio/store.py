@@ -1,0 +1,88 @@
+"""MetricsStore: the dense endpoint-metrics sink.
+
+The reference's data layer stores scraped PodMetrics per endpoint object
+(reference docs/proposals/1023-data-layer-architecture/README.md:104-164
+Endpoint.Store/GetAttributes). Here the store IS the tensor: per-slot rows of
+a float32 [M_MAX, NUM_METRICS] matrix plus LoRA residency slots, snapshotted
+into an EndpointBatch for the scheduler in O(1) copies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from gie_tpu.datastore.objects import Endpoint
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.types import EndpointBatch
+
+
+class MetricsStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics = np.zeros((C.M_MAX, C.NUM_METRICS), np.float32)
+        self._lora_active = np.full((C.M_MAX, C.LORA_SLOTS), -1, np.int32)
+        self._lora_waiting = np.full((C.M_MAX, C.LORA_SLOTS), -1, np.int32)
+        self._scraped_at = np.zeros((C.M_MAX,), np.float64)
+        self._has_data = np.zeros((C.M_MAX,), bool)
+
+    def update(
+        self,
+        slot: int,
+        metrics: dict[int, float],
+        lora_active: Sequence[int] = (),
+        lora_waiting: Sequence[int] = (),
+        now: Optional[float] = None,
+    ) -> None:
+        """Record one endpoint's scrape result (metric-column -> value)."""
+        with self._lock:
+            for col, val in metrics.items():
+                self._metrics[slot, col] = val
+            self._lora_active[slot] = -1
+            self._lora_active[slot, : len(lora_active)] = list(lora_active)[
+                : C.LORA_SLOTS
+            ]
+            self._lora_waiting[slot] = -1
+            self._lora_waiting[slot, : len(lora_waiting)] = list(lora_waiting)[
+                : C.LORA_SLOTS
+            ]
+            self._scraped_at[slot] = time.time() if now is None else now
+            self._has_data[slot] = True
+
+    def remove(self, slot: int) -> None:
+        """Forget a reclaimed slot (wired to Datastore.on_slot_reclaimed)."""
+        with self._lock:
+            self._metrics[slot] = 0.0
+            self._lora_active[slot] = -1
+            self._lora_waiting[slot] = -1
+            self._scraped_at[slot] = 0.0
+            self._has_data[slot] = False
+
+    def endpoint_batch(
+        self, endpoints: Iterable[Endpoint], now: Optional[float] = None
+    ) -> EndpointBatch:
+        """Dense snapshot for one scheduling cycle. Endpoints without any
+        scrape yet are still valid (zero metrics = optimistic cold start,
+        matching the reference's fresh-endpoint admission)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            metrics = self._metrics.copy()
+            active = self._lora_active.copy()
+            waiting = self._lora_waiting.copy()
+            age = np.where(
+                self._has_data, now - self._scraped_at, 0.0
+            ).astype(np.float32)
+        metrics[:, C.Metric.METRICS_AGE_S] = age
+        valid = np.zeros((C.M_MAX,), bool)
+        for ep in endpoints:
+            valid[ep.slot] = True
+        return EndpointBatch(
+            metrics=jnp.asarray(metrics),
+            valid=jnp.asarray(valid),
+            lora_active=jnp.asarray(active),
+            lora_waiting=jnp.asarray(waiting),
+        )

@@ -1,0 +1,201 @@
+"""BatchingTPUPicker: micro-batching bridge from per-stream picks to the
+batched TPU scheduling cycle.
+
+The reference's alternate-scheduler seam (docs/proposals/006-scheduler/
+README.md:160-162) describes exactly this component: an out-of-process
+scheduler "accepting batches of requests + endpoints and returning
+selections". Ext-proc opens one stream per HTTP request (server.go:105), so
+concurrent Process threads enqueue here; a collector thread drains the queue
+every `max_wait_s` (or at `max_batch`) and runs ONE jitted scheduling cycle
+for the whole wave — decoupling stream cadence from batch cadence
+(SURVEY.md section 7.4 "latency discipline across the Go<->TPU boundary").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from gie_tpu.extproc.server import (
+    ExtProcError,
+    PickRequest,
+    PickResult,
+    ShedError,
+)
+from gie_tpu.extproc import metadata as mdkeys
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.hashing import batch_chunk_hashes
+from gie_tpu.sched.profile import Scheduler
+from gie_tpu.sched.types import RequestBatch
+
+import jax.numpy as jnp
+
+_CRITICALITY_BY_NAME = {
+    "critical": C.Criticality.CRITICAL,
+    "standard": C.Criticality.STANDARD,
+    "sheddable": C.Criticality.SHEDDABLE,
+}
+
+
+class _Pending:
+    __slots__ = ("req", "candidates", "event", "result", "error")
+
+    def __init__(self, req: PickRequest, candidates: list):
+        self.req = req
+        self.candidates = candidates
+        self.event = threading.Event()
+        self.result: Optional[PickResult] = None
+        self.error: Optional[Exception] = None
+
+
+class BatchingTPUPicker:
+    """EndpointPicker backed by the batched Scheduler."""
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        datastore,
+        metrics_store,
+        *,
+        max_wait_s: float = 0.002,
+        max_batch: int = C.N_BUCKETS[-1],
+    ):
+        self.scheduler = scheduler
+        self.datastore = datastore
+        self.metrics_store = metrics_store
+        self.max_wait_s = max_wait_s
+        self.max_batch = max_batch
+        self._lora_ids: dict[str, int] = {}
+        self._pending: list[_Pending] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    # -- EndpointPicker interface -----------------------------------------
+
+    def pick(self, req: PickRequest, candidates: list) -> PickResult:
+        if not candidates:
+            # Strict subsetting / no ready endpoints (004 README:77-79).
+            raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "no endpoints available")
+        item = _Pending(req, candidates)
+        with self._cond:
+            if self._closed:
+                raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "picker shut down")
+            self._pending.append(item)
+            self._cond.notify()
+        item.event.wait()
+        if item.error is not None:
+            raise item.error
+        assert item.result is not None
+        return item.result
+
+    def observe_served(self, served_hostport: str, ctx) -> None:
+        """Served-endpoint feedback -> assumed-load release
+        (004 README:84-101)."""
+        ep = self.datastore.endpoint_by_hostport(served_hostport)
+        if ep is None:
+            return
+        cost = getattr(getattr(ctx, "pick_result", None), "assumed_cost", 1.0)
+        self.scheduler.complete(
+            np.asarray([ep.slot], np.int32), np.asarray([cost], np.float32)
+        )
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._worker.join(timeout=5)
+
+    # -- collector ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._pending:
+                    return
+                # Micro-batch window: collect stragglers before draining.
+                if len(self._pending) < self.max_batch:
+                    self._cond.wait(self.max_wait_s)
+                batch = self._pending[: self.max_batch]
+                self._pending = self._pending[self.max_batch :]
+            try:
+                self._run_batch(batch)
+            except Exception as e:  # propagate to all waiters
+                for item in batch:
+                    item.error = ExtProcError(
+                        grpc.StatusCode.INTERNAL, f"scheduler failure: {e}"
+                    )
+                    item.event.set()
+
+    def _lora_id(self, model: str) -> int:
+        if not model:
+            return -1
+        if model not in self._lora_ids:
+            self._lora_ids[model] = len(self._lora_ids) + 1
+        return self._lora_ids[model]
+
+    def _run_batch(self, batch: list[_Pending]) -> None:
+        n = len(batch)
+        prompts = [it.req.body or b"" for it in batch]
+        hashes, counts = batch_chunk_hashes(prompts)
+        lora = np.full((n,), -1, np.int32)
+        crit = np.full((n,), C.Criticality.STANDARD, np.int32)
+        plen = np.zeros((n,), np.float32)
+        mask = np.zeros((n, C.M_MAX), bool)
+        for i, it in enumerate(batch):
+            lora[i] = self._lora_id(it.req.model)
+            obj = it.req.headers.get(mdkeys.OBJECTIVE_KEY, [""])[0].lower()
+            crit[i] = _CRITICALITY_BY_NAME.get(obj, C.Criticality.STANDARD)
+            plen[i] = float(len(prompts[i]))
+            for ep in it.candidates:
+                if 0 <= ep.slot < C.M_MAX:
+                    mask[i, ep.slot] = True
+
+        reqs = RequestBatch(
+            valid=jnp.ones((n,), bool),
+            lora_id=jnp.asarray(lora),
+            criticality=jnp.asarray(crit),
+            prompt_len=jnp.asarray(plen),
+            decode_len=jnp.zeros((n,), jnp.float32),
+            chunk_hashes=jnp.asarray(hashes),
+            n_chunks=jnp.asarray(counts),
+            subset_mask=jnp.asarray(mask),
+            had_subset_hint=jnp.ones((n,), bool),
+        )
+        endpoints = self.datastore.endpoints()
+        eps = self.metrics_store.endpoint_batch(endpoints)
+        result = self.scheduler.pick(reqs, eps)
+
+        by_slot = {ep.slot: ep for ep in endpoints}
+        indices = np.asarray(result.indices)
+        status = np.asarray(result.status)
+        for i, item in enumerate(batch):
+            if status[i] == C.Status.SHED:
+                item.error = ShedError()
+            elif status[i] != C.Status.OK:
+                item.error = ExtProcError(
+                    grpc.StatusCode.UNAVAILABLE, "no endpoints available"
+                )
+            else:
+                picked = [
+                    by_slot[s].hostport
+                    for s in indices[i]
+                    if s >= 0 and s in by_slot
+                ]
+                if not picked:
+                    item.error = ExtProcError(
+                        grpc.StatusCode.UNAVAILABLE, "no endpoints available"
+                    )
+                else:
+                    res = PickResult(endpoint=picked[0], fallbacks=picked[1:])
+                    res.assumed_cost = float(
+                        np.clip(plen[i] / 2048.0, 0.25, 8.0)
+                    )
+                    item.result = res
+            item.event.set()

@@ -92,22 +92,27 @@ def scheduling_cycle(
     membership = filters.lora_membership(reqs, eps) if cfg.enable_lora else None
     if cfg.enable_lora:
         mask &= filters.lora_capacity_mask(reqs, eps, membership)
-    pre_saturation = mask
+    # Saturation is a soft filter (004 README:77-80 + 006 saturation
+    # semantics): when unsaturated candidates exist they are preferred; when
+    # ALL candidates are saturated, SHEDDABLE traffic is shed with 429 while
+    # STANDARD degrades to best-effort over the full candidate set (CRITICAL
+    # bypasses inside saturation_mask).
     if cfg.enable_saturation:
-        mask &= filters.saturation_mask(
+        sat_mask = mask & filters.saturation_mask(
             reqs, eps, queue_limit=cfg.queue_limit, kv_limit=cfg.kv_limit
         )
-
-    # Shedding: SHEDDABLE requests whose candidates exist but are all
-    # saturated get a 429 instead of best-effort queueing (004 README:80).
-    if cfg.shed_sheddable:
-        had_candidates = jnp.any(pre_saturation, axis=-1)
-        none_left = ~jnp.any(mask, axis=-1)
-        shed = (
-            (reqs.criticality == C.Criticality.SHEDDABLE)
-            & had_candidates
-            & none_left
-        )
+        had_candidates = jnp.any(mask, axis=-1)
+        any_unsaturated = jnp.any(sat_mask, axis=-1)
+        sheddable = reqs.criticality == C.Criticality.SHEDDABLE
+        if cfg.shed_sheddable:
+            shed = sheddable & had_candidates & ~any_unsaturated
+            # Sheddable keeps the hard filter (empty -> shed); others fall
+            # back to the unfiltered candidate set when all are saturated.
+            keep_hard = sheddable | any_unsaturated
+        else:
+            shed = jnp.zeros(reqs.valid.shape, bool)
+            keep_hard = any_unsaturated
+        mask = jnp.where(keep_hard[:, None], sat_mask, mask)
     else:
         shed = jnp.zeros(reqs.valid.shape, bool)
 

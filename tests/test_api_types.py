@@ -1,0 +1,98 @@
+"""API validation tests — the CEL/envtest tier analogue (reference
+test/cel/inferencepool_test.go:31-136)."""
+
+import pytest
+
+from gie_tpu.api import types as api
+
+
+def make_pool(**spec_kwargs) -> api.InferencePool:
+    spec = dict(
+        selector=api.LabelSelector(matchLabels={"app": "vllm"}),
+        targetPorts=[api.Port(8000)],
+        endpointPickerRef=api.EndpointPickerRef(
+            name="epp", port=api.Port(9002)
+        ),
+    )
+    spec.update(spec_kwargs)
+    return api.InferencePool(
+        metadata=api.ObjectMeta(name="pool", namespace="default"),
+        spec=api.InferencePoolSpec(**spec),
+    )
+
+
+def test_valid_pool_passes():
+    make_pool().validate()
+
+
+def test_target_ports_min_max():
+    with pytest.raises(api.ValidationError, match="1-8"):
+        make_pool(targetPorts=[]).validate()
+    with pytest.raises(api.ValidationError, match="1-8"):
+        make_pool(targetPorts=[api.Port(3000 + i) for i in range(9)]).validate()
+
+
+def test_target_ports_unique():
+    """CEL: port number must be unique (inferencepool_types.go:78)."""
+    with pytest.raises(api.ValidationError, match="unique"):
+        make_pool(targetPorts=[api.Port(8000), api.Port(8000)]).validate()
+
+
+def test_epp_port_required_for_service_kind():
+    """CEL: self.kind != 'Service' || has(self.port)
+    (inferencepool_types.go:128)."""
+    with pytest.raises(api.ValidationError, match="port is required"):
+        make_pool(
+            endpointPickerRef=api.EndpointPickerRef(name="epp")
+        ).validate()
+    # Non-Service kind without port is fine.
+    make_pool(
+        endpointPickerRef=api.EndpointPickerRef(
+            name="epp", kind="MyPicker", group="example.com"
+        )
+    ).validate()
+
+
+def test_epp_ref_optional():
+    """endpointPickerRef is optional at the API level (reference
+    InferencePoolMissingEPPRef conformance semantics)."""
+    make_pool(endpointPickerRef=None).validate()
+
+
+def test_failure_mode_enum():
+    with pytest.raises(api.ValidationError, match="FailOpen or FailClose"):
+        make_pool(
+            endpointPickerRef=api.EndpointPickerRef(
+                name="epp", port=api.Port(9002), failureMode="Bogus"
+            )
+        ).validate()
+
+
+def test_app_protocol_enum():
+    """Enum http / kubernetes.io/h2c (inferencepool_types.go:91)."""
+    make_pool(appProtocol=api.APP_PROTOCOL_H2C).validate()
+    with pytest.raises(api.ValidationError, match="appProtocol"):
+        make_pool(appProtocol="grpc").validate()
+
+
+def test_port_range():
+    with pytest.raises(api.ValidationError, match="1-65535"):
+        make_pool(targetPorts=[api.Port(0)]).validate()
+
+
+def test_roundtrip_dict():
+    pool = make_pool()
+    d = api.pool_to_dict(pool)
+    back = api.pool_from_dict(d)
+    assert back.spec.selector.matchLabels == {"app": "vllm"}
+    assert back.spec.targetPorts[0].number == 8000
+    assert back.spec.endpointPickerRef.port.number == 9002
+    assert back.spec.endpointPickerRef.failureMode == api.FAIL_CLOSE
+
+
+def test_parent_status_condition_replace():
+    ps = api.ParentStatus()
+    ps.set_condition(api.Condition(api.COND_ACCEPTED, "Unknown", api.REASON_PENDING))
+    ps.set_condition(api.Condition(api.COND_ACCEPTED, "True", api.REASON_ACCEPTED))
+    assert len(ps.conditions) == 1
+    assert ps.get_condition(api.COND_ACCEPTED).status == "True"

@@ -1,0 +1,235 @@
+"""Datastore + reconciler tests (reference datastore_test.go /
+pod_reconciler_test.go behavioral coverage + TPU slot-lifecycle additions)."""
+
+import threading
+
+import pytest
+
+from gie_tpu.api import types as api
+from gie_tpu.controller import (
+    FakeCluster,
+    InferencePoolReconciler,
+    PodReconciler,
+    RequeueAfter,
+)
+from gie_tpu.controller.reconcilers import wire
+from gie_tpu.datastore import Datastore, Pod, PoolNotSyncedError
+from gie_tpu.datastore.objects import EndpointPool
+from gie_tpu.utils.kubemeta import GKNN
+
+
+POOL = EndpointPool(
+    selector={"app": "vllm"}, target_ports=[8000, 8002], namespace="default"
+)
+
+
+def make_pod(name="p1", ip="10.0.0.1", labels=None, annotations=None, ready=True):
+    return Pod(
+        name=name,
+        namespace="default",
+        labels=labels if labels is not None else {"app": "vllm"},
+        annotations=annotations or {},
+        ip=ip,
+        ready=ready,
+    )
+
+
+def test_pool_required_before_pods():
+    ds = Datastore()
+    with pytest.raises(PoolNotSyncedError):
+        ds.pod_update_or_add(make_pod())
+    assert not ds.pool_has_synced()
+
+
+def test_rank_endpoints_per_target_port():
+    """One endpoint per (pod, rank) named <pod>-rank-<idx>
+    (reference datastore.go:329-334, DP-rank semantics SURVEY 2.10)."""
+    ds = Datastore()
+    ds.pool_set(POOL)
+    ds.pod_update_or_add(make_pod())
+    eps = ds.endpoints()
+    assert sorted(e.name for e in eps) == ["p1-rank-0", "p1-rank-1"]
+    assert sorted(e.port for e in eps) == [8000, 8002]
+    assert len({e.slot for e in eps}) == 2
+
+
+def test_active_ports_annotation_filters_ranks():
+    """reference datastore.go:307-325: comma-separated allowlist restricted
+    to pool targetPorts."""
+    ds = Datastore()
+    ds.pool_set(POOL)
+    ds.pod_update_or_add(
+        make_pod(annotations={api.ACTIVE_PORTS_ANNOTATION: " 8000 , 9999, x"})
+    )
+    eps = ds.endpoints()
+    assert [e.port for e in eps] == [8000]
+    # Annotation change re-activates the other rank.
+    ds.pod_update_or_add(
+        make_pod(annotations={api.ACTIVE_PORTS_ANNOTATION: "8000,8002"})
+    )
+    assert len(ds.endpoints()) == 2
+
+
+def test_slot_reclaim_callback_on_delete():
+    reclaimed = []
+    ds = Datastore(on_slot_reclaimed=reclaimed.append)
+    ds.pool_set(POOL)
+    ds.pod_update_or_add(make_pod())
+    slots = {e.slot for e in ds.endpoints()}
+    ds.pod_delete("default", "p1")
+    assert set(reclaimed) == slots
+    assert ds.endpoints() == []
+
+
+def test_slot_reuse_is_lowest_first_and_stable():
+    ds = Datastore()
+    ds.pool_set(POOL)
+    for i in range(3):
+        ds.pod_update_or_add(make_pod(name=f"p{i}", ip=f"10.0.0.{i}"))
+    assert {e.slot for e in ds.endpoints()} == set(range(6))
+    ds.pod_delete("default", "p0")
+    ds.pod_update_or_add(make_pod(name="p9", ip="10.0.0.9"))
+    # Freed slots are reused before new ones.
+    assert {e.slot for e in ds.endpoints()} == set(range(6))
+    # Existing endpoints kept their slots.
+    p1_slots = {e.slot for e in ds.endpoints() if e.pod_name == "p1"}
+    ds.pod_update_or_add(make_pod(name="p1", ip="10.0.0.42"))
+    assert {e.slot for e in ds.endpoints() if e.pod_name == "p1"} == p1_slots
+
+
+def test_pool_change_triggers_resync():
+    """Selector change must evict pods that no longer match (reference
+    datastore.go:131-147 podResyncAll)."""
+    ds = Datastore()
+    pods = [
+        make_pod(name="a", labels={"app": "vllm"}),
+        make_pod(name="b", ip="10.0.0.2", labels={"app": "other"}),
+    ]
+    ds.pool_set(POOL, pod_lister=lambda: pods)
+    assert {e.pod_name for e in ds.endpoints()} == {"a"}
+    new_pool = EndpointPool(
+        selector={"app": "other"}, target_ports=[8000, 8002], namespace="default"
+    )
+    ds.pool_set(new_pool, pod_lister=lambda: pods)
+    assert {e.pod_name for e in ds.endpoints()} == {"b"}
+
+
+def test_target_port_change_resync():
+    ds = Datastore()
+    pods = [make_pod()]
+    ds.pool_set(POOL, pod_lister=lambda: pods)
+    assert len(ds.endpoints()) == 2
+    ds.pool_set(
+        EndpointPool(selector={"app": "vllm"}, target_ports=[8000],
+                     namespace="default"),
+        pod_lister=lambda: pods,
+    )
+    assert [e.port for e in ds.endpoints()] == [8000]
+
+
+def test_clear_frees_everything():
+    ds = Datastore()
+    ds.pool_set(POOL)
+    ds.pod_update_or_add(make_pod())
+    ds.clear()
+    assert not ds.pool_has_synced()
+    assert ds.endpoints() == []
+
+
+def test_concurrent_writes_no_deadlock():
+    """reference datastore_test.go:61,867 concurrency coverage."""
+    ds = Datastore()
+    ds.pool_set(POOL)
+    errs = []
+
+    def writer(i):
+        try:
+            for j in range(20):
+                ds.pod_update_or_add(make_pod(name=f"p{i}", ip=f"10.0.{i}.{j}"))
+                ds.endpoints()
+                ds.pool_set(POOL, pod_lister=lambda: [])
+                ds.pool_set(POOL)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs
+
+
+# ---------------------------------------------------------------------------
+# Reconcilers over FakeCluster
+# ---------------------------------------------------------------------------
+
+
+def make_api_pool(selector=None, ports=(8000, 8002)) -> api.InferencePool:
+    return api.InferencePool(
+        metadata=api.ObjectMeta(name="pool", namespace="default"),
+        spec=api.InferencePoolSpec(
+            selector=api.LabelSelector(matchLabels=selector or {"app": "vllm"}),
+            targetPorts=[api.Port(p) for p in ports],
+            endpointPickerRef=api.EndpointPickerRef(name="epp", port=api.Port(9002)),
+        ),
+    )
+
+
+def setup_wired():
+    cluster = FakeCluster()
+    ds = Datastore()
+    gknn = GKNN(api.GROUP, "InferencePool", "default", "pool")
+    wire(
+        cluster,
+        InferencePoolReconciler(cluster, ds, gknn),
+        PodReconciler(cluster, ds),
+    )
+    return cluster, ds
+
+
+def test_reconcile_flow_end_to_end():
+    cluster, ds = setup_wired()
+    cluster.apply_pool(make_api_pool())
+    assert ds.pool_has_synced()
+    cluster.apply_pod(make_pod())
+    assert len(ds.endpoints()) == 2
+    # Pod goes unready -> evicted (pod_reconciler.go:90-102).
+    cluster.apply_pod(make_pod(ready=False))
+    assert ds.endpoints() == []
+    cluster.apply_pod(make_pod())
+    cluster.delete_pod("default", "p1")
+    assert ds.endpoints() == []
+
+
+def test_pod_before_pool_requeues():
+    cluster = FakeCluster()
+    ds = Datastore()
+    pr = PodReconciler(cluster, ds)
+    cluster.apply_pod(make_pod())
+    res = pr.reconcile("default", "p1")
+    assert isinstance(res, RequeueAfter) and res.seconds == 5.0
+
+
+def test_pool_delete_clears_datastore():
+    cluster, ds = setup_wired()
+    cluster.apply_pool(make_api_pool())
+    cluster.apply_pod(make_pod())
+    cluster.delete_pool("default", "pool")
+    assert not ds.pool_has_synced()
+    assert ds.endpoints() == []
+
+
+def test_other_pool_identity_ignored():
+    """Scoped cache: only the configured pool name/namespace is consumed
+    (reference controller_manager.go:45-68)."""
+    cluster, ds = setup_wired()
+    other = make_api_pool()
+    other.metadata.name = "other-pool"
+    cluster.apply_pool(other)
+    assert not ds.pool_has_synced()
+
+
+def test_nonmatching_pod_labels_not_admitted():
+    cluster, ds = setup_wired()
+    cluster.apply_pool(make_api_pool())
+    cluster.apply_pod(make_pod(labels={"app": "nope"}))
+    assert ds.endpoints() == []

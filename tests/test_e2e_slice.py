@@ -1,0 +1,163 @@
+"""The minimum end-to-end slice (SURVEY.md section 7.3): ext-proc stream ->
+StreamingServer -> BatchingTPUPicker -> batched Scheduler on the
+virtual mesh -> destination header mutation, with live-ish metrics."""
+
+import threading
+
+import grpc
+import numpy as np
+import pytest
+
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool
+from gie_tpu.extproc import StreamingServer, metadata as mdkeys, pb
+from gie_tpu.extproc.server import ExtProcError, ShedError
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.sched import Criticality, Metric, ProfileConfig, Scheduler
+from gie_tpu.sched.batching import BatchingTPUPicker
+from tests.test_datastore import make_pod
+from tests.test_extproc import FakeStream, body_msg, dest_header, headers_msg
+
+
+@pytest.fixture
+def stack():
+    sched = Scheduler(ProfileConfig())
+    ms = MetricsStore()
+
+    def reclaimed(slot):
+        sched.evict_endpoint(slot)
+        ms.remove(slot)
+
+    ds = Datastore(on_slot_reclaimed=reclaimed)
+    ds.pool_set(
+        EndpointPool(selector={"app": "vllm"}, target_ports=[8000],
+                     namespace="default")
+    )
+    for i in range(4):
+        ds.pod_update_or_add(make_pod(name=f"p{i}", ip=f"10.0.0.{i}"))
+    picker = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.005)
+    srv = StreamingServer(ds, picker, on_served=picker.observe_served)
+    yield srv, ds, ms, sched, picker
+    picker.close()
+
+
+def run_request(srv, prompt=b"", headers=None, metadata_struct=None):
+    msgs = [headers_msg(headers=headers, end_of_stream=not prompt,
+                        metadata_struct=metadata_struct)]
+    if prompt:
+        msgs.append(body_msg(prompt, end_of_stream=True))
+    stream = FakeStream(msgs)
+    srv.process(stream)
+    return stream
+
+
+def test_least_loaded_pick_from_metrics(stack):
+    srv, ds, ms, _, _ = stack
+    slots = {e.address: e.slot for e in ds.endpoints()}
+    ms.update(slots["10.0.0.0"], {Metric.QUEUE_DEPTH: 20, Metric.KV_CACHE_UTIL: 0.9})
+    ms.update(slots["10.0.0.1"], {Metric.QUEUE_DEPTH: 0, Metric.KV_CACHE_UTIL: 0.1})
+    ms.update(slots["10.0.0.2"], {Metric.QUEUE_DEPTH: 15, Metric.KV_CACHE_UTIL: 0.8})
+    ms.update(slots["10.0.0.3"], {Metric.QUEUE_DEPTH: 18, Metric.KV_CACHE_UTIL: 0.85})
+    stream = run_request(srv, prompt=b"hello " * 100)
+    dest = dest_header(stream.sent[0])
+    assert dest.startswith("10.0.0.1:")
+
+
+def test_concurrent_streams_batched(stack):
+    """Many concurrent ext-proc streams must be served by shared scheduling
+    cycles and all land on valid endpoints."""
+    srv, ds, *_ = stack
+    results, errs = [], []
+
+    def one(i):
+        try:
+            stream = run_request(srv, prompt=b"req %d " % i * 30)
+            results.append(dest_header(stream.sent[0]))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(32)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs
+    valid = {e.hostport for e in ds.endpoints()}
+    assert all(r.split(",")[0] in valid for r in results)
+
+
+def test_prefix_affinity_through_full_stack(stack):
+    srv, *_ = stack
+    sys_prompt = b"SYSTEM: terse assistant. " * 40
+    first = dest_header(run_request(srv, prompt=sys_prompt + b"q1").sent[0])
+    again = dest_header(run_request(srv, prompt=sys_prompt + b"q2").sent[0])
+    assert first.split(",")[0] == again.split(",")[0]
+
+
+def test_fallback_list_in_header(stack):
+    """Comma-separated ordered fallback list in the destination header
+    (004 README:50-82)."""
+    srv, ds, *_ = stack
+    stream = run_request(srv, prompt=b"x" * 200)
+    parts = dest_header(stream.sent[0]).split(",")
+    assert len(parts) >= 2
+    valid = {e.hostport for e in ds.endpoints()}
+    assert all(p in valid for p in parts)
+    assert len(set(parts)) == len(parts)
+
+
+def test_sheddable_429_immediate_response(stack):
+    srv, ds, ms, *_ = stack
+    for e in ds.endpoints():
+        ms.update(e.slot, {Metric.QUEUE_DEPTH: 500, Metric.KV_CACHE_UTIL: 0.99})
+    stream = run_request(
+        srv,
+        prompt=b"shed me",
+        headers={mdkeys.OBJECTIVE_KEY: "sheddable"},
+    )
+    # ImmediateResponse 429 (004 README:80).
+    kinds = [r.WhichOneof("response") for r in stream.sent]
+    assert kinds == ["immediate_response"]
+    assert stream.sent[0].immediate_response.status_code == 429
+
+
+def test_critical_served_even_saturated(stack):
+    srv, ds, ms, *_ = stack
+    for e in ds.endpoints():
+        ms.update(e.slot, {Metric.QUEUE_DEPTH: 500, Metric.KV_CACHE_UTIL: 0.99})
+    stream = run_request(
+        srv, prompt=b"vip", headers={mdkeys.OBJECTIVE_KEY: "critical"}
+    )
+    assert dest_header(stream.sent[0]) is not None
+
+
+def test_served_feedback_drains_assumed_load(stack):
+    srv, ds, ms, sched, _ = stack
+    stream = run_request(srv, prompt=b"y" * 4096)
+    dest = dest_header(stream.sent[0]).split(",")[0]
+    before = sched.snapshot_assumed_load().sum()
+    assert before > 0
+    served = pb.ProcessingRequest(response_headers=pb.HttpHeaders())
+    from google.protobuf import struct_pb2
+
+    st = struct_pb2.Struct()
+    st.fields[mdkeys.DESTINATION_ENDPOINT_SERVED_KEY].string_value = dest
+    served.metadata_context.filter_metadata[
+        mdkeys.DESTINATION_ENDPOINT_NAMESPACE
+    ].CopyFrom(st)
+    s2 = FakeStream([headers_msg(), served])
+    srv.process(s2)
+    assert sched.snapshot_assumed_load().sum() < before
+
+
+def test_pod_churn_mid_traffic(stack):
+    """Endpoint slot reuse mid-traffic must not leak stale picks."""
+    srv, ds, ms, sched, _ = stack
+    run_request(srv, prompt=b"warm")
+    ds.pod_delete("default", "p0")
+    stream = run_request(srv, prompt=b"after churn")
+    dest = dest_header(stream.sent[0])
+    assert not dest.split(",")[0].startswith("10.0.0.0:")
+    ds.pod_update_or_add(make_pod(name="p9", ip="10.0.0.9"))
+    stream = run_request(
+        srv, headers={mdkeys.TEST_ENDPOINT_SELECTION_HEADER: "10.0.0.9"}
+    )
+    assert dest_header(stream.sent[0]) == "10.0.0.9:8000"

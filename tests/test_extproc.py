@@ -1,0 +1,317 @@
+"""ext-proc protocol tests.
+
+In-memory stream tier mirrors reference handlers tests
+(mockProcessServer pattern, server_test.go:33-59; subset variants,
+request_test.go:50-551); the gRPC tier runs the real service end-to-end over
+localhost — the transport the data plane actually uses.
+"""
+
+import threading
+
+import grpc
+import pytest
+from google.protobuf import struct_pb2
+
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool
+from gie_tpu.extproc import (
+    RoundRobinPicker,
+    StreamingServer,
+    metadata as mdkeys,
+    pb,
+)
+from gie_tpu.extproc.envoy import (
+    BODY_BYTE_LIMIT,
+    build_chunked_body_responses,
+    extract_header_value,
+)
+from gie_tpu.extproc.server import ExtProcError, MAX_REQUEST_BODY_SIZE
+from tests.test_datastore import make_pod  # reuse builders
+
+
+POOL = EndpointPool(selector={"app": "vllm"}, target_ports=[8000], namespace="default")
+
+
+class FakeStream:
+    """Scripted bidirectional stream (reference mockProcessServer)."""
+
+    def __init__(self, messages):
+        self.messages = list(messages)
+        self.sent = []
+
+    def recv(self):
+        return self.messages.pop(0) if self.messages else None
+
+    def send(self, resp):
+        self.sent.append(resp)
+
+
+def make_ds(n=3):
+    ds = Datastore()
+    ds.pool_set(POOL)
+    for i in range(n):
+        ds.pod_update_or_add(make_pod(name=f"p{i}", ip=f"10.0.0.{i}"))
+    return ds
+
+
+def headers_msg(headers=None, end_of_stream=True, metadata_struct=None):
+    hm = pb.HeaderMap()
+    for k, v in (headers or {}).items():
+        hm.headers.append(pb.HeaderValue(key=k, raw_value=v.encode()))
+    req = pb.ProcessingRequest(
+        request_headers=pb.HttpHeaders(headers=hm, end_of_stream=end_of_stream)
+    )
+    if metadata_struct:
+        for ns, fields in metadata_struct.items():
+            st = struct_pb2.Struct()
+            for fk, fv in fields.items():
+                if isinstance(fv, list):
+                    st.fields[fk].list_value.values.extend(
+                        [struct_pb2.Value(string_value=x) for x in fv]
+                    )
+                else:
+                    st.fields[fk].string_value = fv
+            req.metadata_context.filter_metadata[ns].CopyFrom(st)
+    return req
+
+
+def body_msg(data=b"", end_of_stream=True):
+    return pb.ProcessingRequest(
+        request_body=pb.HttpBody(body=data, end_of_stream=end_of_stream)
+    )
+
+
+def dest_header(resp):
+    mut = resp.request_headers.response.header_mutation
+    for opt in mut.set_headers:
+        if opt.header.key == mdkeys.DESTINATION_ENDPOINT_KEY:
+            return opt.header.raw_value.decode()
+    return None
+
+
+def test_headers_only_request_round_robin():
+    srv = StreamingServer(make_ds(), RoundRobinPicker())
+    stream = FakeStream([headers_msg()])
+    srv.process(stream)
+    assert len(stream.sent) == 1
+    resp = stream.sent[0]
+    dest = dest_header(resp)
+    assert dest in {f"10.0.0.{i}:8000" for i in range(3)}
+    assert resp.request_headers.response.clear_route_cache
+    # Dual signal: dynamic metadata must agree with the header (004 README:46-82).
+    md = resp.dynamic_metadata.fields[mdkeys.DESTINATION_ENDPOINT_NAMESPACE]
+    assert (
+        md.struct_value.fields[mdkeys.DESTINATION_ENDPOINT_KEY].string_value == dest
+    )
+
+
+def test_round_robin_rotates():
+    srv = StreamingServer(make_ds(), RoundRobinPicker())
+    seen = set()
+    for _ in range(6):
+        stream = FakeStream([headers_msg()])
+        srv.process(stream)
+        seen.add(dest_header(stream.sent[0]))
+    assert len(seen) == 3
+
+
+def test_body_defers_headers_response():
+    """Headers without end_of_stream defer the pick until the body completes
+    (reference server.go:183,200-258)."""
+    srv = StreamingServer(make_ds(), RoundRobinPicker())
+    stream = FakeStream(
+        [
+            headers_msg(end_of_stream=False),
+            body_msg(b"part1", end_of_stream=False),
+            body_msg(b"part2", end_of_stream=True),
+        ]
+    )
+    srv.process(stream)
+    kinds = [r.WhichOneof("response") for r in stream.sent]
+    assert kinds == ["request_headers", "request_body"]
+    assert dest_header(stream.sent[0]) is not None
+
+
+def test_subset_metadata_string_form():
+    srv = StreamingServer(make_ds(), RoundRobinPicker())
+    md = {
+        mdkeys.SUBSET_FILTER_NAMESPACE: {
+            mdkeys.SUBSET_FILTER_KEY: " 10.0.0.1 , 10.0.0.2"
+        }
+    }
+    for _ in range(4):
+        stream = FakeStream([headers_msg(metadata_struct=md)])
+        srv.process(stream)
+        assert dest_header(stream.sent[0]).rsplit(":", 1)[0] in {
+            "10.0.0.1",
+            "10.0.0.2",
+        }
+
+
+def test_subset_metadata_array_form():
+    srv = StreamingServer(make_ds(), RoundRobinPicker())
+    md = {
+        mdkeys.SUBSET_FILTER_NAMESPACE: {
+            mdkeys.SUBSET_FILTER_KEY: ["10.0.0.0", "10.0.0.2"]
+        }
+    }
+    for _ in range(4):
+        stream = FakeStream([headers_msg(metadata_struct=md)])
+        srv.process(stream)
+        assert dest_header(stream.sent[0]).rsplit(":", 1)[0] in {
+            "10.0.0.0",
+            "10.0.0.2",
+        }
+
+
+def test_subset_with_ports_filters_exact_endpoint():
+    ds = Datastore()
+    ds.pool_set(
+        EndpointPool(selector={"app": "vllm"}, target_ports=[8000, 8002],
+                     namespace="default")
+    )
+    ds.pod_update_or_add(make_pod(name="p0", ip="10.0.0.0"))
+    srv = StreamingServer(ds, RoundRobinPicker())
+    md = {
+        mdkeys.SUBSET_FILTER_NAMESPACE: {mdkeys.SUBSET_FILTER_KEY: "10.0.0.0:8002"}
+    }
+    stream = FakeStream([headers_msg(metadata_struct=md)])
+    srv.process(stream)
+    assert dest_header(stream.sent[0]) == "10.0.0.0:8002"
+
+
+def test_strict_empty_subset_unavailable():
+    """Explicit subset matching nothing -> UNAVAILABLE, never fail-open
+    (reference request.go:130-133)."""
+    srv = StreamingServer(make_ds(), RoundRobinPicker())
+    md = {mdkeys.SUBSET_FILTER_NAMESPACE: {mdkeys.SUBSET_FILTER_KEY: "10.9.9.9"}}
+    with pytest.raises(ExtProcError) as ei:
+        srv.process(FakeStream([headers_msg(metadata_struct=md)]))
+    assert ei.value.code == grpc.StatusCode.UNAVAILABLE
+
+
+def test_no_pods_unavailable():
+    ds = Datastore()
+    ds.pool_set(POOL)
+    srv = StreamingServer(ds, RoundRobinPicker())
+    with pytest.raises(ExtProcError) as ei:
+        srv.process(FakeStream([headers_msg()]))
+    assert ei.value.code == grpc.StatusCode.UNAVAILABLE
+    assert "no pods available" in ei.value.message
+
+
+def test_test_steering_header_priority():
+    """test-epp-endpoint-selection overrides metadata subsetting
+    (reference request.go:84-97)."""
+    srv = StreamingServer(make_ds(), RoundRobinPicker())
+    md = {mdkeys.SUBSET_FILTER_NAMESPACE: {mdkeys.SUBSET_FILTER_KEY: "10.0.0.1"}}
+    stream = FakeStream(
+        [
+            headers_msg(
+                headers={mdkeys.TEST_ENDPOINT_SELECTION_HEADER: "10.0.0.2"},
+                metadata_struct=md,
+            )
+        ]
+    )
+    srv.process(stream)
+    assert dest_header(stream.sent[0]) == "10.0.0.2:8000"
+
+
+def test_body_size_cap():
+    srv = StreamingServer(make_ds(), RoundRobinPicker())
+    big = b"x" * (MAX_REQUEST_BODY_SIZE // 2 + 1)
+    with pytest.raises(ExtProcError) as ei:
+        srv.process(
+            FakeStream(
+                [
+                    headers_msg(end_of_stream=False),
+                    body_msg(big, end_of_stream=False),
+                    body_msg(big, end_of_stream=False),
+                ]
+            )
+        )
+    assert ei.value.code == grpc.StatusCode.RESOURCE_EXHAUSTED
+
+
+def test_response_headers_served_endpoint_echo():
+    """Served-endpoint feedback loop (004 README:84-101; reference
+    response.go:30-92)."""
+    served = []
+    srv = StreamingServer(
+        make_ds(), RoundRobinPicker(), on_served=lambda ep, ctx: served.append(ep)
+    )
+    req = pb.ProcessingRequest(response_headers=pb.HttpHeaders())
+    st = struct_pb2.Struct()
+    st.fields[mdkeys.DESTINATION_ENDPOINT_SERVED_KEY].string_value = "10.0.0.1:8000"
+    req.metadata_context.filter_metadata[
+        mdkeys.DESTINATION_ENDPOINT_NAMESPACE
+    ].CopyFrom(st)
+    stream = FakeStream([headers_msg(), req])
+    srv.process(stream)
+    assert served == ["10.0.0.1:8000"]
+    mut = stream.sent[1].response_headers.response.header_mutation
+    echoed = {
+        o.header.key: o.header.raw_value.decode() for o in mut.set_headers
+    }
+    assert echoed[mdkeys.CONFORMANCE_TEST_RESULT_HEADER] == "10.0.0.1:8000"
+    assert echoed[mdkeys.WENT_INTO_RESP_HEADERS] == "true"
+
+
+def test_response_body_passthrough():
+    srv = StreamingServer(make_ds(), RoundRobinPicker())
+    stream = FakeStream(
+        [headers_msg(), pb.ProcessingRequest(response_body=pb.HttpBody())]
+    )
+    srv.process(stream)
+    assert stream.sent[1].WhichOneof("response") == "response_body"
+
+
+def test_chunked_body_responses():
+    """62 KB chunk framing (reference chunking.go:26-74)."""
+    body = b"a" * (BODY_BYTE_LIMIT * 2 + 100)
+    responses = build_chunked_body_responses(body, request_path=True)
+    assert len(responses) == 3
+    sizes = [len(r.request_body.response.body_mutation.body) for r in responses]
+    assert sizes == [BODY_BYTE_LIMIT, BODY_BYTE_LIMIT, 100]
+    assert all(
+        r.request_body.response.status == pb.CommonResponse.CONTINUE_AND_REPLACE
+        for r in responses
+    )
+
+
+# ---------------------------------------------------------------------------
+# Real gRPC transport
+# ---------------------------------------------------------------------------
+
+
+def test_grpc_end_to_end():
+    from concurrent import futures
+
+    from gie_tpu.extproc.service import SERVICE_NAME, add_extproc_service
+
+    srv = StreamingServer(make_ds(), RoundRobinPicker())
+    gserver = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    add_extproc_service(gserver, srv)
+    port = gserver.add_insecure_port("127.0.0.1:0")
+    gserver.start()
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        method = channel.stream_stream(
+            f"/{SERVICE_NAME}/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString,
+        )
+        responses = list(method(iter([headers_msg()])))
+        assert len(responses) == 1
+        assert dest_header(responses[0])
+
+        # Error path: strict empty subset -> UNAVAILABLE over the wire.
+        md = {
+            mdkeys.SUBSET_FILTER_NAMESPACE: {mdkeys.SUBSET_FILTER_KEY: "1.2.3.4"}
+        }
+        with pytest.raises(grpc.RpcError) as ei:
+            list(method(iter([headers_msg(metadata_struct=md)])))
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        channel.close()
+    finally:
+        gserver.stop(0)

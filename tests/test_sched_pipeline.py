@@ -254,3 +254,23 @@ def test_evict_endpoint_clears_prefix_affinity():
     queue2[other_best] = 0.0
     res2 = sched.pick(make_requests(1, prompts=[prompt + b"b"]), make_endpoints(4, queue=queue2))
     assert int(res2.indices[0, 0]) == other_best
+
+
+def test_standard_degrades_best_effort_when_all_saturated():
+    """STANDARD traffic must not 503 on a fully saturated pool — it degrades
+    to best-effort while SHEDDABLE sheds (004 README:77-80)."""
+    cfg = ProfileConfig(queue_limit=10, kv_limit=0.9)
+    sched = Scheduler(cfg)
+    eps = make_endpoints(3, queue=[50, 40, 60], kv=[0.99, 0.95, 0.99])
+    res = sched.pick(make_requests(1, criticality=[Criticality.STANDARD]), eps)
+    assert res.status[0] == Status.OK
+    assert res.indices[0, 0] == 1  # least loaded of the saturated set
+
+
+def test_shed_disabled_sheddable_degrades_like_standard():
+    cfg = ProfileConfig(queue_limit=10, kv_limit=0.9, shed_sheddable=False)
+    sched = Scheduler(cfg)
+    eps = make_endpoints(2, queue=[50, 40], kv=[0.99, 0.95])
+    res = sched.pick(make_requests(1, criticality=[Criticality.SHEDDABLE]), eps)
+    assert res.status[0] == Status.OK
+    assert res.indices[0, 0] == 1
