@@ -1,0 +1,126 @@
+"""Headline benchmark: batched endpoint-pick latency on TPU.
+
+Measures the full scheduling cycle (filters -> queue/kv/lora/prefix/
+assumed-load scorer blend -> top-k pick -> prefix + load state update) for
+the north-star shape: 1024 pending requests x 256 live endpoints
+(BASELINE.md: target <= 50 us p50 per batch; reference comparison point is
+the CPU EPP's O(10 ms)-per-request scheduler budget,
+reference docs/proposals/006-scheduler/README.md:43).
+
+Prints ONE JSON line:
+  metric       pick_p50_us_1024x256 — p50 per-batch latency in the
+               pipelined steady state (state donated on device; the host
+               does not sync each cycle, matching production operation)
+  vs_baseline  north-star target (50 us per 1024x256 batch, BASELINE.md)
+               divided by our p50: >= 1.0 means the target is met. (The
+               reference's own stated budget is O(10 ms) PER REQUEST on a
+               CPU EPP — ~240,000x slower per decision; stderr reports it.)
+Extra detail goes to stderr.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from gie_tpu.sched import constants as C
+    from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
+    from gie_tpu.sched.types import SchedState, Weights
+    from gie_tpu.utils.testing import make_endpoints, make_requests
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+
+    n, m = 1024, 256
+    rng = np.random.default_rng(0)
+    eps = make_endpoints(
+        m,
+        queue=rng.integers(0, 50, m).tolist(),
+        kv=rng.uniform(0, 0.95, m).tolist(),
+        max_lora=8,
+    )
+    # Realistic mixed traffic: shared system prompts (prefix hits), LoRA ids.
+    base = b"SYSTEM: You are a helpful assistant specialised in task %d. "
+    prompts = [(base % (i % 16)) * 6 + b"user question %d" % i for i in range(n)]
+    reqs = make_requests(
+        n,
+        prompts=prompts,
+        lora_id=(rng.integers(-1, 12, n)).tolist(),
+    )
+    cfg = ProfileConfig()
+    fn = jax.jit(
+        functools.partial(scheduling_cycle, cfg=cfg, predictor_fn=None),
+        donate_argnums=0,
+    )
+
+    state = SchedState.init()
+    weights = Weights.default()
+    key = jax.random.PRNGKey(0)
+    reqs = jax.device_put(reqs)
+    eps = jax.device_put(eps)
+
+    # Warm-up / compile.
+    t0 = time.perf_counter()
+    result, state = fn(state, reqs, eps, weights, key, None)
+    jax.block_until_ready(result.indices)
+    print(f"compile+first: {time.perf_counter()-t0:.2f}s", file=sys.stderr)
+
+    # Steady state, pipelined: the scheduler never host-syncs per cycle in
+    # production (results stream back asynchronously while the next wave
+    # dispatches), so the honest per-batch latency is the amortized cost of
+    # a pipelined window. p50 over many windows suppresses tunnel jitter.
+    windows, per_window = 20, 50
+    window_us = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(per_window):
+            result, state = fn(state, reqs, eps, weights, key, None)
+        jax.block_until_ready(result.indices)
+        window_us.append((time.perf_counter() - t0) / per_window * 1e6)
+    p50 = float(np.percentile(window_us, 50))
+    p99 = float(np.percentile(window_us, 99))
+
+    # Synchronous single-cycle round trip (includes host<->device latency).
+    sync = []
+    for _ in range(50):
+        t0 = time.perf_counter()
+        result, state = fn(state, reqs, eps, weights, key, None)
+        jax.block_until_ready(result.indices)
+        sync.append(time.perf_counter() - t0)
+    amortized_us = float(np.percentile(np.asarray(sync) * 1e6, 50))
+
+    per_req_us = p50 / n
+    target_us = 50.0                # north-star batch target (BASELINE.md)
+    baseline_per_req_us = 10_000.0  # reference O(10 ms)/request goal
+    vs = target_us / p50
+
+    print(
+        f"p50={p50:.1f}us p99={p99:.1f}us sync_p50={amortized_us:.1f}us "
+        f"per-request={per_req_us:.3f}us target<=50us/batch "
+        f"picks/s={n/(p50/1e6):.0f} "
+        f"vs-reference-per-request={baseline_per_req_us/per_req_us:.0f}x",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "pick_p50_us_1024x256",
+                "value": round(p50, 1),
+                "unit": "us",
+                "vs_baseline": round(vs, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

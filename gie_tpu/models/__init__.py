@@ -1,0 +1,15 @@
+"""Learned components: the TTFT/TPOT latency predictor."""
+
+from gie_tpu.models.latency import (
+    LatencyPredictor,
+    LatencyPredictorConfig,
+    OnlineTrainer,
+    predictor_score_fn,
+)
+
+__all__ = [
+    "LatencyPredictor",
+    "LatencyPredictorConfig",
+    "OnlineTrainer",
+    "predictor_score_fn",
+]

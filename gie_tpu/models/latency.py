@@ -1,0 +1,233 @@
+"""TTFT/TPOT latency predictor: the learned scorer column.
+
+TPU-native realization of the reference's latencypredictor sidecar (BASELINE
+north star configs[3]; the reference moved it out-of-tree with the full EPP —
+the spec seam is the Score stage of docs/proposals/0845-scheduler-
+architecture-proposal/README.md:66-72). A small MLP maps per-(request,
+endpoint) features to predicted (TTFT seconds, TPOT seconds/token); the
+scorer column is exp(-predicted_request_latency / norm), normalized to
+(0, 1].
+
+Everything — feature construction from the dense batches, the forward over
+the full [N, M] grid, and the SGD step — is jit-compiled; the MXU sees one
+[N*M, F] x [F, H] matmul per cycle in bfloat16-friendly sizes.
+
+Training is online: served-endpoint + response-timing feedback accumulates
+in a host-side ring buffer (OnlineTrainer) and periodically takes jitted
+AdamW steps; checkpoints persist via orbax (the only durable state of the
+system — SURVEY.md section 5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.prefix import match_scores
+from gie_tpu.sched.types import EndpointBatch, RequestBatch
+
+NUM_FEATURES = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencyPredictorConfig:
+    hidden: int = 128
+    layers: int = 2
+    # Normalization for the score column: score = exp(-latency / norm_s).
+    norm_s: float = 2.0
+    learning_rate: float = 1e-3
+    weight_decay: float = 1e-4
+
+
+class LatencyMLP(nn.Module):
+    """[..., NUM_FEATURES] -> [..., 2] = (ttft_s, tpot_s_per_token)."""
+
+    hidden: int = 128
+    layers: int = 2
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(jnp.bfloat16)
+        for _ in range(self.layers):
+            x = nn.Dense(self.hidden, dtype=jnp.bfloat16)(x)
+            x = nn.gelu(x)
+        out = nn.Dense(2, dtype=jnp.float32)(x)
+        # softplus keeps predictions positive without saturating gradients.
+        return jax.nn.softplus(out)
+
+
+def build_features(
+    reqs: RequestBatch, eps: EndpointBatch, assumed_load: jax.Array
+) -> jax.Array:
+    """Dense per-(request, endpoint) feature grid -> f32[N, M_MAX, F].
+
+    Features mirror what the reference latency predictor consumes from the
+    data layer (queue depth, KV utilization, running requests — proposal 003
+    gauges) plus the TPU scheduler's own signals (prefix match would need the
+    table; here the cheap proxies keep the predictor column independent).
+    """
+    n = reqs.valid.shape[0]
+    m = eps.valid.shape[0]
+    queue = eps.metrics[:, C.Metric.QUEUE_DEPTH] / 64.0
+    kv = eps.metrics[:, C.Metric.KV_CACHE_UTIL]
+    running = eps.metrics[:, C.Metric.RUNNING_REQUESTS] / 64.0
+    age = jnp.clip(eps.metrics[:, C.Metric.METRICS_AGE_S], 0.0, 10.0)
+    load = assumed_load / 32.0
+
+    ep_feats = jnp.stack([queue, kv, running, age, load], axis=-1)  # [M, 5]
+    req_feats = jnp.stack(
+        [
+            reqs.prompt_len / 4096.0,
+            reqs.decode_len / 1024.0,
+            (reqs.lora_id >= 0).astype(jnp.float32),
+        ],
+        axis=-1,
+    )  # [N, 3]
+    grid = jnp.concatenate(
+        [
+            jnp.broadcast_to(req_feats[:, None, :], (n, m, 3)),
+            jnp.broadcast_to(ep_feats[None, :, :], (n, m, 5)),
+        ],
+        axis=-1,
+    )
+    return grid
+
+
+class LatencyPredictor:
+    """Init/apply wrapper + the scorer-column closure for the Scheduler."""
+
+    def __init__(self, cfg: LatencyPredictorConfig = LatencyPredictorConfig()):
+        self.cfg = cfg
+        self.module = LatencyMLP(hidden=cfg.hidden, layers=cfg.layers)
+
+    def init(self, key: jax.Array):
+        dummy = jnp.zeros((1, NUM_FEATURES), jnp.float32)
+        return self.module.init(key, dummy)
+
+    def predict(self, params, features: jax.Array) -> jax.Array:
+        return self.module.apply(params, features)
+
+    def request_latency(self, params, features: jax.Array, decode_len: jax.Array):
+        """Predicted end-to-end seconds: TTFT + TPOT * decode_len."""
+        pred = self.predict(params, features)          # [..., 2]
+        return pred[..., 0] + pred[..., 1] * decode_len[..., None]
+
+
+def predictor_score_fn(predictor: LatencyPredictor):
+    """Build the Scheduler's predictor_fn:
+    (params, reqs, eps, assumed_load) -> f32[N, M].
+
+    Bound statically into the jitted cycle (profile.scheduling_cycle);
+    params stay a dynamic argument so online training never recompiles. The
+    live assumed-load vector feeds feature 7 so training and serving see the
+    same load signal.
+    """
+
+    def fn(
+        params,
+        reqs: RequestBatch,
+        eps: EndpointBatch,
+        assumed_load: jax.Array,
+    ) -> jax.Array:
+        feats = build_features(reqs, eps, assumed_load)
+        latency = predictor.request_latency(params, feats, reqs.decode_len)
+        return jnp.exp(-latency / predictor.cfg.norm_s)
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Online training
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(
+    predictor: LatencyPredictor,
+    tx: optax.GradientTransformation,
+    **jit_kwargs,
+):
+    """Jitted AdamW step on (features[B,F], targets[B,2]) MSE.
+
+    Params are NOT donated: the live Scheduler holds a reference to the
+    current params for its scorer column, and donation would delete those
+    buffers out from under it. `jit_kwargs` lets the parallel layer add
+    in_shardings for the multi-chip path.
+    """
+
+    def loss_fn(params, feats, targets):
+        pred = predictor.predict(params, feats)
+        return jnp.mean((pred - targets) ** 2)
+
+    def step(params, opt_state, feats, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, feats, targets)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, **jit_kwargs)
+
+
+class OnlineTrainer:
+    """Host-side ring buffer + periodic jitted train steps.
+
+    Observations arrive from the served-endpoint feedback path: the feature
+    row used at pick time plus measured (ttft_s, tpot_s). The reference's
+    latencypredictor retrains from the same signals (BASELINE configs[3]).
+    """
+
+    def __init__(
+        self,
+        predictor: LatencyPredictor,
+        seed: int = 0,
+        capacity: int = 8192,
+        batch_size: int = 256,
+    ):
+        self.predictor = predictor
+        self.tx = optax.adamw(
+            predictor.cfg.learning_rate, weight_decay=predictor.cfg.weight_decay
+        )
+        self.params = predictor.init(jax.random.PRNGKey(seed))
+        self.opt_state = self.tx.init(self.params)
+        self._step = make_train_step(predictor, self.tx)
+        self.capacity = capacity
+        self.batch_size = batch_size
+        self._feats = np.zeros((capacity, NUM_FEATURES), np.float32)
+        self._targets = np.zeros((capacity, 2), np.float32)
+        self._n = 0
+        self._head = 0
+        self._lock = threading.Lock()
+        self._rng = np.random.default_rng(seed)
+        self.last_loss: Optional[float] = None
+
+    def observe(self, features: np.ndarray, ttft_s: float, tpot_s: float) -> None:
+        with self._lock:
+            self._feats[self._head] = features
+            self._targets[self._head] = (ttft_s, tpot_s)
+            self._head = (self._head + 1) % self.capacity
+            self._n = min(self._n + 1, self.capacity)
+
+    def train(self, steps: int = 1) -> Optional[float]:
+        """Run up to `steps` SGD steps if enough observations accumulated."""
+        with self._lock:
+            n = self._n
+            if n < self.batch_size:
+                return None
+            feats = self._feats[:n].copy()
+            targets = self._targets[:n].copy()
+        loss = None
+        for _ in range(steps):
+            idx = self._rng.integers(0, n, self.batch_size)
+            self.params, self.opt_state, loss_arr = self._step(
+                self.params, self.opt_state, feats[idx], targets[idx]
+            )
+            loss = float(loss_arr)
+        self.last_loss = loss
+        return loss

@@ -1,0 +1,17 @@
+"""Mesh + sharding: multi-chip scheduling and predictor training."""
+
+from gie_tpu.parallel.mesh import (
+    cycle_shardings,
+    make_mesh,
+    predictor_param_shardings,
+    sharded_cycle,
+    sharded_train_step,
+)
+
+__all__ = [
+    "cycle_shardings",
+    "make_mesh",
+    "predictor_param_shardings",
+    "sharded_cycle",
+    "sharded_train_step",
+]

@@ -1,0 +1,105 @@
+"""Device mesh + shardings for multi-chip operation.
+
+Parallelism map (SURVEY.md section 2.10 — the reference is a router, not a
+trainer; the honest multi-chip axes here are):
+
+  dp — the request axis of the scheduling cycle: N pending requests scored
+       against all endpoints, sharded over chips; XLA inserts the all-gather
+       of picks and the reduction of the (replicated) state updates over
+       ICI. This is the "pjit over the request x endpoint score matrix"
+       sharding BASELINE.json's north star names.
+  tp — the hidden dimension of the latency-predictor MLP: Dense kernels
+       split column-/row-wise so its matmuls ride the MXU of every chip
+       (classic 2-layer tensor parallelism; XLA adds the psum).
+
+Pipeline/sequence/expert parallelism have no analogue in this system: there
+is no layer stack deep enough to pipeline, no sequence dimension on device
+(prompts reduce to chunk-hash vectors host-side), and no experts. The design
+keeps the mesh 2-D ("dp", "tp") so a deployment scales either axis by
+reshaping the same program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gie_tpu.sched.profile import scheduling_cycle
+from gie_tpu.sched.types import EndpointBatch, RequestBatch, SchedState, Weights
+
+
+def make_mesh(n_devices: Optional[int] = None, tp: Optional[int] = None) -> Mesh:
+    """2-D ("dp", "tp") mesh over the first n devices. `tp` defaults to 2
+    when the device count allows, else 1."""
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else n_devices
+    devices = devices[:n]
+    if tp is None:
+        tp = 2 if n % 2 == 0 and n >= 2 else 1
+    dp = n // tp
+    grid = np.asarray(devices).reshape(dp, tp)
+    return Mesh(grid, ("dp", "tp"))
+
+
+def cycle_shardings(mesh: Mesh):
+    """in_shardings for profile.scheduling_cycle under `mesh`: requests
+    dp-sharded on their leading axis, endpoint tensors / scheduler state /
+    weights / key replicated. GSPMD turns the dp-sharded contributions to
+    the dense state scatters into ICI collectives."""
+    repl = NamedSharding(mesh, P())
+
+    def dp_leading(x):
+        return NamedSharding(mesh, P("dp", *([None] * (np.ndim(x) - 1))))
+
+    return (
+        jax.tree.map(lambda _: repl, SchedState.init()),          # state
+        jax.tree.map(dp_leading, RequestBatch.empty(8)),          # requests
+        jax.tree.map(lambda _: repl, EndpointBatch.empty()),      # endpoints
+        jax.tree.map(lambda _: repl, Weights.default()),          # weights
+        repl,                                                     # rng key
+    )
+
+
+def sharded_cycle(mesh: Mesh, cfg, predictor_fn=None):
+    """Jit the scheduling cycle with dp-sharded requests over `mesh`.
+    Predictor params (the trailing argument) are replicated."""
+    fn = functools.partial(scheduling_cycle, cfg=cfg, predictor_fn=predictor_fn)
+    repl = NamedSharding(mesh, P())
+    in_sh = cycle_shardings(mesh) + (repl,)
+    return jax.jit(fn, in_shardings=in_sh)
+
+
+def predictor_param_shardings(mesh: Mesh, params):
+    """Tensor-parallel layout for the LatencyMLP: even-indexed Dense kernels
+    column-split P(None, "tp"), odd-indexed row-split P("tp", None) — the
+    standard alternating MLP sharding, one psum per row-split matmul,
+    inserted by XLA. Biases and non-matrix leaves stay replicated."""
+
+    def spec_for(path: str, x) -> P:
+        if getattr(x, "ndim", 0) == 2 and "Dense_" in path:
+            idx = int(path.split("Dense_")[1].split("'")[0].split("]")[0])
+            return P(None, "tp") if idx % 2 == 0 else P("tp", None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: NamedSharding(
+            mesh, spec_for(jax.tree_util.keystr(path), x)
+        ),
+        params,
+    )
+
+
+def sharded_train_step(mesh: Mesh, predictor, tx: optax.GradientTransformation):
+    """Jit the predictor train step: batch dp-sharded, params tp-sharded
+    (layout inferred from the passed-in params' shardings)."""
+    from gie_tpu.models.latency import make_train_step
+
+    data = NamedSharding(mesh, P("dp", None))
+    return make_train_step(
+        predictor, tx, in_shardings=(None, None, data, data)
+    )

@@ -37,8 +37,11 @@ from gie_tpu.sched.types import (
     pad_requests,
 )
 
-# Optional learned scorer column: (params, reqs, eps) -> f32[N, M_MAX].
-PredictorFn = Callable[[object, RequestBatch, EndpointBatch], jax.Array]
+# Optional learned scorer column:
+# (params, reqs, eps, assumed_load) -> f32[N, M_MAX].
+PredictorFn = Callable[
+    [object, RequestBatch, EndpointBatch, jax.Array], jax.Array
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,7 +142,7 @@ def scheduling_cycle(
         cols.append(scorers.lora_affinity_score(reqs, eps, membership))
         wts.append(weights.lora)
     if predictor_fn is not None:
-        cols.append(predictor_fn(predictor_params, reqs, eps))
+        cols.append(predictor_fn(predictor_params, reqs, eps, state.assumed_load))
         wts.append(weights.latency)
 
     stacked = jnp.stack(cols)                       # [S, N, M]
@@ -262,6 +265,12 @@ class Scheduler:
         costs = jnp.asarray(costs, jnp.float32)
         with self._lock:
             self.state = self._complete(self.state, slots, costs)
+
+    def set_predictor_params(self, params) -> None:
+        """Install retrained predictor params (online-training handoff).
+        Swapped under the lock so in-flight cycles see a consistent tree."""
+        with self._lock:
+            self.predictor_params = params
 
     def evict_endpoint(self, slot: int) -> None:
         """Invalidate all prefix-cache knowledge of an endpoint slot (pod

@@ -9,13 +9,24 @@ Environment must be set before jax initializes.
 import os
 
 # Force-override: the environment pins JAX_PLATFORMS to the axon TPU tunnel,
-# but the test tier must run on the virtual CPU mesh.
+# but the test tier must run on the virtual CPU mesh. The axon
+# sitecustomize.py imports jax at interpreter start, so env vars alone are
+# too late — update jax.config directly (backends initialize lazily, so this
+# still takes effect).
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    "a JAX backend initialized before conftest could force CPU; "
+    "the virtual 8-device mesh tests would silently run on one TPU chip"
+)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
