@@ -1,0 +1,169 @@
+"""Prometheus scrape parsing + the fast-poll scraper.
+
+Data-layer ingestion per reference docs/proposals/1023-data-layer-
+architecture/README.md:59-60 (goroutine-per-endpoint fast poll) and the
+metric semantics of proposal 003. Here: one poller thread per endpoint slot,
+writing rows straight into the dense MetricsStore tensor.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+from typing import Callable, Optional
+
+from prometheus_client.parser import text_string_to_metric_families
+
+from gie_tpu.metricsio.mappings import LabeledGauge, ServerMapping
+from gie_tpu.metricsio.store import MetricsStore
+from gie_tpu.sched.constants import Metric
+from gie_tpu.utils.lora import LoraRegistry
+
+
+def _match(sample, gauge: LabeledGauge) -> bool:
+    return all(sample.labels.get(k) == v for k, v in gauge.labels.items())
+
+
+# Fallback registry for callers that don't inject one: module-level so ids
+# stay stable within the process (a per-call registry would reassign ids on
+# every scrape and silently break affinity matching).
+_DEFAULT_REGISTRY = LoraRegistry()
+
+
+def parse_scrape(
+    text: str, mapping: ServerMapping, lora: Optional[LoraRegistry] = None
+) -> tuple[dict[int, float], list[int], list[int]]:
+    """Prometheus exposition text -> (metric columns, active/waiting LoRA ids).
+
+    LoRA residency follows the vllm:lora_requests_info contract (proposal
+    003:43-57): gauge VALUE is a last-updated timestamp — when several series
+    exist, the freshest wins — and the adapter lists ride in the
+    running_lora_adapters / waiting_lora_adapters labels.
+    """
+    out: dict[int, float] = {}
+    lora_active: list[int] = []
+    lora_waiting: list[int] = []
+    best_lora_ts = float("-inf")
+
+    wanted: list[tuple[int, LabeledGauge]] = [
+        (Metric.QUEUE_DEPTH, mapping.queued),
+        (Metric.RUNNING_REQUESTS, mapping.running),
+        (Metric.KV_CACHE_UTIL, mapping.kv_util),
+    ]
+    if mapping.block_size is not None:
+        wanted.append((Metric.BLOCK_SIZE, mapping.block_size))
+    if mapping.num_blocks is not None:
+        wanted.append((Metric.NUM_BLOCKS, mapping.num_blocks))
+
+    for family in text_string_to_metric_families(text):
+        for sample in family.samples:
+            for col, gauge in wanted:
+                if sample.name != gauge.name or not _match(sample, gauge):
+                    continue
+                if gauge.value_label is not None:
+                    raw = sample.labels.get(gauge.value_label)
+                    if raw is not None:
+                        try:
+                            out[col] = float(raw)
+                        except ValueError:
+                            pass
+                else:
+                    out[col] = float(sample.value)
+            if mapping.lora_info and sample.name in (
+                mapping.lora_info,
+                mapping.lora_info.replace(":", "_"),
+            ):
+                if sample.value >= best_lora_ts:
+                    best_lora_ts = sample.value
+                    out[Metric.MAX_LORA] = float(
+                        sample.labels.get("max_lora", "0") or 0
+                    )
+                    reg = lora if lora is not None else _DEFAULT_REGISTRY
+                    lora_active = reg.ids_for(
+                        sample.labels.get("running_lora_adapters", "").split(",")
+                    )
+                    lora_waiting = reg.ids_for(
+                        sample.labels.get("waiting_lora_adapters", "").split(",")
+                    )
+                    out[Metric.WAITING_LORA] = float(len(lora_waiting))
+    return out, lora_active, lora_waiting
+
+
+Fetcher = Callable[[str], str]
+
+
+def _http_fetch(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=2.0) as resp:  # noqa: S310
+        return resp.read().decode("utf-8", "replace")
+
+
+class Scraper:
+    """Per-endpoint fast-poll loop.
+
+    `attach(slot, url, mapping)` starts a poller thread for an endpoint;
+    `detach(slot)` stops it (wired to datastore slot reclaim). The reference
+    runs one goroutine per endpoint with a configurable interval
+    (1023 README:59-60); 50 ms default matches its fast-poll guidance.
+    """
+
+    def __init__(
+        self,
+        store: MetricsStore,
+        lora: Optional[LoraRegistry] = None,
+        interval_s: float = 0.05,
+        fetcher: Fetcher = _http_fetch,
+    ):
+        self.store = store
+        self.lora = lora or LoraRegistry()
+        self.interval_s = interval_s
+        self.fetcher = fetcher
+        self._stops: dict[int, threading.Event] = {}
+        self._threads: dict[int, threading.Thread] = {}
+        self._lock = threading.Lock()
+
+    def attach(self, slot: int, url: str, mapping: ServerMapping) -> None:
+        with self._lock:
+            if slot in self._threads:
+                return
+            stop = threading.Event()
+            t = threading.Thread(
+                target=self._poll, args=(slot, url, mapping, stop), daemon=True
+            )
+            self._stops[slot] = stop
+            self._threads[slot] = t
+            t.start()
+
+    def detach(self, slot: int) -> None:
+        with self._lock:
+            stop = self._stops.pop(slot, None)
+            thread = self._threads.pop(slot, None)
+        if stop is not None:
+            stop.set()
+        if thread is not None:
+            thread.join(timeout=2)
+        self.store.remove(slot)
+
+    def close(self) -> None:
+        for slot in list(self._threads):
+            self.detach(slot)
+
+    def _poll(
+        self, slot: int, url: str, mapping: ServerMapping, stop: threading.Event
+    ) -> None:
+        while not stop.is_set():
+            started = time.monotonic()
+            try:
+                text = self.fetcher(url)
+                metrics, active, waiting = parse_scrape(text, mapping, self.lora)
+                if metrics:
+                    self.store.update(
+                        slot, metrics, lora_active=active, lora_waiting=waiting
+                    )
+            except Exception:
+                # Unreachable endpoint: leave the last row; staleness shows
+                # up via METRICS_AGE_S and the endpoint stays routable
+                # (reference keeps stale metrics rather than evicting).
+                pass
+            elapsed = time.monotonic() - started
+            stop.wait(max(self.interval_s - elapsed, 0.001))

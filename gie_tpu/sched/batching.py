@@ -30,6 +30,7 @@ from gie_tpu.sched import constants as C
 from gie_tpu.sched.hashing import batch_chunk_hashes
 from gie_tpu.sched.profile import Scheduler
 from gie_tpu.sched.types import RequestBatch
+from gie_tpu.utils.lora import LoraRegistry
 
 import jax.numpy as jnp
 
@@ -62,13 +63,16 @@ class BatchingTPUPicker:
         *,
         max_wait_s: float = 0.002,
         max_batch: int = C.N_BUCKETS[-1],
+        lora_registry: Optional[LoraRegistry] = None,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
         self.metrics_store = metrics_store
         self.max_wait_s = max_wait_s
         self.max_batch = max_batch
-        self._lora_ids: dict[str, int] = {}
+        # MUST be the same registry the metrics scraper interns adapter
+        # names through, or affinity compares ids from two unrelated spaces.
+        self.lora_registry = lora_registry if lora_registry is not None else LoraRegistry()
         self._pending: list[_Pending] = []
         self._cond = threading.Condition()
         self._closed = False
@@ -133,13 +137,6 @@ class BatchingTPUPicker:
                     )
                     item.event.set()
 
-    def _lora_id(self, model: str) -> int:
-        if not model:
-            return -1
-        if model not in self._lora_ids:
-            self._lora_ids[model] = len(self._lora_ids) + 1
-        return self._lora_ids[model]
-
     def _run_batch(self, batch: list[_Pending]) -> None:
         n = len(batch)
         prompts = [it.req.body or b"" for it in batch]
@@ -149,7 +146,7 @@ class BatchingTPUPicker:
         plen = np.zeros((n,), np.float32)
         mask = np.zeros((n, C.M_MAX), bool)
         for i, it in enumerate(batch):
-            lora[i] = self._lora_id(it.req.model)
+            lora[i] = self.lora_registry.id_for(it.req.model)
             obj = it.req.headers.get(mdkeys.OBJECTIVE_KEY, [""])[0].lower()
             crit[i] = _CRITICALITY_BY_NAME.get(obj, C.Criticality.STANDARD)
             plen[i] = float(len(prompts[i]))

@@ -1,0 +1,250 @@
+"""SimCluster: closed-loop cluster simulation for scheduler benchmarking.
+
+The benchmarking harness the scheduler proposal calls for (reference
+docs/proposals/006-scheduler/README.md:164-174): a fleet of VLLMStub model
+servers, a session-structured traffic generator (shared system prompts ->
+prefix reuse; LoRA adapter mix), the real metrics pipeline (stub prometheus
+text -> parse_scrape -> MetricsStore), and pluggable scheduling policies:
+
+  tpu       — the batched Scheduler (full scorer blend on device)
+  least-kv  — per-request argmax of free KV cache (the reference EPP's
+              default scorer; BASELINE configs[0] baseline)
+  round-robin — lwepp's RoundRobinPicker equivalent
+
+Goodput = output tokens/s from requests meeting the TTFT SLO (the
+"cluster tokens/sec goodput" of the BASELINE north star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.metricsio.mappings import VLLM
+from gie_tpu.metricsio.scrape import parse_scrape
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.hashing import batch_chunk_hashes
+from gie_tpu.sched.profile import ProfileConfig, Scheduler
+from gie_tpu.sched.types import RequestBatch, Weights
+from gie_tpu.simulator.vllm_stub import StubConfig, VLLMStub
+from gie_tpu.utils.lora import LoraRegistry
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadConfig:
+    arrival_qps: float = 40.0
+    n_sessions: int = 24           # distinct shared system prompts
+    system_prompt_bytes: int = 2048
+    user_suffix_bytes: int = 256
+    decode_tokens_mean: float = 96.0
+    lora_adapters: int = 0         # 0 = base-model-only workload
+    ttft_slo_s: float = 2.0
+
+
+def tuned_scheduler() -> Scheduler:
+    """Scheduler profile tuned on the cache-constrained prefix benchmark
+    (simulation sweep, round 1): strong queue + assumed-load terms keep
+    prefix affinity from herding sessions onto hot pods."""
+    import jax.numpy as _jnp
+
+    return Scheduler(
+        ProfileConfig(load_decay=0.95, load_norm=8.0, queue_norm=16.0),
+        weights=Weights(
+            queue=_jnp.float32(2.0),
+            kv_cache=_jnp.float32(1.0),
+            prefix=_jnp.float32(1.0),
+            lora=_jnp.float32(1.0),
+            assumed_load=_jnp.float32(3.0),
+            latency=_jnp.float32(0.0),
+        ),
+    )
+
+
+@dataclasses.dataclass
+class RunStats:
+    goodput_tokens_per_s: float
+    throughput_tokens_per_s: float
+    ttft_p50_s: float
+    ttft_p99_s: float
+    slo_attainment: float
+    prefix_hit_rate: float
+    completed: int
+
+
+class SimCluster:
+    def __init__(
+        self,
+        n_pods: int = 8,
+        stub_cfg: StubConfig = StubConfig(),
+        seed: int = 0,
+    ):
+        self.stubs = [VLLMStub(stub_cfg, name=f"pod-{i}") for i in range(n_pods)]
+        self.n = n_pods
+        self.rng = np.random.default_rng(seed)
+        self.store = MetricsStore()
+        self.lora_reg = LoraRegistry()
+
+    def _scrape_all(self, now: float) -> None:
+        for slot, stub in enumerate(self.stubs):
+            metrics, active, waiting = parse_scrape(
+                stub.metrics_text(), VLLM, self.lora_reg
+            )
+            self.store.update(
+                slot, metrics, lora_active=active, lora_waiting=waiting, now=now
+            )
+
+    def _endpoint_batch(self, now: float):
+        class _Ep:
+            __slots__ = ("slot",)
+
+            def __init__(self, slot):
+                self.slot = slot
+
+        return self.store.endpoint_batch([_Ep(i) for i in range(self.n)], now=now)
+
+    def run(
+        self,
+        policy: str,
+        workload: WorkloadConfig = WorkloadConfig(),
+        duration_s: float = 30.0,
+        dt: float = 0.02,
+        scrape_interval_s: float = 0.05,
+        scheduler: Optional[Scheduler] = None,
+    ) -> RunStats:
+        wl = workload
+        sessions = [
+            (b"SYSTEM PROMPT session %03d | " % s) * 2
+            + b"x" * max(wl.system_prompt_bytes - 60, 0)
+            for s in range(wl.n_sessions)
+        ]
+        if policy == "tpu" and scheduler is None:
+            scheduler = tuned_scheduler()
+        rr_counter = 0
+        clock = 0.0
+        next_scrape = 0.0
+        completions = []
+        self._scrape_all(0.0)
+
+        while clock < duration_s:
+            # --- arrivals (Poisson) ---------------------------------------
+            n_new = self.rng.poisson(wl.arrival_qps * dt)
+            prompts, decodes, loras = [], [], []
+            for _ in range(n_new):
+                sess = self.rng.integers(0, wl.n_sessions)
+                suffix = bytes(
+                    self.rng.integers(97, 122, wl.user_suffix_bytes, dtype=np.uint8)
+                )
+                prompts.append(sessions[sess] + suffix)
+                decodes.append(
+                    float(max(self.rng.exponential(wl.decode_tokens_mean), 8.0))
+                )
+                loras.append(
+                    f"adapter-{self.rng.integers(0, wl.lora_adapters)}"
+                    if wl.lora_adapters > 0
+                    else None
+                )
+
+            # --- schedule -------------------------------------------------
+            if n_new:
+                picks = self._schedule(
+                    policy, scheduler, prompts, decodes, loras, clock, rr_counter
+                )
+                rr_counter += n_new
+                for prompt, decode, lora, pod in zip(prompts, decodes, loras, picks):
+                    self.stubs[pod].submit(prompt, decode_tokens=decode, lora=lora)
+
+            # --- advance the fleet ----------------------------------------
+            for slot, stub in enumerate(self.stubs):
+                for comp in stub.step(dt):
+                    completions.append(comp)
+                    if scheduler is not None and policy == "tpu":
+                        # Release exactly what pick time charged
+                        # (profile.request_cost on prompt_len + decode_len).
+                        cost = np.clip(
+                            (comp.prompt_bytes + comp.output_tokens) / 2048.0,
+                            0.25,
+                            8.0,
+                        )
+                        scheduler.complete(
+                            np.asarray([slot], np.int32),
+                            np.asarray([cost], np.float32),
+                        )
+            clock += dt
+            if clock >= next_scrape:
+                self._scrape_all(clock)
+                next_scrape = clock + scrape_interval_s
+
+        # --- stats ---------------------------------------------------------
+        if not completions:
+            return RunStats(0, 0, float("inf"), float("inf"), 0, 0, 0)
+        ttfts = np.asarray([c.ttft_s for c in completions])
+        tokens = np.asarray([c.output_tokens for c in completions])
+        ok = ttfts <= wl.ttft_slo_s
+        return RunStats(
+            goodput_tokens_per_s=float(tokens[ok].sum() / duration_s),
+            throughput_tokens_per_s=float(tokens.sum() / duration_s),
+            ttft_p50_s=float(np.percentile(ttfts, 50)),
+            ttft_p99_s=float(np.percentile(ttfts, 99)),
+            slo_attainment=float(ok.mean()),
+            prefix_hit_rate=float(
+                np.mean([c.hit_fraction for c in completions])
+            ),
+            completed=len(completions),
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def _schedule(
+        self, policy, scheduler, prompts, decodes, loras, now, rr_counter
+    ) -> list[int]:
+        n = len(prompts)
+        if policy == "round-robin":
+            return [(rr_counter + i) % self.n for i in range(n)]
+        if policy == "least-kv":
+            # The reference default scorer: per request, pick the endpoint
+            # with the most free KV cache (queue-depth tie-break), reading
+            # the latest scraped metrics — per-request greedy, no batch
+            # awareness (BASELINE configs[0]).
+            kv = self.store._metrics[: self.n, C.Metric.KV_CACHE_UTIL].copy()
+            queue = self.store._metrics[: self.n, C.Metric.QUEUE_DEPTH].copy()
+            picks = []
+            for _ in range(n):
+                score = (1.0 - kv) - 0.01 * queue
+                p = int(np.argmax(score))
+                picks.append(p)
+                # emulate the reference's assumed-load bump between scrapes
+                queue[p] += 1.0
+            return picks
+        if policy == "tpu":
+            hashes, counts = batch_chunk_hashes(prompts)
+            lora_ids = np.asarray(
+                [self.lora_reg.id_for(x) if x else -1 for x in loras], np.int32
+            )
+            reqs = RequestBatch(
+                valid=jnp.ones((n,), bool),
+                lora_id=jnp.asarray(lora_ids),
+                criticality=jnp.full((n,), C.Criticality.STANDARD, jnp.int32),
+                prompt_len=jnp.asarray([float(len(p)) for p in prompts]),
+                decode_len=jnp.asarray(np.asarray(decodes, np.float32)),
+                chunk_hashes=jnp.asarray(hashes),
+                n_chunks=jnp.asarray(counts),
+                subset_mask=jnp.ones((n, C.M_MAX), bool),
+                had_subset_hint=jnp.zeros((n,), bool),
+            )
+            # Only the first self.n slots are valid endpoints.
+            eps = self._endpoint_batch(now)
+            result = scheduler.pick(reqs, eps)
+            primary = np.asarray(result.indices[:, 0])
+            # Fallback for any non-OK rows: least-kv choice.
+            bad = primary < 0
+            if bad.any():
+                kv = self.store._metrics[: self.n, C.Metric.KV_CACHE_UTIL]
+                primary = primary.copy()
+                primary[bad] = int(np.argmin(kv))
+            return [int(p) for p in primary]
+        raise ValueError(f"unknown policy {policy!r}")

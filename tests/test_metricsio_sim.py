@@ -1,0 +1,168 @@
+"""Metrics protocol + simulator tests (proposal 003 mappings; proposal 006
+stub semantics)."""
+
+import time
+
+import pytest
+
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.metricsio.mappings import SGLANG, TRITON_TRTLLM, TRTLLM_SERVE, VLLM
+from gie_tpu.metricsio.scrape import Scraper, parse_scrape
+from gie_tpu.sched.constants import Metric
+from gie_tpu.simulator import StubConfig, VLLMStub
+from gie_tpu.utils.lora import LoraRegistry
+
+
+VLLM_TEXT = """\
+# TYPE vllm:num_requests_waiting gauge
+vllm:num_requests_waiting 7
+# TYPE vllm:num_requests_running gauge
+vllm:num_requests_running 3
+# TYPE vllm:kv_cache_usage_perc gauge
+vllm:kv_cache_usage_perc 0.42
+# TYPE vllm:cache_config_info gauge
+vllm:cache_config_info{block_size="16",num_gpu_blocks="2048"} 1
+# TYPE vllm:lora_requests_info gauge
+vllm:lora_requests_info{max_lora="4",running_lora_adapters="a1, a2",waiting_lora_adapters="a3"} 100.0
+vllm:lora_requests_info{max_lora="4",running_lora_adapters="old",waiting_lora_adapters=""} 50.0
+"""
+
+TRITON_TEXT = """\
+# TYPE nv_trt_llm_request_metrics gauge
+nv_trt_llm_request_metrics{request_type="waiting"} 5
+nv_trt_llm_request_metrics{request_type="scheduled"} 2
+# TYPE nv_trt_llm_kv_cache_block_metrics gauge
+nv_trt_llm_kv_cache_block_metrics{kv_cache_block_type="fraction"} 0.66
+nv_trt_llm_kv_cache_block_metrics{kv_cache_block_type="tokens_per"} 32
+nv_trt_llm_kv_cache_block_metrics{kv_cache_block_type="max"} 1024
+"""
+
+SGLANG_TEXT = """\
+sglang:num_queue_reqs 1
+sglang:num_running_reqs 9
+sglang:token_usage 0.81
+"""
+
+
+def test_parse_vllm():
+    reg = LoraRegistry()
+    metrics, active, waiting = parse_scrape(VLLM_TEXT, VLLM, reg)
+    assert metrics[Metric.QUEUE_DEPTH] == 7
+    assert metrics[Metric.RUNNING_REQUESTS] == 3
+    assert metrics[Metric.KV_CACHE_UTIL] == pytest.approx(0.42)
+    assert metrics[Metric.BLOCK_SIZE] == 16
+    assert metrics[Metric.NUM_BLOCKS] == 2048
+    assert metrics[Metric.MAX_LORA] == 4
+    # Freshest lora_requests_info series wins (ts 100 > 50).
+    assert active == [reg.id_for("a1"), reg.id_for("a2")]
+    assert waiting == [reg.id_for("a3")]
+
+
+def test_parse_triton_labeled_gauges():
+    metrics, _, _ = parse_scrape(TRITON_TEXT, TRITON_TRTLLM)
+    assert metrics[Metric.QUEUE_DEPTH] == 5
+    assert metrics[Metric.RUNNING_REQUESTS] == 2
+    assert metrics[Metric.KV_CACHE_UTIL] == pytest.approx(0.66)
+    assert metrics[Metric.BLOCK_SIZE] == 32
+    assert metrics[Metric.NUM_BLOCKS] == 1024
+
+
+def test_parse_sglang():
+    metrics, _, _ = parse_scrape(SGLANG_TEXT, SGLANG)
+    assert metrics[Metric.QUEUE_DEPTH] == 1
+    assert metrics[Metric.RUNNING_REQUESTS] == 9
+    assert metrics[Metric.KV_CACHE_UTIL] == pytest.approx(0.81)
+
+
+def test_scraper_poll_loop_fills_store():
+    store = MetricsStore()
+    texts = {"http://10.0.0.1:8000/metrics": VLLM_TEXT}
+    scraper = Scraper(store, interval_s=0.01, fetcher=lambda url: texts[url])
+    scraper.attach(3, "http://10.0.0.1:8000/metrics", VLLM)
+    deadline = time.time() + 2
+    while time.time() < deadline:
+        if store._has_data[3]:
+            break
+        time.sleep(0.01)
+    queue_seen = float(store._metrics[3, Metric.QUEUE_DEPTH])
+    scraper.close()
+    assert queue_seen == 7
+    assert not store._has_data[3]  # detach cleared the slot
+
+
+def test_scraper_survives_fetch_errors():
+    store = MetricsStore()
+
+    def bad_fetch(url):
+        raise ConnectionError("down")
+
+    scraper = Scraper(store, interval_s=0.01, fetcher=bad_fetch)
+    scraper.attach(0, "http://x/metrics", VLLM)
+    time.sleep(0.05)
+    scraper.close()
+    assert not store._has_data[0]
+
+
+# ---------------------------------------------------------------------------
+# Simulator
+# ---------------------------------------------------------------------------
+
+
+def test_stub_processes_request_lifecycle():
+    stub = VLLMStub(StubConfig(decode_tokens_per_s=100.0))
+    stub.submit(b"x" * 400, decode_tokens=50)
+    done = stub.step(5.0)
+    assert len(done) == 1
+    c = done[0]
+    assert c.ttft_s > 0
+    assert c.tpot_s == pytest.approx(1 / 100.0, rel=0.3)
+
+
+def test_stub_queueing_raises_ttft():
+    cfg = StubConfig(max_running=1, decode_tokens_per_s=100.0)
+    stub = VLLMStub(cfg)
+    stub.submit(b"a" * 400, decode_tokens=100)
+    stub.submit(b"b" * 400, decode_tokens=100)
+    done = stub.step(10.0)
+    assert len(done) == 2
+    by_id = {c.rid: c for c in done}
+    assert by_id[1].queue_s > by_id[0].queue_s
+    assert by_id[1].ttft_s > by_id[0].ttft_s
+
+
+def test_stub_prefix_cache_reduces_ttft():
+    cfg = StubConfig(prefill_tokens_per_s=500.0, decode_tokens_per_s=1000.0)
+    shared = b"SYSTEM PROMPT " * 64
+    s1 = VLLMStub(cfg)
+    first = s1.submit(shared + b"q1", decode_tokens=1)
+    s1.step(10.0)
+    second = s1.submit(shared + b"q2", decode_tokens=1)
+    done = s1.step(10.0)
+    cold = VLLMStub(cfg)
+    cold.submit(shared + b"q2", decode_tokens=1)
+    cold_done = cold.step(10.0)
+    warm_ttft = [c for c in done if c.rid == second][0].ttft_s
+    assert warm_ttft < cold_done[0].ttft_s * 0.5
+    assert [c for c in done if c.rid == second][0].hit_fraction > 0.8
+
+
+def test_stub_lora_loading_and_metrics():
+    cfg = StubConfig(max_lora=2, decode_tokens_per_s=1000.0)
+    stub = VLLMStub(cfg)
+    stub.submit(b"p" * 100, decode_tokens=1, lora="ad1")
+    stub.submit(b"p" * 100, decode_tokens=1, lora="ad2")
+    stub.step(3.0)
+    text = stub.metrics_text()
+    metrics, active, waiting = parse_scrape(text, VLLM, LoraRegistry())
+    assert metrics[Metric.MAX_LORA] == 2
+    assert len(active) == 2
+
+
+def test_stub_metrics_scrapeable_by_real_parser():
+    stub = VLLMStub()
+    for i in range(5):
+        stub.submit(b"req %d" % i * 50, decode_tokens=200)
+    stub.step(0.05)
+    metrics, _, _ = parse_scrape(stub.metrics_text(), VLLM)
+    assert metrics[Metric.QUEUE_DEPTH] + metrics[Metric.RUNNING_REQUESTS] == 5
+    assert 0 <= metrics[Metric.KV_CACHE_UTIL] <= 1
