@@ -141,7 +141,10 @@ class Datastore:
                         )
                     else:
                         # Refresh mutable fields in place; slot is sticky.
+                        # Port too: a targetPorts change re-binds the same
+                        # rank index to a new port number.
                         existing.address = pod.ip
+                        existing.port = port
                         existing.labels = dict(pod.labels)
                 else:
                     if existing is not None:

@@ -51,6 +51,9 @@ class PickRequest:
     headers: dict[str, list[str]]
     body: Optional[bytes] = None
     model: str = ""
+    # True when the data plane supplied an explicit candidate subset
+    # (metadata hint or test steering header).
+    subset_hinted: bool = False
 
 
 @dataclasses.dataclass
@@ -96,6 +99,7 @@ class RoundRobinPicker:
 class RequestContext:
     headers: dict[str, list[str]] = dataclasses.field(default_factory=dict)
     candidates: list = dataclasses.field(default_factory=list)
+    subset_hinted: bool = False
     target_endpoint: str = ""
     selected_pod_ip: str = ""
 
@@ -131,7 +135,17 @@ class StreamingServer:
             if which == "request_headers":
                 self._handle_request_headers(ctx, req)
                 if req.request_headers.end_of_stream:
-                    self._pick(ctx, None)
+                    try:
+                        self._pick(ctx, None)
+                    except ShedError:
+                        stream.send(
+                            pb.ProcessingResponse(
+                                immediate_response=pb.ImmediateResponse(
+                                    status_code=429, details="request shed"
+                                )
+                            )
+                        )
+                        return
                     stream.send(self._headers_response(ctx))
                 else:
                     headers_deferred = True
@@ -232,6 +246,7 @@ class StreamingServer:
             raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "no pods available")
 
         if has_subset_filter or filter_endpoints:
+            ctx.subset_hinted = True
             # ip or ip:port entries; bare ip allows all ports
             # (reference request.go:104-129).
             allow_all_ports: set[str] = set()
@@ -258,7 +273,12 @@ class StreamingServer:
         if rewrite:
             model = rewrite[0]
         result = self.picker.pick(
-            PickRequest(headers=ctx.headers, body=body, model=model),
+            PickRequest(
+                headers=ctx.headers,
+                body=body,
+                model=model,
+                subset_hinted=ctx.subset_hinted,
+            ),
             ctx.candidates,
         )
         ctx.target_endpoint = result.destination_value

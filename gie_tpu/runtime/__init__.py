@@ -1,0 +1,1 @@
+"""Runtime: options, logging, TLS, health, metrics, server runner."""

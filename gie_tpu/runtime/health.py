@@ -1,0 +1,86 @@
+"""gRPC health service (grpc.health.v1) with pool-sync-gated readiness.
+
+Mirror of reference runserver.go:117-123,132-157: the ext-proc server
+exposes health BOTH colocated (on the ext-proc port, under the ext-proc
+service name) and on a dedicated health port whose readiness flips to
+SERVING only once the datastore has synced the InferencePool (100 ms poll),
+per the protocol's liveness/readiness semantics (004 README:103-137).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+import grpc
+
+import gie_tpu.extproc  # noqa: F401 — installs the pb path hook
+import health_pb2  # via gie_tpu.extproc pb path hook
+from gie_tpu.extproc.service import SERVICE_NAME as EXTPROC_SERVICE
+
+HEALTH_SERVICE = "grpc.health.v1.Health"
+
+SERVING = health_pb2.HealthCheckResponse.SERVING
+NOT_SERVING = health_pb2.HealthCheckResponse.NOT_SERVING
+
+
+class HealthService:
+    """Check/Watch backed by a ready-predicate per service name."""
+
+    def __init__(self, ready_fn: Callable[[], bool]):
+        self.ready_fn = ready_fn
+
+    def _status(self, service: str) -> int:
+        known = ("", EXTPROC_SERVICE, HEALTH_SERVICE)
+        if service not in known:
+            return health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+        return SERVING if self.ready_fn() else NOT_SERVING
+
+    def check(self, request, context):
+        return health_pb2.HealthCheckResponse(status=self._status(request.service))
+
+    def watch(self, request, context):
+        # Poll-based watch (reference HealthServerRunnable polls at 100 ms,
+        # runserver.go:147-149); emits on every state change.
+        last = None
+        while context.is_active():
+            status = self._status(request.service)
+            if status != last:
+                last = status
+                yield health_pb2.HealthCheckResponse(status=status)
+            time.sleep(0.1)
+
+    def add_to_server(self, server: grpc.Server) -> None:
+        handlers = {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                self.check,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                self.watch,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(HEALTH_SERVICE, handlers),)
+        )
+
+
+def start_dedicated_health_server(
+    ready_fn: Callable[[], bool], port: int
+) -> tuple[grpc.Server, int]:
+    """The dedicated health listener, started BEFORE the manager/cache sync
+    so probes get NOT_SERVING instead of connection refused (reference
+    cmd/lwepp/main.go:104-109)."""
+    from concurrent import futures
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    HealthService(ready_fn).add_to_server(server)
+    bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    if bound == 0:
+        raise OSError(f"failed to bind health port {port}")
+    server.start()
+    return server, bound

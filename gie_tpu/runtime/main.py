@@ -1,0 +1,142 @@
+"""lwepp-equivalent entrypoint (reference cmd/lwepp/main.go:36-116).
+
+    python -m gie_tpu.runtime.main --pool-name my-pool [--demo]
+
+Without a real kube-apiserver in this environment, the ClusterClient seam
+(gie_tpu/controller/cluster.py) is served either by an external integration
+(a kubernetes watch adapter implementing ClusterClient) or — with --demo —
+by an in-process FakeCluster populated with simulated vLLM pods whose
+/metrics endpoints are real HTTP servers backed by VLLMStub dynamics, so the
+whole binary is drivable end to end on one machine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def _demo_cluster(opts, n_pods: int):
+    """FakeCluster + stub fleet with live HTTP /metrics."""
+    import http.server
+
+    from gie_tpu.api import types as api
+    from gie_tpu.controller import FakeCluster
+    from gie_tpu.datastore.objects import Pod
+    from gie_tpu.simulator import StubConfig, VLLMStub
+
+    cluster = FakeCluster()
+    stubs, servers = [], []
+    n_pods = min(n_pods, 8)  # one targetPort per pod, max 8 (API limit)
+    for i in range(n_pods):
+        stub = VLLMStub(StubConfig(), name=f"demo-pod-{i}")
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self, s=stub):
+                body = s.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        stubs.append(stub)
+        servers.append(httpd)
+
+    # Clock driver so stub queues evolve.
+    def tick():
+        import time
+
+        while True:
+            for s in stubs:
+                s.step(0.05)
+            time.sleep(0.05)
+
+    threading.Thread(target=tick, daemon=True).start()
+
+    # Every stub listens on its own localhost port; the pool lists them all
+    # as targetPorts (max 8) and each pod's active-ports annotation narrows
+    # to its own stub, exercising the per-pod rank filtering.
+    ports = [s.server_address[1] for s in servers]
+    cluster.apply_pool(
+        api.InferencePool(
+            metadata=api.ObjectMeta(
+                name=opts.pool_name, namespace=opts.pool_namespace
+            ),
+            spec=api.InferencePoolSpec(
+                selector=api.LabelSelector(matchLabels={"app": "demo"}),
+                targetPorts=[api.Port(p) for p in ports],
+                endpointPickerRef=api.EndpointPickerRef(
+                    name="epp", port=api.Port(opts.grpc_port)
+                ),
+            ),
+        )
+    )
+    for i, httpd in enumerate(servers):
+        cluster.apply_pod(
+            Pod(
+                name=f"demo-pod-{i}",
+                namespace=opts.pool_namespace,
+                labels={"app": "demo"},
+                ip="127.0.0.1",
+                annotations={
+                    api.ACTIVE_PORTS_ANNOTATION: str(httpd.server_address[1])
+                },
+            )
+        )
+    return cluster
+
+
+def main(argv=None) -> int:
+    from gie_tpu.runtime.logging import get_logger, set_verbosity
+    from gie_tpu.runtime.options import Options
+    from gie_tpu.runtime.runner import ExtProcServerRunner
+
+    parser = argparse.ArgumentParser(prog="gie-tpu-epp")
+    Options.add_flags(parser)
+    parser.add_argument(
+        "--demo", action="store_true",
+        help="run against an in-process simulated cluster",
+    )
+    parser.add_argument("--demo-pods", type=int, default=4)
+    args = parser.parse_args(argv)
+    opts = Options.from_args(args)
+    opts.validate()
+    set_verbosity(opts.verbosity)
+    log = get_logger("main")
+
+    if args.demo:
+        cluster = _demo_cluster(opts, args.demo_pods)
+    else:
+        log.error(
+            "no cluster integration configured; run with --demo or provide "
+            "a ClusterClient adapter"
+        )
+        return 2
+
+    runner = ExtProcServerRunner(opts, cluster)
+    runner.setup()
+    runner.start()
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal received, shutting down", signal=signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    log.info("serving", pool=opts.pool_name)
+    stop.wait()
+    runner.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""Prometheus metrics OUT: the EPP's own observability.
+
+The reference exposes controller-runtime's metrics endpoint on :9090
+(cmd/lwepp/main.go:75-77); the full-EPP spec adds scheduler metrics. Here:
+pick counts/latency, shed/unavailable counts, batch sizes, assumed load.
+"""
+
+from __future__ import annotations
+
+import prometheus_client as prom
+
+REGISTRY = prom.CollectorRegistry()
+
+PICKS = prom.Counter(
+    "gie_picks_total", "Endpoint picks by outcome", ["outcome"], registry=REGISTRY
+)
+PICK_LATENCY = prom.Histogram(
+    "gie_pick_latency_seconds",
+    "End-to-end pick latency (enqueue to result)",
+    buckets=(1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0),
+    registry=REGISTRY,
+)
+BATCH_SIZE = prom.Histogram(
+    "gie_sched_batch_size",
+    "Requests per scheduling cycle",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+    registry=REGISTRY,
+)
+STREAMS = prom.Gauge(
+    "gie_active_streams", "Open ext-proc streams", registry=REGISTRY
+)
+
+
+def start_metrics_server(port: int) -> None:
+    prom.start_http_server(port, registry=REGISTRY)

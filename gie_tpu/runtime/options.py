@@ -1,0 +1,93 @@
+"""CLI options with the AddFlags/Complete/Validate lifecycle.
+
+Mirror of reference pkg/lwepp/server/options.go:25-94 (defaults: ext-proc
+gRPC 9002, dedicated health 9003, metrics 9090, pool group
+inference.networking.k8s.io, TLS on) plus the TPU scheduler's knobs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+from gie_tpu.api.types import GROUP
+
+
+@dataclasses.dataclass
+class Options:
+    grpc_port: int = 9002
+    grpc_health_port: int = 9003
+    metrics_port: int = 9090
+    pool_name: str = ""
+    pool_namespace: str = "default"
+    pool_group: str = GROUP
+    secure_serving: bool = True
+    cert_path: Optional[str] = None     # mounted cert dir (hot-reload)
+    verbosity: int = 2
+    # TPU scheduler knobs
+    batch_window_ms: float = 2.0
+    scrape_interval_ms: float = 50.0
+    model_server_type: str = "vllm"
+
+    @staticmethod
+    def add_flags(parser: argparse.ArgumentParser) -> None:
+        d = Options()
+        parser.add_argument("--grpc-port", type=int, default=d.grpc_port,
+                            help="ext-proc gRPC port")
+        parser.add_argument("--grpc-health-port", type=int,
+                            default=d.grpc_health_port,
+                            help="dedicated health gRPC port")
+        parser.add_argument("--metrics-port", type=int, default=d.metrics_port,
+                            help="prometheus metrics port")
+        parser.add_argument("--pool-name", default=d.pool_name,
+                            help="InferencePool to serve (required)")
+        parser.add_argument("--pool-namespace", default=d.pool_namespace)
+        parser.add_argument("--pool-group", default=d.pool_group)
+        parser.add_argument("--secure-serving", action="store_true",
+                            default=d.secure_serving)
+        parser.add_argument("--insecure-serving", dest="secure_serving",
+                            action="store_false")
+        parser.add_argument("--cert-path", default=d.cert_path,
+                            help="mounted TLS cert dir (tls.crt/tls.key); "
+                                 "self-signed when unset")
+        parser.add_argument("-v", "--verbosity", type=int, default=d.verbosity)
+        parser.add_argument("--batch-window-ms", type=float,
+                            default=d.batch_window_ms,
+                            help="micro-batch collection window")
+        parser.add_argument("--scrape-interval-ms", type=float,
+                            default=d.scrape_interval_ms)
+        parser.add_argument("--model-server-type", default=d.model_server_type,
+                            choices=["vllm", "triton-tensorrt-llm",
+                                     "trtllm-serve", "sglang"])
+
+    @classmethod
+    def from_args(cls, args: argparse.Namespace) -> "Options":
+        return cls(
+            grpc_port=args.grpc_port,
+            grpc_health_port=args.grpc_health_port,
+            metrics_port=args.metrics_port,
+            pool_name=args.pool_name,
+            pool_namespace=args.pool_namespace,
+            pool_group=args.pool_group,
+            secure_serving=args.secure_serving,
+            cert_path=args.cert_path,
+            verbosity=args.verbosity,
+            batch_window_ms=args.batch_window_ms,
+            scrape_interval_ms=args.scrape_interval_ms,
+            model_server_type=args.model_server_type,
+        )
+
+    def validate(self) -> None:
+        """reference options.go:84-94."""
+        if not self.pool_name:
+            raise ValueError("--pool-name is required")
+        for name, port in (
+            ("grpc-port", self.grpc_port),
+            ("grpc-health-port", self.grpc_health_port),
+            ("metrics-port", self.metrics_port),
+        ):
+            if not (0 < port < 65536):
+                raise ValueError(f"--{name} {port} out of range")
+        if self.verbosity < 0 or self.verbosity > 5:
+            raise ValueError("-v must be 0..5")

@@ -1,0 +1,176 @@
+"""ExtProcServerRunner: wiring + lifecycle.
+
+Mirror of reference pkg/lwepp/server/runserver.go:45-157 + cmd/lwepp/main.go:
+build the full stack (datastore + reconcilers + scraper + scheduler +
+batching picker + ext-proc gRPC + dual health + metrics), start the
+dedicated health listener before cache sync, serve, and stop gracefully on
+context/signal (internal/runnable/grpc.go:44-57 GracefulStop).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from gie_tpu.api.types import GROUP
+from gie_tpu.controller.cluster import ClusterClient
+from gie_tpu.controller.reconcilers import (
+    InferencePoolReconciler,
+    PodReconciler,
+    wire,
+)
+from gie_tpu.datastore import Datastore
+from gie_tpu.extproc.server import StreamingServer
+from gie_tpu.extproc.service import add_extproc_service
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.metricsio.mappings import BY_NAME
+from gie_tpu.metricsio.scrape import Scraper
+from gie_tpu.runtime import metrics as own_metrics
+from gie_tpu.runtime.health import HealthService, start_dedicated_health_server
+from gie_tpu.runtime.logging import get_logger
+from gie_tpu.runtime.options import Options
+from gie_tpu.runtime.tls import server_credentials
+from gie_tpu.sched.batching import BatchingTPUPicker
+from gie_tpu.sched.profile import Scheduler
+from gie_tpu.utils.kubemeta import GKNN
+from gie_tpu.utils.lora import LoraRegistry
+
+
+class ExtProcServerRunner:
+    def __init__(
+        self,
+        opts: Options,
+        cluster: ClusterClient,
+        scheduler: Optional[Scheduler] = None,
+    ):
+        self.opts = opts
+        self.log = get_logger("runner")
+        self.cluster = cluster
+        self.lora_registry = LoraRegistry()
+        self.scheduler = scheduler if scheduler is not None else Scheduler()
+        self.metrics_store = MetricsStore()
+        self.mapping = BY_NAME[opts.model_server_type]
+        self.scraper = Scraper(
+            self.metrics_store,
+            lora=self.lora_registry,
+            interval_s=opts.scrape_interval_ms / 1000.0,
+        )
+        self.datastore = Datastore(on_slot_reclaimed=self._slot_reclaimed)
+        self._attach_lock = threading.Lock()
+        self.picker = BatchingTPUPicker(
+            self.scheduler,
+            self.datastore,
+            self.metrics_store,
+            max_wait_s=opts.batch_window_ms / 1000.0,
+            lora_registry=self.lora_registry,
+        )
+        self.streaming = StreamingServer(
+            self.datastore, self.picker, on_served=self.picker.observe_served
+        )
+        self.grpc_server: Optional[grpc.Server] = None
+        self.health_server: Optional[grpc.Server] = None
+        self._cert_reloader = None
+        self._stopped = threading.Event()
+
+    # -- scrape lifecycle follows endpoint lifecycle -----------------------
+
+    def _slot_reclaimed(self, slot: int) -> None:
+        self.scheduler.evict_endpoint(slot)
+        self.scraper.detach(slot)
+
+    def _sync_scrapers(self) -> None:
+        for ep in self.datastore.endpoints():
+            self.scraper.attach(
+                ep.slot, f"http://{ep.hostport}/metrics", self.mapping
+            )
+
+    # ---------------------------------------------------------------------
+
+    def setup(self) -> None:
+        """Wire reconcilers (reference SetupWithManager, runserver.go:78-93)."""
+        gknn = GKNN(GROUP, "InferencePool", self.opts.pool_namespace,
+                    self.opts.pool_name)
+        pool_rec = InferencePoolReconciler(self.cluster, self.datastore, gknn)
+        pod_rec = PodReconciler(self.cluster, self.datastore)
+        wire(self.cluster, pool_rec, pod_rec)
+
+        # Scrapers follow datastore content after every event.
+        original_pod = pod_rec.reconcile
+        original_pool = pool_rec.reconcile
+
+        def pod_reconcile(ns, name):
+            res = original_pod(ns, name)
+            self._sync_scrapers()
+            return res
+
+        def pool_reconcile(ns, name):
+            res = original_pool(ns, name)
+            self._sync_scrapers()
+            return res
+
+        pod_rec.reconcile = pod_reconcile
+        pool_rec.reconcile = pool_reconcile
+
+        # Initial sync: reconcile pre-existing state (the cache-sync pass of
+        # controller-runtime; watch events only cover changes from now on).
+        pool_reconcile(self.opts.pool_namespace, self.opts.pool_name)
+        for pod in self.cluster.list_pods(self.opts.pool_namespace):
+            pod_reconcile(pod.namespace, pod.name)
+
+    def start(self) -> int:
+        """Start health, metrics, and the ext-proc listener; returns the
+        bound ext-proc port."""
+        # Dedicated health first — NOT_SERVING beats connection-refused
+        # during startup (reference main.go:104-109).
+        self.health_server, _ = start_dedicated_health_server(
+            self.datastore.pool_has_synced, self.opts.grpc_health_port
+        )
+        try:
+            own_metrics.start_metrics_server(self.opts.metrics_port)
+        except OSError as e:
+            self.log.error("metrics server failed to start", err=e)
+
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=64))
+        add_extproc_service(server, self.streaming)
+        # Colocated health on the ext-proc port (runserver.go:117-123).
+        HealthService(self.datastore.pool_has_synced).add_to_server(server)
+        addr = f"0.0.0.0:{self.opts.grpc_port}"
+        if self.opts.secure_serving:
+            creds, self._cert_reloader = server_credentials(self.opts.cert_path)
+            port = server.add_secure_port(addr, creds)
+        else:
+            port = server.add_insecure_port(addr)
+        if port == 0:
+            raise OSError(f"failed to bind ext-proc port {addr}")
+        server.start()
+        self.grpc_server = server
+        self.log.info(
+            "ext-proc server started",
+            port=port,
+            secure=self.opts.secure_serving,
+            health_port=self.opts.grpc_health_port,
+            metrics_port=self.opts.metrics_port,
+        )
+        return port
+
+    def wait(self) -> None:
+        if self.grpc_server is not None:
+            self.grpc_server.wait_for_termination()
+
+    def stop(self, grace: float = 5.0) -> None:
+        """Graceful stop (reference grpc.go:44-57)."""
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        if self.grpc_server is not None:
+            self.grpc_server.stop(grace).wait()
+        if self.health_server is not None:
+            self.health_server.stop(0)
+        self.picker.close()
+        self.scraper.close()
+        if self._cert_reloader is not None:
+            self._cert_reloader.close()
+        self.log.info("shutdown complete")

@@ -28,7 +28,7 @@ from gie_tpu.extproc.server import (
 from gie_tpu.extproc import metadata as mdkeys
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.hashing import batch_chunk_hashes
-from gie_tpu.sched.profile import Scheduler
+from gie_tpu.sched.profile import Scheduler, request_cost_host
 from gie_tpu.sched.types import RequestBatch
 from gie_tpu.utils.lora import LoraRegistry
 
@@ -145,8 +145,10 @@ class BatchingTPUPicker:
         crit = np.full((n,), C.Criticality.STANDARD, np.int32)
         plen = np.zeros((n,), np.float32)
         mask = np.zeros((n, C.M_MAX), bool)
+        hinted = np.zeros((n,), bool)
         for i, it in enumerate(batch):
             lora[i] = self.lora_registry.id_for(it.req.model)
+            hinted[i] = it.req.subset_hinted
             obj = it.req.headers.get(mdkeys.OBJECTIVE_KEY, [""])[0].lower()
             crit[i] = _CRITICALITY_BY_NAME.get(obj, C.Criticality.STANDARD)
             plen[i] = float(len(prompts[i]))
@@ -163,7 +165,7 @@ class BatchingTPUPicker:
             chunk_hashes=jnp.asarray(hashes),
             n_chunks=jnp.asarray(counts),
             subset_mask=jnp.asarray(mask),
-            had_subset_hint=jnp.ones((n,), bool),
+            had_subset_hint=jnp.asarray(hinted),
         )
         endpoints = self.datastore.endpoints()
         eps = self.metrics_store.endpoint_batch(endpoints)
@@ -191,8 +193,6 @@ class BatchingTPUPicker:
                     )
                 else:
                     res = PickResult(endpoint=picked[0], fallbacks=picked[1:])
-                    res.assumed_cost = float(
-                        np.clip(plen[i] / 2048.0, 0.25, 8.0)
-                    )
+                    res.assumed_cost = request_cost_host(float(plen[i]))
                     item.result = res
             item.event.set()

@@ -78,6 +78,12 @@ def request_cost(reqs: RequestBatch) -> jax.Array:
     return jnp.clip((reqs.prompt_len + reqs.decode_len) / 2048.0, 0.25, 8.0)
 
 
+def request_cost_host(prompt_len: float, decode_len: float = 0.0) -> float:
+    """Host-side twin of request_cost — completion feedback MUST release
+    exactly what pick time charged, so both paths share these constants."""
+    return float(np.clip((prompt_len + decode_len) / 2048.0, 0.25, 8.0))
+
+
 def scheduling_cycle(
     state: SchedState,
     reqs: RequestBatch,

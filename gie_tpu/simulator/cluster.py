@@ -27,7 +27,7 @@ from gie_tpu.metricsio.mappings import VLLM
 from gie_tpu.metricsio.scrape import parse_scrape
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.hashing import batch_chunk_hashes
-from gie_tpu.sched.profile import ProfileConfig, Scheduler
+from gie_tpu.sched.profile import ProfileConfig, Scheduler, request_cost_host
 from gie_tpu.sched.types import RequestBatch, Weights
 from gie_tpu.simulator.vllm_stub import StubConfig, VLLMStub
 from gie_tpu.utils.lora import LoraRegistry
@@ -163,12 +163,9 @@ class SimCluster:
                 for comp in stub.step(dt):
                     completions.append(comp)
                     if scheduler is not None and policy == "tpu":
-                        # Release exactly what pick time charged
-                        # (profile.request_cost on prompt_len + decode_len).
-                        cost = np.clip(
-                            (comp.prompt_bytes + comp.output_tokens) / 2048.0,
-                            0.25,
-                            8.0,
+                        # Release exactly what pick time charged.
+                        cost = request_cost_host(
+                            comp.prompt_bytes, comp.output_tokens
                         )
                         scheduler.complete(
                             np.asarray([slot], np.int32),

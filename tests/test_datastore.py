@@ -233,3 +233,24 @@ def test_nonmatching_pod_labels_not_admitted():
     cluster.apply_pool(make_api_pool())
     cluster.apply_pod(make_pod(labels={"app": "nope"}))
     assert ds.endpoints() == []
+
+
+def test_target_port_renumber_updates_existing_endpoints():
+    """targetPorts [8000]->[9000]: same rank, new port — picks must route
+    to the new port immediately."""
+    ds = Datastore()
+    pods = [make_pod()]
+    ds.pool_set(
+        EndpointPool(selector={"app": "vllm"}, target_ports=[8000],
+                     namespace="default"),
+        pod_lister=lambda: pods,
+    )
+    old_slot = ds.endpoints()[0].slot
+    ds.pool_set(
+        EndpointPool(selector={"app": "vllm"}, target_ports=[9000],
+                     namespace="default"),
+        pod_lister=lambda: pods,
+    )
+    eps = ds.endpoints()
+    assert [e.port for e in eps] == [9000]
+    assert eps[0].slot == old_slot  # rank identity (and slot) preserved

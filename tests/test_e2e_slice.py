@@ -161,3 +161,15 @@ def test_pod_churn_mid_traffic(stack):
         srv, headers={mdkeys.TEST_ENDPOINT_SELECTION_HEADER: "10.0.0.9"}
     )
     assert dest_header(stream.sent[0]) == "10.0.0.9:8000"
+
+
+def test_sheddable_429_headers_only_request(stack):
+    """Bodyless (end_of_stream on headers) sheddable request must also get
+    the 429 ImmediateResponse, not a stream error (004 README:80)."""
+    srv, ds, ms, *_ = stack
+    for e in ds.endpoints():
+        ms.update(e.slot, {Metric.QUEUE_DEPTH: 500, Metric.KV_CACHE_UTIL: 0.99})
+    stream = run_request(srv, headers={mdkeys.OBJECTIVE_KEY: "sheddable"})
+    kinds = [r.WhichOneof("response") for r in stream.sent]
+    assert kinds == ["immediate_response"]
+    assert stream.sent[0].immediate_response.status_code == 429

@@ -1,0 +1,120 @@
+"""Runtime tier tests: options lifecycle, logging levels, TLS generation +
+hot reload, health gating, runner wiring."""
+
+import argparse
+import io
+import json
+import os
+import time
+
+import grpc
+import pytest
+
+from gie_tpu.runtime.logging import Logger, set_verbosity
+from gie_tpu.runtime.options import Options
+from gie_tpu.runtime.tls import CertReloader, create_self_signed_cert
+
+
+def make_opts(**kw):
+    parser = argparse.ArgumentParser()
+    Options.add_flags(parser)
+    args = parser.parse_args([])
+    opts = Options.from_args(args)
+    for k, v in kw.items():
+        setattr(opts, k, v)
+    return opts
+
+
+def test_options_defaults_match_reference():
+    """reference options.go:25-27 defaults."""
+    o = make_opts(pool_name="p")
+    assert (o.grpc_port, o.grpc_health_port, o.metrics_port) == (9002, 9003, 9090)
+    assert o.secure_serving
+    o.validate()
+
+
+def test_options_validation():
+    with pytest.raises(ValueError, match="pool-name"):
+        make_opts().validate()
+    with pytest.raises(ValueError, match="grpc-port"):
+        make_opts(pool_name="p", grpc_port=0).validate()
+    with pytest.raises(ValueError, match="-v"):
+        make_opts(pool_name="p", verbosity=9).validate()
+
+
+def test_logger_levels_and_structure():
+    buf = io.StringIO()
+    log = Logger("test", stream=buf, component="x")
+    set_verbosity(2)
+    log.v(4).info("hidden debug")
+    log.info("visible", key="val")
+    set_verbosity(5)
+    log.v(5).info("trace now visible")
+    set_verbosity(2)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert [x["msg"] for x in lines] == ["visible", "trace now visible"]
+    assert lines[0]["component"] == "x" and lines[0]["key"] == "val"
+    assert lines[1]["level"] == "trace"
+
+
+def test_self_signed_cert_valid():
+    """reference tls.go:33-74."""
+    cert_pem, key_pem = create_self_signed_cert()
+    from cryptography import x509
+    from cryptography.hazmat.primitives.serialization import load_pem_private_key
+
+    cert = x509.load_pem_x509_certificate(cert_pem)
+    key = load_pem_private_key(key_pem, None)
+    assert key.key_size == 4096
+    assert (cert.not_valid_after_utc - cert.not_valid_before_utc).days >= 3649
+    # usable as grpc server creds
+    grpc.ssl_server_credentials([(key_pem, cert_pem)])
+
+
+def test_cert_reloader_hot_swap(tmp_path):
+    """reference certs.go:35-103."""
+    c1, k1 = create_self_signed_cert("first")
+    cert_f, key_f = tmp_path / "tls.crt", tmp_path / "tls.key"
+    cert_f.write_bytes(c1)
+    key_f.write_bytes(k1)
+    r = CertReloader(str(cert_f), str(key_f), poll_s=0.05)
+    try:
+        assert r.current() == (c1, k1)
+        c2, k2 = create_self_signed_cert("second")
+        # ensure mtime actually changes on coarse filesystems
+        time.sleep(0.05)
+        cert_f.write_bytes(c2)
+        key_f.write_bytes(k2)
+        os.utime(cert_f)
+        deadline = time.time() + 5
+        while time.time() < deadline and r.current() == (c1, k1):
+            time.sleep(0.05)
+        assert r.current() == (c2, k2)
+    finally:
+        r.close()
+
+
+def test_health_gated_on_pool_sync():
+    """reference runserver.go:132-157: NOT_SERVING until PoolHasSynced."""
+    from gie_tpu.runtime.health import start_dedicated_health_server
+    import health_pb2  # available after the runtime.health import hook
+
+    ready = {"v": False}
+    server, port = start_dedicated_health_server(lambda: ready["v"], 0)
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        check = channel.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        resp = check(health_pb2.HealthCheckRequest(service=""))
+        assert resp.status == health_pb2.HealthCheckResponse.NOT_SERVING
+        ready["v"] = True
+        resp = check(health_pb2.HealthCheckRequest(service=""))
+        assert resp.status == health_pb2.HealthCheckResponse.SERVING
+        resp = check(health_pb2.HealthCheckRequest(service="bogus.Service"))
+        assert resp.status == health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+        channel.close()
+    finally:
+        server.stop(0)
