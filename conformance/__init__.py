@@ -1,0 +1,13 @@
+"""Conformance suite for TPU-native inference gateways.
+
+Re-expression of the reference conformance tier (reference conformance/:
+suite bootstrap, 13 Gateway-profile tests, report emission) against an
+in-process gateway simulator driving the REAL EPP components — protocol
+semantics, status choreography, and routing behavior are asserted exactly as
+the reference tests do, without requiring a Kubernetes cluster.
+"""
+
+from conformance.harness import ConformanceEnv
+from conformance.report import ConformanceReport
+
+__all__ = ["ConformanceEnv", "ConformanceReport"]

@@ -1,0 +1,407 @@
+"""ConformanceEnv: in-process cluster + inference-gateway data plane.
+
+Plays the role the real cluster + Envoy/Istio play for the reference suite
+(reference conformance/conformance.go:194-224 SetupConformanceTestSuite +
+the echo-backend fixtures of resources/base.yaml):
+
+  control plane — FakeCluster objects (InferencePool, Pods) + Gateways,
+      HTTPRoutes, Services; a gateway status controller maintaining the
+      per-parent conditions the tests assert (Accepted / ResolvedRefs /
+      EndpointPickerRefMissing / BackendNotFound semantics).
+  EPP — one REAL EPP stack per pool (Datastore + reconcilers + scheduler +
+      StreamingServer), with a replica count so tests can scale it to zero
+      (MakeServiceUnavailable, reference helpers.go:361-409).
+  data plane — send(): route matching (host + path prefix), weighted
+      backendRef selection, the full ext-proc exchange against the pool's
+      EPP (request headers/body -> destination header; response phase ->
+      served-endpoint echo), fail-open/fail-close per EndpointPickerRef.
+      failureMode, and echo-backend identity responses
+      (X-Echo-Set-Header reflection, reference Appendix B).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+from google.protobuf import struct_pb2
+
+from gie_tpu.api import types as api
+from gie_tpu.api.gateway import (
+    ROUTE_ACCEPTED,
+    ROUTE_REASON_ACCEPTED,
+    ROUTE_REASON_BACKEND_NOT_FOUND,
+    ROUTE_RESOLVED_REFS,
+    Gateway,
+    HTTPRoute,
+    Service,
+)
+from gie_tpu.controller import FakeCluster, InferencePoolReconciler, PodReconciler
+from gie_tpu.controller.reconcilers import wire
+from gie_tpu.datastore import Datastore, Pod
+from gie_tpu.extproc import StreamingServer, metadata as mdkeys, pb
+from gie_tpu.extproc.envoy import extract_metadata_values, get_header_value
+from gie_tpu.extproc.server import ExtProcError, RoundRobinPicker
+from gie_tpu.utils.kubemeta import GKNN
+
+GATEWAY_CONTROLLER_NAME = "gie-tpu.inference.networking.k8s.io/gateway"
+
+
+@dataclasses.dataclass
+class Response:
+    status: int
+    headers: dict[str, str]
+    body: bytes = b""
+    backend_pod: str = ""       # which echo pod served
+    protocol: str = "http"      # appProtocol used for the backend hop
+
+
+class _FakeStream:
+    def __init__(self, messages):
+        self.messages = list(messages)
+        self.sent = []
+
+    def recv(self):
+        return self.messages.pop(0) if self.messages else None
+
+    def send(self, resp):
+        self.sent.append(resp)
+
+
+class EppInstance:
+    """One EPP per pool: the real server components, plus a replica count so
+    the suite can take it down (EppUnAvailableFailOpen)."""
+
+    def __init__(self, env: "ConformanceEnv", pool_ns: str, pool_name: str):
+        self.datastore = Datastore()
+        self.server = StreamingServer(self.datastore, RoundRobinPicker())
+        self.replicas = 1
+        gknn = GKNN(api.GROUP, "InferencePool", pool_ns, pool_name)
+        self._pool_rec = InferencePoolReconciler(env.cluster, self.datastore, gknn)
+        self._pod_rec = PodReconciler(env.cluster, self.datastore)
+        wire(env.cluster, self._pool_rec, self._pod_rec)
+        # Initial sync for pre-existing objects.
+        self._pool_rec.reconcile(pool_ns, pool_name)
+        for pod in env.cluster.list_pods(pool_ns):
+            self._pod_rec.reconcile(pod.namespace, pod.name)
+
+    @property
+    def available(self) -> bool:
+        return self.replicas > 0
+
+
+class ConformanceEnv:
+    def __init__(self, seed: int = 0):
+        self.cluster = FakeCluster()
+        self.gateways: dict[str, Gateway] = {}
+        self.routes: dict[tuple[str, str], HTTPRoute] = {}
+        self.services: dict[tuple[str, str], Service] = {}
+        self.epps: dict[tuple[str, str], EppInstance] = {}
+        self._ip_counter = 0
+        self.rng = random.Random(seed)
+
+    # ---- resource application (manifest-equivalents) ---------------------
+
+    def apply_gateway(self, gw: Gateway) -> None:
+        self.gateways[gw.name] = gw
+        self._reconcile_statuses()
+
+    def apply_service(self, svc: Service) -> None:
+        self.services[(svc.namespace, svc.name)] = svc
+        self._reconcile_statuses()
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        self.services.pop((namespace, name), None)
+        self._reconcile_statuses()
+
+    def apply_pool(self, pool: api.InferencePool) -> None:
+        self.cluster.apply_pool(pool)
+        key = (pool.metadata.namespace, pool.metadata.name)
+        if key not in self.epps:
+            self.epps[key] = EppInstance(self, *key)
+        self._reconcile_statuses()
+
+    def delete_pool(self, namespace: str, name: str) -> None:
+        self.cluster.delete_pool(namespace, name)
+        self.epps.pop((namespace, name), None)
+        self._reconcile_statuses()
+
+    def apply_route(self, route: HTTPRoute) -> None:
+        self.routes[(route.namespace, route.name)] = route
+        self._reconcile_statuses()
+
+    def delete_route(self, namespace: str, name: str) -> None:
+        self.routes.pop((namespace, name), None)
+        self._reconcile_statuses()
+
+    def deploy_model_servers(
+        self,
+        prefix: str,
+        replicas: int,
+        labels: dict[str, str],
+        namespace: str = "default",
+        annotations: Optional[dict[str, str]] = None,
+    ) -> list[Pod]:
+        """Echo-backend deployment (reference base.yaml model servers ×3)."""
+        pods = []
+        for i in range(replicas):
+            self._ip_counter += 1
+            pod = Pod(
+                name=f"{prefix}-{i}",
+                namespace=namespace,
+                labels=dict(labels),
+                annotations=dict(annotations or {}),
+                ip=f"10.1.{self._ip_counter // 256}.{self._ip_counter % 256}",
+            )
+            self.cluster.apply_pod(pod)
+            pods.append(pod)
+        return pods
+
+    def scale_epp(self, namespace: str, pool: str, replicas: int) -> None:
+        """MakeServiceUnavailable / restore (reference helpers.go:361-409)."""
+        self.epps[(namespace, pool)].replicas = replicas
+
+    def get_pool(self, namespace: str, name: str) -> Optional[api.InferencePool]:
+        return self.cluster.get_pool(namespace, name)
+
+    # ---- status controller ----------------------------------------------
+
+    def _reconcile_statuses(self) -> None:
+        """Maintain pool + route per-parent conditions (the gateway
+        implementation's bookkeeping the conformance tests assert)."""
+        # Route conditions first (and collect pool parents on the way).
+        pool_parents: dict[tuple[str, str], set[str]] = {}
+        for route in self.routes.values():
+            for gw_name in route.parent_gateways:
+                ps = route.parent_status(gw_name)
+                if gw_name not in self.gateways:
+                    ps.set_condition(api.Condition(
+                        ROUTE_ACCEPTED, "False", "NoMatchingParent",
+                        "gateway not found"))
+                    continue
+                ps.set_condition(api.Condition(
+                    ROUTE_ACCEPTED, "True", ROUTE_REASON_ACCEPTED, "accepted"))
+                unresolved = []
+                for rule in route.rules:
+                    for ref in rule.backend_refs:
+                        if ref.kind != "InferencePool":
+                            continue
+                        key = (route.namespace, ref.name)
+                        if self.cluster.get_pool(*key) is None:
+                            unresolved.append(ref.name)
+                        else:
+                            pool_parents.setdefault(key, set()).add(gw_name)
+                if unresolved:
+                    ps.set_condition(api.Condition(
+                        ROUTE_RESOLVED_REFS, "False",
+                        ROUTE_REASON_BACKEND_NOT_FOUND,
+                        f"InferencePool not found: {unresolved}"))
+                else:
+                    ps.set_condition(api.Condition(
+                        ROUTE_RESOLVED_REFS, "True", "ResolvedRefs", "ok"))
+
+        # Pool per-parent conditions (reference api conditions, C1).
+        for (ns, name), parents in pool_parents.items():
+            pool = self.cluster.get_pool(ns, name)
+            if pool is None:
+                continue
+            new_parents = []
+            for gw_name in sorted(parents):
+                parent = api.ParentStatus(
+                    parentRef=api.ParentReference(name=gw_name)
+                )
+                parent.set_condition(api.Condition(
+                    api.COND_ACCEPTED, "True", api.REASON_ACCEPTED,
+                    "supported by parent"))
+                epp = pool.spec.endpointPickerRef
+                if epp is None:
+                    # This implementation supports EPP-less pools (plain
+                    # round-robin), so Accepted stays True
+                    # (InferencePoolMissingEPPRef allows either semantic).
+                    parent.set_condition(api.Condition(
+                        api.COND_RESOLVED_REFS, "True",
+                        api.REASON_RESOLVED_REFS, "no endpointPickerRef"))
+                elif (ns, epp.name) not in self.services:
+                    parent.set_condition(api.Condition(
+                        api.COND_RESOLVED_REFS, "False",
+                        api.REASON_INVALID_EXTENSION_REF,
+                        f"BackendNotFound: Service {epp.name}"))
+                else:
+                    parent.set_condition(api.Condition(
+                        api.COND_RESOLVED_REFS, "True",
+                        api.REASON_RESOLVED_REFS, "ok"))
+                new_parents.append(parent)
+            pool.status.parents = new_parents
+
+        # Pools no longer referenced by any route lose their parent status
+        # (InferencePoolResolvedRefsCondition clear-on-change semantics).
+        for (ns, name), _epp in self.epps.items():
+            pool = self.cluster.get_pool(ns, name)
+            if pool is not None and (ns, name) not in pool_parents:
+                pool.status.parents = []
+
+    # ---- data plane ------------------------------------------------------
+
+    def send(
+        self,
+        gateway: str,
+        host: str,
+        path: str,
+        headers: Optional[dict[str, str]] = None,
+        body: bytes = b"",
+        method: str = "GET",
+    ) -> Response:
+        """One HTTP request through the gateway."""
+        headers = dict(headers or {})
+        route, rule = self._match_route(gateway, host, path)
+        if route is None or rule is None:
+            return Response(404, {}, b"no matching route")
+        ref = self._pick_backend(rule)
+        if ref.kind != "InferencePool":
+            return Response(500, {}, b"non-pool backends not modeled")
+        pool = self.cluster.get_pool(route.namespace, ref.name)
+        if pool is None:
+            return Response(500, {}, b"backend not found")
+        # NOTE: ref.port for InferencePool backends is IGNORED by contract
+        # (reference inferencepool_httproute_port_validation.go scenario 3).
+        epp = self.epps[(route.namespace, ref.name)]
+        return self._forward(pool, epp, headers, body)
+
+    def _match_route(self, gateway, host, path):
+        best = (None, None, -1)
+        for route in self.routes.values():
+            if gateway not in route.parent_gateways:
+                continue
+            if route.hostnames and host not in route.hostnames:
+                continue
+            for rule in route.rules:
+                p = rule.path_prefix
+                if path.startswith(p) and len(p) > best[2]:
+                    best = (route, rule, len(p))
+        return best[0], best[1]
+
+    def _pick_backend(self, rule):
+        total = sum(max(r.weight, 0) for r in rule.backend_refs)
+        if total <= 0:
+            return rule.backend_refs[0]
+        x = self.rng.uniform(0, total)
+        acc = 0.0
+        for ref in rule.backend_refs:
+            acc += max(ref.weight, 0)
+            if x <= acc:
+                return ref
+        return rule.backend_refs[-1]
+
+    def _forward(self, pool, epp: EppInstance, headers, body) -> Response:
+        failure_mode = (
+            pool.spec.endpointPickerRef.failureMode
+            if pool.spec.endpointPickerRef is not None
+            else api.FAIL_CLOSE
+        )
+        has_epp = pool.spec.endpointPickerRef is not None
+        ready = epp.datastore.endpoints()
+
+        if not has_epp or not epp.available:
+            # EPP-less pool or EPP down: fail-open routes to any ready
+            # endpoint, fail-close rejects (004 README failure semantics).
+            if not has_epp or failure_mode == api.FAIL_OPEN:
+                if not ready:
+                    return Response(503, {}, b"no ready endpoints")
+                ep = self.rng.choice(ready)
+                return self._echo(pool, ep.hostport, {}, body)
+            return Response(503, {}, b"EPP unavailable (FailClose)")
+
+        # Real ext-proc exchange against the pool's EPP.
+        hm = pb.HeaderMap()
+        for k, v in headers.items():
+            hm.headers.append(pb.HeaderValue(key=k, raw_value=v.encode()))
+        msgs = [pb.ProcessingRequest(
+            request_headers=pb.HttpHeaders(headers=hm, end_of_stream=not body))]
+        if body:
+            msgs.append(pb.ProcessingRequest(
+                request_body=pb.HttpBody(body=body, end_of_stream=True)))
+        stream = _FakeStream(msgs)
+        try:
+            epp.server.process(stream)
+        except ExtProcError as e:
+            if failure_mode == api.FAIL_OPEN and ready:
+                ep = self.rng.choice(ready)
+                return self._echo(pool, ep.hostport, {}, body)
+            status = 503 if e.code.name in ("UNAVAILABLE",) else 500
+            return Response(status, {}, e.message.encode())
+
+        if stream.sent and stream.sent[0].WhichOneof("response") == "immediate_response":
+            imm = stream.sent[0].immediate_response
+            return Response(imm.status_code, {}, imm.body)
+
+        # Extract destination from the headers response; verify the dual
+        # dynamic-metadata signal agrees (004 README:46-82).
+        hdr_resp = stream.sent[0]
+        mutation = hdr_resp.request_headers.response.header_mutation
+        set_headers = {
+            o.header.key: get_header_value(o.header) for o in mutation.set_headers
+        }
+        dest = set_headers.get(mdkeys.DESTINATION_ENDPOINT_KEY, "")
+        md = hdr_resp.dynamic_metadata
+        lb = md.fields.get(mdkeys.DESTINATION_ENDPOINT_NAMESPACE)
+        md_dest = (
+            lb.struct_value.fields[mdkeys.DESTINATION_ENDPOINT_KEY].string_value
+            if lb is not None else ""
+        )
+        if dest != md_dest:
+            return Response(500, {}, b"header/metadata destination mismatch")
+
+        # Walk the ordered fallback list to a live endpoint.
+        by_hostport = {e.hostport: e for e in ready}
+        chosen = None
+        for candidate in [d.strip() for d in dest.split(",") if d.strip()]:
+            if candidate in by_hostport:
+                chosen = candidate
+                break
+        if chosen is None:
+            return Response(503, {}, b"no live destination")
+
+        # Forward to the echo backend, honoring X-Echo-Set-Header.
+        echo_extra = {}
+        if "X-Echo-Set-Header" in set_headers:
+            k, _, v = set_headers["X-Echo-Set-Header"].partition(":")
+            echo_extra[k.strip()] = v.strip()
+        resp = self._echo(pool, chosen, echo_extra, body)
+
+        # Response phase: report the served endpoint back to the EPP
+        # (004 README:84-101) and apply its response-header mutation.
+        served_req = pb.ProcessingRequest(response_headers=pb.HttpHeaders())
+        st = struct_pb2.Struct()
+        st.fields[mdkeys.DESTINATION_ENDPOINT_SERVED_KEY].string_value = chosen
+        served_req.metadata_context.filter_metadata[
+            mdkeys.DESTINATION_ENDPOINT_NAMESPACE].CopyFrom(st)
+        s2 = _FakeStream([served_req])
+        epp.server.process(s2)
+        if s2.sent:
+            mut = s2.sent[0].response_headers.response.header_mutation
+            for o in mut.set_headers:
+                resp.headers[o.header.key] = get_header_value(o.header)
+        return resp
+
+    def _echo(self, pool, hostport, extra_headers, body) -> Response:
+        """The echo-basic model-server stand-in: identifies its pod
+        (reference base.yaml:80,124) and reflects requested headers."""
+        ip = hostport.rsplit(":", 1)[0]
+        pod = next(
+            (p for p in self.cluster.list_pods(pool.metadata.namespace)
+             if p.ip == ip),
+            None,
+        )
+        if pod is None:
+            return Response(503, {}, b"endpoint pod gone")
+        headers = dict(extra_headers)
+        headers["x-pod-name"] = pod.name
+        return Response(
+            200,
+            headers,
+            b"echo from " + pod.name.encode(),
+            backend_pod=pod.name,
+            protocol="h2c" if pool.spec.appProtocol == api.APP_PROTOCOL_H2C
+            else "http",
+        )

@@ -1,0 +1,50 @@
+"""Standalone conformance runner (reference conformance.go:149-192
+RunConformanceWithOptions): runs every registered test against a fresh
+environment and writes the versioned ConformanceReport.
+
+    python -m conformance.run [--report PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import traceback
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="gie-tpu-conformance")
+    parser.add_argument("--report", default="conformance-report.yaml")
+    args = parser.parse_args(argv)
+
+    # The suite lives in tests/test_conformance.py; reuse its registry.
+    sys.path.insert(0, ".")
+    from conformance.report import ConformanceReport
+    import tests.test_conformance as suite
+
+    report = ConformanceReport()
+    tests = [
+        (name, fn)
+        for name, fn in vars(suite).items()
+        if name.startswith("test_") and name != "test_zzz_emit_report"
+        and callable(fn)
+    ]
+    failed = 0
+    for name, fn in tests:
+        env = suite.env.__wrapped__()  # the fixture body builds the env
+        try:
+            fn(env)
+            print(f"PASS {name}")
+        except Exception:
+            failed += 1
+            print(f"FAIL {name}")
+            traceback.print_exc()
+    # The @record decorators filled suite.REPORT; merge into ours.
+    report.results = suite.REPORT.results
+    report.write(args.report)
+    print(f"report written to {args.report}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

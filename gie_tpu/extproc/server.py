@@ -51,9 +51,6 @@ class PickRequest:
     headers: dict[str, list[str]]
     body: Optional[bytes] = None
     model: str = ""
-    # True when the data plane supplied an explicit candidate subset
-    # (metadata hint or test steering header).
-    subset_hinted: bool = False
 
 
 @dataclasses.dataclass
@@ -99,7 +96,6 @@ class RoundRobinPicker:
 class RequestContext:
     headers: dict[str, list[str]] = dataclasses.field(default_factory=dict)
     candidates: list = dataclasses.field(default_factory=list)
-    subset_hinted: bool = False
     target_endpoint: str = ""
     selected_pod_ip: str = ""
 
@@ -124,6 +120,15 @@ class StreamingServer:
     # ------------------------------------------------------------------ #
 
     def process(self, stream: Stream) -> None:
+        from gie_tpu.runtime import metrics as own_metrics
+
+        own_metrics.STREAMS.inc()
+        try:
+            self._process(stream)
+        finally:
+            own_metrics.STREAMS.dec()
+
+    def _process(self, stream: Stream) -> None:
         ctx = RequestContext()
         body = bytearray()
         headers_deferred = False
@@ -246,7 +251,6 @@ class StreamingServer:
             raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "no pods available")
 
         if has_subset_filter or filter_endpoints:
-            ctx.subset_hinted = True
             # ip or ip:port entries; bare ip allows all ports
             # (reference request.go:104-129).
             allow_all_ports: set[str] = set()
@@ -273,12 +277,7 @@ class StreamingServer:
         if rewrite:
             model = rewrite[0]
         result = self.picker.pick(
-            PickRequest(
-                headers=ctx.headers,
-                body=body,
-                model=model,
-                subset_hinted=ctx.subset_hinted,
-            ),
+            PickRequest(headers=ctx.headers, body=body, model=model),
             ctx.candidates,
         )
         ctx.target_endpoint = result.destination_value

@@ -120,11 +120,20 @@ class Scraper:
         self.fetcher = fetcher
         self._stops: dict[int, threading.Event] = {}
         self._threads: dict[int, threading.Thread] = {}
+        self._urls: dict[int, str] = {}
         self._lock = threading.Lock()
 
     def attach(self, slot: int, url: str, mapping: ServerMapping) -> None:
         with self._lock:
-            if slot in self._threads:
+            if self._urls.get(slot) == url:
+                return
+            already = slot in self._threads
+        if already:
+            # Endpoint re-bound (port renumber / pod IP change): restart the
+            # poller at the new URL instead of polling the dead one forever.
+            self.detach(slot)
+        with self._lock:
+            if self._urls.get(slot) == url:
                 return
             stop = threading.Event()
             t = threading.Thread(
@@ -132,12 +141,14 @@ class Scraper:
             )
             self._stops[slot] = stop
             self._threads[slot] = t
+            self._urls[slot] = url
             t.start()
 
     def detach(self, slot: int) -> None:
         with self._lock:
             stop = self._stops.pop(slot, None)
             thread = self._threads.pop(slot, None)
+            self._urls.pop(slot, None)
         if stop is not None:
             stop.set()
         if thread is not None:

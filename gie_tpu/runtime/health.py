@@ -77,7 +77,9 @@ def start_dedicated_health_server(
     cmd/lwepp/main.go:104-109)."""
     from concurrent import futures
 
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    # Watch handlers hold a worker for their stream's lifetime; size the
+    # pool so long-lived watchers cannot starve Check probes.
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=10))
     HealthService(ready_fn).add_to_server(server)
     bound = server.add_insecure_port(f"0.0.0.0:{port}")
     if bound == 0:

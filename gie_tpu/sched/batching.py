@@ -14,10 +14,13 @@ for the whole wave — decoupling stream cadence from batch cadence
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import grpc
 import numpy as np
+
+from gie_tpu.runtime import metrics as own_metrics
 
 from gie_tpu.extproc.server import (
     ExtProcError,
@@ -42,7 +45,7 @@ _CRITICALITY_BY_NAME = {
 
 
 class _Pending:
-    __slots__ = ("req", "candidates", "event", "result", "error")
+    __slots__ = ("req", "candidates", "event", "result", "error", "enqueued_at")
 
     def __init__(self, req: PickRequest, candidates: list):
         self.req = req
@@ -50,6 +53,7 @@ class _Pending:
         self.event = threading.Event()
         self.result: Optional[PickResult] = None
         self.error: Optional[Exception] = None
+        self.enqueued_at = time.monotonic()
 
 
 class BatchingTPUPicker:
@@ -144,11 +148,10 @@ class BatchingTPUPicker:
         lora = np.full((n,), -1, np.int32)
         crit = np.full((n,), C.Criticality.STANDARD, np.int32)
         plen = np.zeros((n,), np.float32)
+        own_metrics.BATCH_SIZE.observe(n)
         mask = np.zeros((n, C.M_MAX), bool)
-        hinted = np.zeros((n,), bool)
         for i, it in enumerate(batch):
             lora[i] = self.lora_registry.id_for(it.req.model)
-            hinted[i] = it.req.subset_hinted
             obj = it.req.headers.get(mdkeys.OBJECTIVE_KEY, [""])[0].lower()
             crit[i] = _CRITICALITY_BY_NAME.get(obj, C.Criticality.STANDARD)
             plen[i] = float(len(prompts[i]))
@@ -165,7 +168,6 @@ class BatchingTPUPicker:
             chunk_hashes=jnp.asarray(hashes),
             n_chunks=jnp.asarray(counts),
             subset_mask=jnp.asarray(mask),
-            had_subset_hint=jnp.asarray(hinted),
         )
         endpoints = self.datastore.endpoints()
         eps = self.metrics_store.endpoint_batch(endpoints)
@@ -175,9 +177,12 @@ class BatchingTPUPicker:
         indices = np.asarray(result.indices)
         status = np.asarray(result.status)
         for i, item in enumerate(batch):
+            own_metrics.PICK_LATENCY.observe(time.monotonic() - item.enqueued_at)
             if status[i] == C.Status.SHED:
+                own_metrics.PICKS.labels(outcome="shed").inc()
                 item.error = ShedError()
             elif status[i] != C.Status.OK:
+                own_metrics.PICKS.labels(outcome="unavailable").inc()
                 item.error = ExtProcError(
                     grpc.StatusCode.UNAVAILABLE, "no endpoints available"
                 )
@@ -188,10 +193,12 @@ class BatchingTPUPicker:
                     if s >= 0 and s in by_slot
                 ]
                 if not picked:
+                    own_metrics.PICKS.labels(outcome="unavailable").inc()
                     item.error = ExtProcError(
                         grpc.StatusCode.UNAVAILABLE, "no endpoints available"
                     )
                 else:
+                    own_metrics.PICKS.labels(outcome="ok").inc()
                     res = PickResult(endpoint=picked[0], fallbacks=picked[1:])
                     res.assumed_cost = request_cost_host(float(plen[i]))
                     item.result = res

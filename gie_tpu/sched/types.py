@@ -68,7 +68,6 @@ class RequestBatch:
     chunk_hashes: jax.Array  # u32[N, MAX_CHUNKS] rolling prefix-chunk hashes
     n_chunks: jax.Array      # i32[N] number of valid chunk hashes
     subset_mask: jax.Array   # bool[N, M_MAX]
-    had_subset_hint: jax.Array  # bool[N] — True if the request carried a hint
 
     @staticmethod
     def empty(n: int, m: int = C.M_MAX) -> "RequestBatch":
@@ -81,7 +80,6 @@ class RequestBatch:
             chunk_hashes=jnp.zeros((n, C.MAX_CHUNKS), jnp.uint32),
             n_chunks=jnp.zeros((n,), jnp.int32),
             subset_mask=jnp.ones((n, m), bool),
-            had_subset_hint=jnp.zeros((n,), bool),
         )
 
 

@@ -231,7 +231,6 @@ class SimCluster:
                 chunk_hashes=jnp.asarray(hashes),
                 n_chunks=jnp.asarray(counts),
                 subset_mask=jnp.ones((n, C.M_MAX), bool),
-                had_subset_hint=jnp.zeros((n,), bool),
             )
             # Only the first self.n slots are valid endpoints.
             eps = self._endpoint_batch(now)

@@ -106,5 +106,4 @@ def make_requests(
         chunk_hashes=jnp.asarray(hashes),
         n_chunks=jnp.asarray(counts),
         subset_mask=jnp.asarray(mask),
-        had_subset_hint=jnp.asarray(hint),
     )

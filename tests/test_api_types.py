@@ -96,3 +96,24 @@ def test_parent_status_condition_replace():
     ps.set_condition(api.Condition(api.COND_ACCEPTED, "True", api.REASON_ACCEPTED))
     assert len(ps.conditions) == 1
     assert ps.get_condition(api.COND_ACCEPTED).status == "True"
+
+
+def test_crd_generation(tmp_path):
+    """CRD YAML emission with bundle-version annotation (reference
+    pkg/generator/main.go:35-106)."""
+    import yaml as _yaml
+
+    from gie_tpu.api import crdgen
+    from gie_tpu.version import BUNDLE_VERSION, BUNDLE_VERSION_ANNOTATION
+
+    paths = crdgen.generate(str(tmp_path))
+    assert len(paths) == 2
+    pool_crd = _yaml.safe_load(open(paths[0]))
+    assert pool_crd["metadata"]["name"] == "inferencepools.inference.networking.k8s.io"
+    assert pool_crd["metadata"]["annotations"][BUNDLE_VERSION_ANNOTATION] == BUNDLE_VERSION
+    spec = pool_crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]["spec"]
+    tp = spec["properties"]["targetPorts"]
+    assert tp["minItems"] == 1 and tp["maxItems"] == 8
+    assert "port number must be unique" in str(tp["x-kubernetes-validations"])
+    epp = spec["properties"]["endpointPickerRef"]
+    assert "has(self.port)" in str(epp["x-kubernetes-validations"])

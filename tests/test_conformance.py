@@ -1,0 +1,350 @@
+"""The conformance suite: the reference's 13 Gateway-profile core tests
+(reference conformance/tests/*.go, SURVEY.md C16 inventory) re-expressed
+against the in-process gateway + real EPP stack, plus report emission."""
+
+import collections
+
+import pytest
+
+from conformance import ConformanceEnv, ConformanceReport
+from gie_tpu.api import types as api
+from gie_tpu.api.gateway import (
+    ROUTE_ACCEPTED,
+    ROUTE_REASON_BACKEND_NOT_FOUND,
+    ROUTE_RESOLVED_REFS,
+    BackendRef,
+    Gateway,
+    HTTPRoute,
+    RouteRule,
+    Service,
+)
+from gie_tpu.extproc import metadata as mdkeys
+
+REPORT = ConformanceReport()
+
+
+def make_pool(name, selector, ports=(8000,), epp="epp-svc", failure_mode=api.FAIL_CLOSE,
+              app_protocol=api.APP_PROTOCOL_HTTP, namespace="default"):
+    return api.InferencePool(
+        metadata=api.ObjectMeta(name=name, namespace=namespace),
+        spec=api.InferencePoolSpec(
+            selector=api.LabelSelector(matchLabels=selector),
+            targetPorts=[api.Port(p) for p in ports],
+            appProtocol=app_protocol,
+            endpointPickerRef=(
+                api.EndpointPickerRef(name=epp, port=api.Port(9002),
+                                      failureMode=failure_mode)
+                if epp else None
+            ),
+        ),
+    )
+
+
+@pytest.fixture
+def env():
+    """Base resources (reference conformance/resources/base.yaml: gateways +
+    echo model-server deployments x3 + EPP service)."""
+    e = ConformanceEnv()
+    e.apply_gateway(Gateway("primary-gateway"))
+    e.apply_gateway(Gateway("secondary-gateway"))
+    e.apply_service(Service("epp-svc"))
+    e.deploy_model_servers("primary-model-server", 3, {"app": "primary"})
+    e.deploy_model_servers("secondary-model-server", 3, {"app": "secondary"})
+    return e
+
+
+def pool_condition(env, ns, name, parent, ctype):
+    pool = env.get_pool(ns, name)
+    for ps in pool.status.parents:
+        if ps.parentRef.name == parent:
+            return ps.get_condition(ctype)
+    return None
+
+
+def simple_route(name, gateway, pool, path="/", host=None):
+    return HTTPRoute(
+        name=name,
+        hostnames=[host] if host else [],
+        parent_gateways=[gateway],
+        rules=[RouteRule(path_prefix=path,
+                         backend_refs=[BackendRef(name=pool)])],
+    )
+
+
+def record(short_name):
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            try:
+                fn(*a, **kw)
+            except Exception:
+                REPORT.add(short_name, False)
+                raise
+            REPORT.add(short_name, True)
+        return wrapper
+    return deco
+
+
+# --- status-choreography tests --------------------------------------------
+
+
+@record("InferencePoolAccepted")
+def test_inferencepool_accepted(env):
+    """reference tests/inferencepool_accepted.go:38."""
+    env.apply_pool(make_pool("pool-a", {"app": "primary"}))
+    env.apply_route(simple_route("route-a", "primary-gateway", "pool-a"))
+    cond = pool_condition(env, "default", "pool-a", "primary-gateway",
+                          api.COND_ACCEPTED)
+    assert cond is not None and cond.status == "True"
+
+
+@record("InferencePoolResolvedRefsCondition")
+def test_inferencepool_resolvedrefs_add_and_clear(env):
+    """Parent status appears with the route ref and clears when the route
+    goes away (reference tests/inferencepool_resolvedrefs_condition.go:44)."""
+    env.apply_pool(make_pool("pool-b", {"app": "primary"}))
+    pool = env.get_pool("default", "pool-b")
+    assert pool.status.parents == []
+    env.apply_route(simple_route("route-b", "primary-gateway", "pool-b"))
+    cond = pool_condition(env, "default", "pool-b", "primary-gateway",
+                          api.COND_RESOLVED_REFS)
+    assert cond is not None and cond.status == "True"
+    env.delete_route("default", "route-b")
+    assert env.get_pool("default", "pool-b").status.parents == []
+
+
+@record("InferencePoolInvalidEPPService")
+def test_invalid_epp_service(env):
+    """Dangling EPP Service ref -> ResolvedRefs False/InvalidExtensionRef
+    (reference tests/inferencepool_invalid_epp_service.go:42)."""
+    env.apply_pool(make_pool("pool-c", {"app": "primary"}, epp="no-such-svc"))
+    env.apply_route(simple_route("route-c", "primary-gateway", "pool-c"))
+    cond = pool_condition(env, "default", "pool-c", "primary-gateway",
+                          api.COND_RESOLVED_REFS)
+    assert cond.status == "False"
+    assert cond.reason == api.REASON_INVALID_EXTENSION_REF
+
+
+@record("InferencePoolMissingEPPRef")
+def test_missing_epp_ref(env):
+    """endpointPickerRef is optional; this implementation accepts the pool
+    and serves it round-robin (reference
+    tests/inferencepool_missing_epp_ref.go:40 allows either semantic)."""
+    env.apply_pool(make_pool("pool-d", {"app": "primary"}, epp=None))
+    env.apply_route(
+        simple_route("route-d", "primary-gateway", "pool-d", path="/d"))
+    cond = pool_condition(env, "default", "pool-d", "primary-gateway",
+                          api.COND_ACCEPTED)
+    assert cond.status == "True"
+    resp = env.send("primary-gateway", "d.example.com", "/d")
+    assert resp.status == 200 and resp.backend_pod.startswith("primary-")
+
+
+@record("InferencePoolAppProtocol")
+def test_app_protocol(env):
+    """http default + h2c honored (reference
+    tests/inferencepool_appprotocol.go:39)."""
+    env.apply_pool(make_pool("pool-http", {"app": "primary"}, ports=(8000,)))
+    env.apply_pool(make_pool("pool-h2c", {"app": "secondary"}, ports=(8001,),
+                             app_protocol=api.APP_PROTOCOL_H2C))
+    env.apply_route(simple_route("route-http", "primary-gateway", "pool-http",
+                                 path="/http"))
+    env.apply_route(simple_route("route-h2c", "primary-gateway", "pool-h2c",
+                                 path="/h2c"))
+    assert env.send("primary-gateway", "x", "/http").protocol == "http"
+    assert env.send("primary-gateway", "x", "/h2c").protocol == "h2c"
+
+
+@record("InferencePoolHTTPRoutePortValidation")
+def test_port_validation(env):
+    """backendRef port unspecified/matching/non-matching all route fine —
+    port is ignored for InferencePool backends (reference
+    tests/inferencepool_httproute_port_validation.go scenarios 1-3)."""
+    env.apply_pool(make_pool("pool-e", {"app": "primary"}))
+    for name, path, port in (
+        ("route-port-unspec", "/unspec", None),
+        ("route-port-match", "/match", 8000),
+        ("route-port-mismatch", "/mismatch", 7777),
+    ):
+        env.apply_route(HTTPRoute(
+            name=name, parent_gateways=["primary-gateway"],
+            rules=[RouteRule(path_prefix=path,
+                             backend_refs=[BackendRef(name="pool-e", port=port)])],
+        ))
+        route = env.routes[("default", name)]
+        ps = route.parent_status("primary-gateway")
+        assert ps.get_condition(ROUTE_ACCEPTED).status == "True"
+        assert ps.get_condition(ROUTE_RESOLVED_REFS).status == "True"
+        resp = env.send("primary-gateway", "x", path)
+        assert resp.status == 200
+
+
+@record("HTTPRouteInvalidInferencePoolRef")
+def test_route_invalid_pool_ref(env):
+    """Route to a nonexistent pool: Accepted=True, ResolvedRefs=False/
+    BackendNotFound (reference tests/httproute_invalid_inferencepool_ref.go:38)."""
+    env.apply_route(simple_route("route-f", "primary-gateway", "ghost-pool"))
+    ps = env.routes[("default", "route-f")].parent_status("primary-gateway")
+    assert ps.get_condition(ROUTE_ACCEPTED).status == "True"
+    rr = ps.get_condition(ROUTE_RESOLVED_REFS)
+    assert rr.status == "False" and rr.reason == ROUTE_REASON_BACKEND_NOT_FOUND
+
+
+# --- routing tests ---------------------------------------------------------
+
+
+@record("GatewayFollowingEPPRouting")
+def test_gateway_follows_epp_routing(env):
+    """100 requests steered to subsets of 1/2/3 pods must ONLY reach those
+    pods (reference tests/gateway_following_epp_routing.go:114-213)."""
+    env.apply_pool(make_pool("pool-g", {"app": "primary"}))
+    env.apply_route(simple_route("route-g", "primary-gateway", "pool-g"))
+    pods = env.cluster.list_pods("default")
+    primary = [p for p in pods if p.labels.get("app") == "primary"]
+    for subset_size in (1, 2, 3):
+        subset = primary[:subset_size]
+        allowed = {p.name for p in subset}
+        steering = ",".join(p.ip for p in subset)
+        served = collections.Counter()
+        for _ in range(100):
+            resp = env.send(
+                "primary-gateway", "x", "/",
+                headers={mdkeys.TEST_ENDPOINT_SELECTION_HEADER: steering},
+            )
+            assert resp.status == 200
+            served[resp.backend_pod] += 1
+        assert set(served) <= allowed, f"misroutes: {served} vs {allowed}"
+        if subset_size > 1:
+            assert len(served) > 1  # load actually spreads across the subset
+
+
+@record("GatewayFollowingEPPRoutingWithDataParallelism")
+def test_epp_routing_dp_ranks(env):
+    """Multiple targetPorts = DP ranks; steering by ip:port must hit the
+    exact rank (reference tests/gateway_following_epp_routing_dp.go:54)."""
+    env.apply_pool(make_pool("pool-dp", {"app": "primary"},
+                             ports=(3000, 3002, 3004)))
+    env.apply_route(simple_route("route-dp", "primary-gateway", "pool-dp"))
+    pod = [p for p in env.cluster.list_pods("default")
+           if p.labels.get("app") == "primary"][0]
+    for port in (3000, 3002, 3004):
+        resp = env.send(
+            "primary-gateway", "x", "/",
+            headers={mdkeys.TEST_ENDPOINT_SELECTION_HEADER: f"{pod.ip}:{port}"},
+        )
+        assert resp.status == 200
+        assert resp.backend_pod == pod.name
+
+
+@record("HTTPRouteMultipleGatewaysDifferentPools")
+def test_multiple_gateways_different_pools(env):
+    """Two gateways -> two pools stay isolated (reference
+    tests/httproute_multiple_gateways_different_pools.go:36)."""
+    env.apply_pool(make_pool("pool-p", {"app": "primary"}))
+    env.apply_pool(make_pool("pool-s", {"app": "secondary"}, ports=(8001,)))
+    env.apply_route(simple_route("route-p", "primary-gateway", "pool-p"))
+    env.apply_route(simple_route("route-s", "secondary-gateway", "pool-s"))
+    for _ in range(20):
+        assert env.send("primary-gateway", "x", "/").backend_pod.startswith(
+            "primary-")
+        assert env.send("secondary-gateway", "x", "/").backend_pod.startswith(
+            "secondary-")
+
+
+@record("HTTPRouteMultipleRulesDifferentPools")
+def test_multiple_rules_different_pools(env):
+    """One route, two rules -> two pools (reference
+    tests/inferencepool_multiple_rules_different_pools.go:37)."""
+    env.apply_pool(make_pool("pool-r1", {"app": "primary"}))
+    env.apply_pool(make_pool("pool-r2", {"app": "secondary"}, ports=(8001,)))
+    env.apply_route(HTTPRoute(
+        name="route-two-rules", parent_gateways=["primary-gateway"],
+        rules=[
+            RouteRule(path_prefix="/one",
+                      backend_refs=[BackendRef(name="pool-r1")]),
+            RouteRule(path_prefix="/two",
+                      backend_refs=[BackendRef(name="pool-r2")]),
+        ],
+    ))
+    for _ in range(10):
+        assert env.send("primary-gateway", "x", "/one").backend_pod.startswith(
+            "primary-")
+        assert env.send("primary-gateway", "x", "/two").backend_pod.startswith(
+            "secondary-")
+
+
+@record("GatewayWeightedAcrossTwoInferencePools")
+def test_weighted_two_pools(env):
+    """Weighted backendRef split across pools (reference
+    tests/gateway_weighted_two_pools.go:51)."""
+    env.apply_pool(make_pool("pool-w1", {"app": "primary"}))
+    env.apply_pool(make_pool("pool-w2", {"app": "secondary"}, ports=(8001,)))
+    env.apply_route(HTTPRoute(
+        name="route-weighted", parent_gateways=["primary-gateway"],
+        rules=[RouteRule(
+            path_prefix="/",
+            backend_refs=[BackendRef(name="pool-w1", weight=9),
+                          BackendRef(name="pool-w2", weight=1)],
+        )],
+    ))
+    hits = collections.Counter()
+    for _ in range(300):
+        resp = env.send("primary-gateway", "x", "/")
+        assert resp.status == 200
+        hits["w1" if resp.backend_pod.startswith("primary-") else "w2"] += 1
+    assert hits["w1"] > hits["w2"] * 3  # 9:1 split, generous tolerance
+    assert hits["w2"] > 0
+
+
+@record("EppUnAvailableFailOpen")
+def test_epp_unavailable_fail_open(env):
+    """Traffic still served when the EPP is scaled to 0 with FailOpen;
+    FailClose rejects (reference tests/epp_unavailable_fail_open.go:40)."""
+    env.apply_pool(make_pool("pool-open", {"app": "primary"},
+                             failure_mode=api.FAIL_OPEN))
+    env.apply_pool(make_pool("pool-close", {"app": "secondary"}, ports=(8001,),
+                             failure_mode=api.FAIL_CLOSE))
+    env.apply_route(simple_route("route-open", "primary-gateway", "pool-open",
+                                 path="/open"))
+    env.apply_route(simple_route("route-close", "primary-gateway", "pool-close",
+                                 path="/close"))
+    # Phase 1: baseline with EPP up, steered to a specific pod.
+    pod = [p for p in env.cluster.list_pods("default")
+           if p.labels.get("app") == "primary"][0]
+    resp = env.send("primary-gateway", "x", "/open",
+                    headers={mdkeys.TEST_ENDPOINT_SELECTION_HEADER: pod.ip})
+    assert resp.status == 200 and resp.backend_pod == pod.name
+    # Phase 2: EPP down.
+    env.scale_epp("default", "pool-open", 0)
+    env.scale_epp("default", "pool-close", 0)
+    for _ in range(10):
+        assert env.send("primary-gateway", "x", "/open").status == 200
+    assert env.send("primary-gateway", "x", "/close").status == 503
+
+
+@record("GatewayDestinationEndpointServed")
+def test_destination_endpoint_served(env):
+    """Data plane reports the served endpoint back; EPP echoes it on the
+    response (reference tests/gateway_destination_endpoint_served.go:40)."""
+    env.apply_pool(make_pool("pool-served", {"app": "primary"}))
+    env.apply_route(simple_route("route-served", "primary-gateway",
+                                 "pool-served"))
+    resp = env.send("primary-gateway", "x", "/")
+    assert resp.status == 200
+    served = resp.headers.get(mdkeys.CONFORMANCE_TEST_RESULT_HEADER)
+    assert served is not None
+    pod = next(p for p in env.cluster.list_pods("default")
+               if p.name == resp.backend_pod)
+    assert served.startswith(pod.ip + ":")
+
+
+def test_zzz_emit_report(tmp_path):
+    """Write the versioned ConformanceReport (reference
+    conformancereport.go:39-56). Runs last by name ordering."""
+    path = tmp_path / "report.yaml"
+    REPORT.write(str(path))
+    text = path.read_text()
+    assert "ConformanceReport" in text
+    assert "Passed" in text
