@@ -1,0 +1,38 @@
+# Build / test / release targets (reference Makefile parity, C19).
+
+PY ?= python
+
+.PHONY: all native generate test test-unit test-conformance bench bench-goodput clean
+
+all: native generate
+
+# Native fast paths (C++ chunker).
+native:
+	$(MAKE) -C native
+
+# CRD manifests (reference `make generate`).
+generate:
+	$(PY) -m gie_tpu.api.crdgen config/crd/bases
+
+# Full test tier: unit + conformance on the virtual 8-device CPU mesh.
+test:
+	$(PY) -m pytest tests/ -q
+
+test-unit:
+	$(PY) -m pytest tests/ -q --ignore=tests/test_conformance.py
+
+# Conformance suite with report emission (reference `go test ./conformance`).
+test-conformance:
+	$(PY) -m conformance.run --report conformance-report.yaml
+
+# Headline TPU benchmark (driver metric).
+bench:
+	$(PY) bench.py
+
+# Cluster-goodput benchmark vs the least-kv baseline.
+bench-goodput:
+	$(PY) bench_goodput.py
+
+clean:
+	$(MAKE) -C native clean
+	rm -f conformance-report.yaml

@@ -1,0 +1,98 @@
+"""BBR plugin chain (reference proposal 1964).
+
+The shared-parse rule (1964 README:59): the body is JSON-parsed at most once
+per request into the OpenAI completion/chat shape; every plugin receives the
+same read-only dict. Plugins return (headers-to-set, mutated-body-or-None);
+the chain folds mutations left to right.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Protocol
+
+from gie_tpu.api.modelrewrite import RewriteEngine
+
+# The header BBR sets for gateway routing on extracted model names
+# (reference BBR default MetadataExtractor semantics). Canonical constant
+# lives with the other protocol keys.
+from gie_tpu.extproc.metadata import MODEL_NAME_HEADER as MODEL_HEADER
+
+
+class BBRPlugin(Protocol):
+    name: str
+
+    def execute(
+        self, body: bytes, parsed: Optional[dict]
+    ) -> tuple[dict[str, str], Optional[bytes]]: ...
+
+
+class ModelExtractorPlugin:
+    """Default plugin (1964 DefaultPluginImplementation
+    'simple-model-selector'): extract `model` from the body into
+    X-Gateway-Model-Name."""
+
+    name = "simple-model-selector"
+
+    def execute(self, body, parsed):
+        if parsed and isinstance(parsed.get("model"), str):
+            return {MODEL_HEADER: parsed["model"]}, None
+        return {}, None
+
+
+class ModelRewritePlugin:
+    """InferenceModelRewrite enforcement: rewrite the body's model per the
+    merged rule set and surface the final name in the model header + the
+    rewrite header (proposal 1816 + metadata ModelNameRewriteKey)."""
+
+    name = "model-rewrite"
+
+    def __init__(self, engine: RewriteEngine, pool: str, namespace: str = "default"):
+        self.engine = engine
+        self.pool = pool
+        self.namespace = namespace
+
+    def execute(self, body, parsed):
+        if not parsed or not isinstance(parsed.get("model"), str):
+            return {}, None
+        model = parsed["model"]
+        target = self.engine.resolve(self.pool, model, self.namespace)
+        if target is None or target == model:
+            return {}, None
+        mutated = dict(parsed)
+        mutated["model"] = target
+        from gie_tpu.extproc import metadata as mdkeys
+
+        return (
+            {MODEL_HEADER: target, mdkeys.MODEL_NAME_REWRITE_KEY: target},
+            json.dumps(mutated).encode(),
+        )
+
+
+class PluginChain:
+    def __init__(self, plugins: list[BBRPlugin]):
+        self.plugins = list(plugins)
+
+    def execute(self, body: bytes) -> tuple[dict[str, str], Optional[bytes]]:
+        parsed: Optional[dict] = None
+        if body:
+            try:
+                obj = json.loads(body)
+                if isinstance(obj, dict):
+                    parsed = obj
+            except (ValueError, UnicodeDecodeError):
+                parsed = None
+        headers: dict[str, str] = {}
+        mutated: Optional[bytes] = None
+        current = parsed
+        for plugin in self.plugins:
+            h, m = plugin.execute(body, current)
+            headers.update(h)
+            if m is not None:
+                mutated = m
+                try:
+                    obj = json.loads(m)
+                    current = obj if isinstance(obj, dict) else current
+                except ValueError:
+                    pass
+        return headers, mutated

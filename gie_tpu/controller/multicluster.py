@@ -1,0 +1,118 @@
+"""Multi-cluster inference: export annotation -> InferencePoolImport.
+
+Port of reference docs/proposals/1374-multi-cluster-inference/README.md:36-53
+and the InferencePoolImport API (apix/v1alpha1): a pool annotated
+`inference.networking.x-k8s.io/export: ClusterSet` is exported from its home
+cluster; the multi-cluster controller materializes a same-name
+InferencePoolImport in every OTHER member cluster, recording the exporting
+cluster(s) in status.controllers, and maintains the pool's Exported
+condition (Exported / NotRequested / NotSupported,
+reference api/v1/inferencepool_types.go:352-379).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from gie_tpu.api import types as api
+
+CONTROLLER_NAME = "gie-tpu.inference.networking.k8s.io/multicluster"
+
+
+class ClusterSet:
+    """A named set of member clusters, each holding pools and imports."""
+
+    def __init__(self, members: list[str]):
+        self.members = list(members)
+        # (cluster, namespace, name) -> object
+        self.pools: dict[tuple[str, str, str], api.InferencePool] = {}
+        self.imports: dict[tuple[str, str, str], api.InferencePoolImport] = {}
+
+    def apply_pool(self, cluster: str, pool: api.InferencePool) -> None:
+        if cluster not in self.members:
+            raise ValueError(f"unknown member cluster {cluster!r}")
+        pool.validate()
+        self.pools[(cluster, pool.metadata.namespace, pool.metadata.name)] = pool
+        self.reconcile()
+
+    def delete_pool(self, cluster: str, namespace: str, name: str) -> None:
+        self.pools.pop((cluster, namespace, name), None)
+        self.reconcile()
+
+    def get_import(
+        self, cluster: str, namespace: str, name: str
+    ) -> Optional[api.InferencePoolImport]:
+        return self.imports.get((cluster, namespace, name))
+
+    # ------------------------------------------------------------------ #
+
+    def reconcile(self) -> None:
+        """Recompute all imports + Exported conditions from pool state."""
+        desired: dict[tuple[str, str, str], list[str]] = {}
+        for (cluster, ns, name), pool in self.pools.items():
+            raw = pool.metadata.annotations.get(api.EXPORT_ANNOTATION)
+            exported = raw == api.EXPORT_SCOPE_CLUSTERSET
+            # Exported condition on the pool itself; a present-but-unknown
+            # scope is NotSupported, absence is NotRequested
+            # (reference inferencepool_types.go:352-379 reason set).
+            self._set_exported_condition(pool, exported, raw)
+            if not exported:
+                continue
+            for member in self.members:
+                if member == cluster:
+                    continue
+                desired.setdefault((member, ns, name), []).append(cluster)
+
+        # Materialize / update imports.
+        for key, exporting in desired.items():
+            member, ns, name = key
+            imp = self.imports.get(key)
+            if imp is None:
+                imp = api.InferencePoolImport(
+                    metadata=api.ObjectMeta(name=name, namespace=ns)
+                )
+                self.imports[key] = imp
+            imp.status = api.InferencePoolImportStatus(
+                controllers=[
+                    api.ImportController(
+                        name=CONTROLLER_NAME,
+                        exportingClusters=[
+                            api.ExportingCluster(name=c)
+                            for c in sorted(exporting)
+                        ],
+                    )
+                ]
+            )
+        # Prune imports whose export stopped.
+        for key in [k for k in self.imports if k not in desired]:
+            del self.imports[key]
+
+    @staticmethod
+    def _set_exported_condition(
+        pool: api.InferencePool, exported: bool, raw_scope
+    ) -> None:
+        if exported:
+            cond = api.Condition(api.COND_EXPORTED, "True",
+                                 api.REASON_EXPORTED,
+                                 "exported to ClusterSet")
+        elif raw_scope is not None:
+            cond = api.Condition(api.COND_EXPORTED, "False",
+                                 api.REASON_NOT_SUPPORTED,
+                                 f"unsupported export scope {raw_scope!r}")
+        else:
+            cond = api.Condition(api.COND_EXPORTED, "False",
+                                 api.REASON_NOT_REQUESTED,
+                                 "no export annotation")
+        if not pool.status.parents:
+            pool.status.parents = [api.ParentStatus(
+                parentRef=api.ParentReference(name=CONTROLLER_NAME)
+            )]
+        for parent in pool.status.parents:
+            if parent.parentRef.name == CONTROLLER_NAME:
+                parent.set_condition(cond)
+                return
+        ps = api.ParentStatus(
+            parentRef=api.ParentReference(name=CONTROLLER_NAME)
+        )
+        ps.set_condition(cond)
+        pool.status.parents.append(ps)

@@ -18,6 +18,8 @@ FLOW_FAIRNESS_ID_KEY = "x-gateway-inference-fairness-id"
 OBJECTIVE_KEY = "x-gateway-inference-objective"
 # Model-name rewrite header (proposal 1816).
 MODEL_NAME_REWRITE_KEY = "x-gateway-model-name-rewrite"
+# Extracted-model header set by BBR (proposal 1964 default plugin).
+MODEL_NAME_HEADER = "X-Gateway-Model-Name"
 
 # Test-only steering header (reference request.go:84-97 + conformance
 # utils/headers/headers.go:19-22).

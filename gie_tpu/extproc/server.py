@@ -110,12 +110,17 @@ class StreamingServer:
     """One instance serves all streams; Process is invoked per HTTP request
     (Envoy opens an ext-proc stream per request)."""
 
-    def __init__(self, datastore, picker: EndpointPicker, on_served=None):
+    def __init__(self, datastore, picker: EndpointPicker, on_served=None,
+                 bbr_chain=None):
         self.datastore = datastore
         self.picker = picker
         # Served-endpoint feedback hook (004 README:84-101): called with the
         # hostport reported by the data plane at response time.
         self.on_served = on_served
+        # Optional BBR plugin chain (proposal 1964): runs over the complete
+        # request body before the pick; its headers join the header mutation
+        # and its body mutation is forwarded chunked.
+        self.bbr_chain = bbr_chain
 
     # ------------------------------------------------------------------ #
 
@@ -272,14 +277,31 @@ class StreamingServer:
 
     def _pick(self, ctx: RequestContext, body: Optional[bytes]) -> PickResult:
         """reference handlers/request.go:141-163."""
-        model = ""
+        bbr_headers: dict[str, str] = {}
+        bbr_body: Optional[bytes] = None
+        if self.bbr_chain is not None and body:
+            bbr_headers, bbr_body = self.bbr_chain.execute(body)
+        # Model precedence: an explicit rewrite (from BBR's rewrite plugin,
+        # else the upstream rewrite header) beats the raw extracted body
+        # model (proposal 1816 rewrite > 1964 extraction).
         rewrite = ctx.headers.get(metadata.MODEL_NAME_REWRITE_KEY)
-        if rewrite:
-            model = rewrite[0]
+        model = (
+            bbr_headers.get(metadata.MODEL_NAME_REWRITE_KEY)
+            or (rewrite[0] if rewrite else "")
+            or bbr_headers.get(metadata.MODEL_NAME_HEADER)
+            or ""
+        )
         result = self.picker.pick(
-            PickRequest(headers=ctx.headers, body=body, model=model),
+            PickRequest(
+                headers=ctx.headers,
+                body=bbr_body if bbr_body is not None else body,
+                model=model,
+            ),
             ctx.candidates,
         )
+        result.extra_headers = {**bbr_headers, **result.extra_headers}
+        if result.mutated_body is None and bbr_body is not None:
+            result.mutated_body = bbr_body
         ctx.target_endpoint = result.destination_value
         ctx.selected_pod_ip = result.endpoint.rsplit(":", 1)[0]
         ctx.pick_result = result

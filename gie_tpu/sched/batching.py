@@ -44,6 +44,39 @@ _CRITICALITY_BY_NAME = {
 }
 
 
+def _fair_order(items: list["_Pending"]) -> list["_Pending"]:
+    """Criticality bands first, round-robin by fairness ID within a band.
+
+    Proposal 1199 scopes fairness within a priority band: CRITICAL drains
+    before STANDARD before SHEDDABLE, and inside each band tenants
+    (x-gateway-inference-fairness-id) interleave round-robin with per-tenant
+    FIFO preserved. O(n) via deques.
+    """
+    from collections import deque
+
+    bands: dict[int, dict[str, deque]] = {}
+    band_order: dict[int, list[str]] = {}
+    for it in items:
+        obj = it.req.headers.get(mdkeys.OBJECTIVE_KEY, [""])[0].lower()
+        band = int(_CRITICALITY_BY_NAME.get(obj, C.Criticality.STANDARD))
+        fid = it.req.headers.get(mdkeys.FLOW_FAIRNESS_ID_KEY, [""])[0]
+        per = bands.setdefault(band, {})
+        if fid not in per:
+            per[fid] = deque()
+            band_order.setdefault(band, []).append(fid)
+        per[fid].append(it)
+
+    out: list[_Pending] = []
+    for band in sorted(bands):
+        queues = deque(bands[band][fid] for fid in band_order[band])
+        while queues:
+            q = queues.popleft()
+            out.append(q.popleft())
+            if q:
+                queues.append(q)
+    return out
+
+
 class _Pending:
     __slots__ = ("req", "candidates", "event", "result", "error", "enqueued_at")
 
@@ -130,6 +163,12 @@ class BatchingTPUPicker:
                 # Micro-batch window: collect stragglers before draining.
                 if len(self._pending) < self.max_batch:
                     self._cond.wait(self.max_wait_s)
+                if len(self._pending) > self.max_batch:
+                    # Flow-control fairness: when demand exceeds one cycle,
+                    # interleave round-robin across fairness IDs
+                    # (x-gateway-inference-fairness-id header, proposal 1199 /
+                    # flow control) so one tenant cannot monopolize a wave.
+                    self._pending = _fair_order(self._pending)
                 batch = self._pending[: self.max_batch]
                 self._pending = self._pending[self.max_batch :]
             try:

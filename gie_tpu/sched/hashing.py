@@ -6,18 +6,50 @@ docs/proposals/0602-prefix-cache/README.md:99:
 into fixed-size character chunks and each chunk's hash folds in the previous
 chunk's hash, so equal hash at depth i implies equal prefix up to i.
 
-This is the reference implementation (a C++ fast path under native/ is
-planned and will dispatch from here once built). Hash 0 is reserved for
-"empty table slot" and remapped to 1.
+Two implementations, bit-identical (both chained zlib CRC32):
+  - native/libgiechunker.so (C++, batch API) — loaded via ctypes when built
+    (`make -C native`); used by batch_chunk_hashes for whole micro-batches.
+  - the pure-Python per-prompt loop below — always available fallback.
+Hash 0 is reserved for "empty table slot" and remapped to 1.
 """
 
 from __future__ import annotations
 
+import ctypes
+import os
 import zlib
 
 import numpy as np
 
 from gie_tpu.sched import constants as C
+
+
+def _load_native():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        "native",
+        "libgiechunker.so",
+    )
+    try:
+        lib = ctypes.CDLL(path)
+        fn = lib.gie_chunk_hashes_batch
+    except (OSError, AttributeError):
+        # Missing OR stale library (symbol absent): pure-Python fallback.
+        return None
+    fn.argtypes = [
+        ctypes.c_char_p,                      # data
+        np.ctypeslib.ndpointer(np.int64),     # offsets
+        ctypes.c_int,                         # n_prompts
+        ctypes.c_int,                         # chunk_bytes
+        ctypes.c_int,                         # max_chunks
+        np.ctypeslib.ndpointer(np.uint32),    # out_hashes
+        np.ctypeslib.ndpointer(np.int32),     # out_counts
+    ]
+    fn.restype = None
+    return fn
+
+
+_NATIVE = _load_native()
 
 
 def chunk_hashes(
@@ -49,8 +81,16 @@ def batch_chunk_hashes(
     max_chunks: int = C.MAX_CHUNKS,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Hash a batch of prompts -> (u32[N, max_chunks], i32[N])."""
-    hashes = np.zeros((len(prompts), max_chunks), np.uint32)
-    counts = np.zeros((len(prompts),), np.int32)
+    n = len(prompts)
+    hashes = np.zeros((n, max_chunks), np.uint32)
+    counts = np.zeros((n,), np.int32)
+    if _NATIVE is not None and n > 0:
+        offsets = np.zeros((n + 1,), np.int64)
+        for i, p in enumerate(prompts):
+            offsets[i + 1] = offsets[i] + len(p)
+        data = b"".join(prompts)
+        _NATIVE(data, offsets, n, chunk_bytes, max_chunks, hashes, counts)
+        return hashes, counts
     for i, p in enumerate(prompts):
         hashes[i], counts[i] = chunk_hashes(
             p, chunk_bytes=chunk_bytes, max_chunks=max_chunks

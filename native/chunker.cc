@@ -1,0 +1,70 @@
+// Batch rolling-hash prefix chunker — native fast path for
+// gie_tpu/sched/hashing.py.
+//
+// Computes the chained chunk hashes of the prefix-cache design
+// (reference docs/proposals/0602-prefix-cache/README.md:99:
+//  hash(chunk_i) = hash(content_i + hash(chunk_{i-1}))) for a batch of
+// prompts in one call. The hash is zlib-compatible CRC32 chained through the
+// previous chunk's value, bit-identical to the Python fallback
+// (zlib.crc32(chunk, prev)), so the device-side prefix index sees the same
+// keys regardless of which path produced them.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+// Standard zlib CRC32 (polynomial 0xEDB88320), table-based.
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) {
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      }
+      t[i] = c;
+    }
+  }
+};
+
+const Crc32Table kTable;
+
+inline uint32_t crc32_update(uint32_t crc, const uint8_t* buf, size_t len) {
+  crc = crc ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) {
+    crc = kTable.t[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+  }
+  return crc ^ 0xFFFFFFFFu;
+}
+
+}  // namespace
+
+extern "C" {
+
+// data: concatenated prompt bytes; offsets[i]..offsets[i+1] = prompt i
+// (length n_prompts + 1). out_hashes: [n_prompts * max_chunks] u32,
+// zero-padded; out_counts: [n_prompts] i32.
+void gie_chunk_hashes_batch(const uint8_t* data, const int64_t* offsets,
+                            int n_prompts, int chunk_bytes, int max_chunks,
+                            uint32_t* out_hashes, int32_t* out_counts) {
+  for (int p = 0; p < n_prompts; p++) {
+    const uint8_t* prompt = data + offsets[p];
+    const int64_t len = offsets[p + 1] - offsets[p];
+    int n = static_cast<int>(len / chunk_bytes);
+    if (n > max_chunks) n = max_chunks;
+    uint32_t h = 0;
+    uint32_t* out = out_hashes + static_cast<size_t>(p) * max_chunks;
+    for (int c = 0; c < n; c++) {
+      h = crc32_update(h, prompt + static_cast<size_t>(c) * chunk_bytes,
+                       chunk_bytes);
+      out[c] = (h != 0) ? h : 1u;
+    }
+    for (int c = n; c < max_chunks; c++) out[c] = 0;
+    out_counts[p] = n;
+  }
+}
+
+}  // extern "C"

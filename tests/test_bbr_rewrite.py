@@ -1,0 +1,153 @@
+"""BBR plugin chain + InferenceModelRewrite tests (proposals 1964 + 1816)."""
+
+import json
+
+import pytest
+
+from gie_tpu.api.modelrewrite import (
+    InferenceModelRewrite,
+    ModelMatch,
+    RewriteEngine,
+    RewriteRule,
+    TargetModel,
+)
+from gie_tpu.bbr import (
+    MODEL_HEADER,
+    ModelExtractorPlugin,
+    ModelRewritePlugin,
+    PluginChain,
+)
+from gie_tpu.extproc import RoundRobinPicker, StreamingServer, metadata as mdkeys
+from tests.test_extproc import FakeStream, body_msg, headers_msg, make_ds
+
+
+def test_model_extractor_sets_header():
+    chain = PluginChain([ModelExtractorPlugin()])
+    headers, mutated = chain.execute(json.dumps({"model": "llama-8b"}).encode())
+    assert headers[MODEL_HEADER] == "llama-8b"
+    assert mutated is None
+
+
+def test_chain_tolerates_non_json_body():
+    chain = PluginChain([ModelExtractorPlugin()])
+    headers, mutated = chain.execute(b"\x00\x01 not json")
+    assert headers == {} and mutated is None
+
+
+def make_engine():
+    eng = RewriteEngine(seed=0)
+    eng.apply(InferenceModelRewrite(
+        name="rw-generic", pool_ref="pool",
+        rules=[RewriteRule(targets=[TargetModel("fallback-model")])],
+    ))
+    eng.apply(InferenceModelRewrite(
+        name="rw-exact", pool_ref="pool",
+        rules=[RewriteRule(
+            matches=[ModelMatch("gpt-fast")],
+            targets=[TargetModel("llama-70b")],
+        )],
+    ))
+    return eng
+
+
+def test_exact_match_beats_generic_regardless_of_age():
+    """1816 README: Exact precedence over generic even when the generic
+    resource is older."""
+    eng = make_engine()
+    assert eng.resolve("pool", "gpt-fast") == "llama-70b"
+    assert eng.resolve("pool", "anything-else") == "fallback-model"
+
+
+def test_oldest_resource_wins_exact_ties():
+    eng = RewriteEngine(seed=0)
+    eng.apply(InferenceModelRewrite(
+        name="older", pool_ref="pool",
+        rules=[RewriteRule(matches=[ModelMatch("m")],
+                           targets=[TargetModel("first")])],
+    ))
+    eng.apply(InferenceModelRewrite(
+        name="newer", pool_ref="pool",
+        rules=[RewriteRule(matches=[ModelMatch("m")],
+                           targets=[TargetModel("second")])],
+    ))
+    assert eng.resolve("pool", "m") == "first"
+
+
+def test_weighted_split_roughly_proportional():
+    eng = RewriteEngine(seed=0)
+    eng.apply(InferenceModelRewrite(
+        name="split", pool_ref="pool",
+        rules=[RewriteRule(
+            matches=[ModelMatch("base")],
+            targets=[TargetModel("a", weight=9), TargetModel("b", weight=1)],
+        )],
+    ))
+    hits = {"a": 0, "b": 0}
+    for _ in range(500):
+        hits[eng.resolve("pool", "base")] += 1
+    assert hits["a"] > hits["b"] * 3
+    assert hits["b"] > 0
+
+
+def test_rewrite_plugin_mutates_body_and_sets_headers():
+    eng = make_engine()
+    chain = PluginChain([
+        ModelExtractorPlugin(),
+        ModelRewritePlugin(eng, pool="pool"),
+    ])
+    headers, mutated = chain.execute(
+        json.dumps({"model": "gpt-fast", "prompt": "hi"}).encode()
+    )
+    assert headers[MODEL_HEADER] == "llama-70b"
+    assert headers[mdkeys.MODEL_NAME_REWRITE_KEY] == "llama-70b"
+    assert json.loads(mutated)["model"] == "llama-70b"
+    assert json.loads(mutated)["prompt"] == "hi"
+
+
+def test_bbr_through_extproc_server():
+    """End to end: body arrives, BBR rewrites it, the data plane receives a
+    CONTINUE_AND_REPLACE body mutation + the model headers."""
+    eng = make_engine()
+    srv = StreamingServer(
+        make_ds(), RoundRobinPicker(),
+        bbr_chain=PluginChain([
+            ModelExtractorPlugin(), ModelRewritePlugin(eng, pool="pool"),
+        ]),
+    )
+    body = json.dumps({"model": "gpt-fast", "prompt": "x"}).encode()
+    stream = FakeStream([
+        headers_msg(end_of_stream=False), body_msg(body, end_of_stream=True),
+    ])
+    srv.process(stream)
+    hdr_resp, body_resp = stream.sent
+    mut = {
+        o.header.key: o.header.raw_value.decode()
+        for o in hdr_resp.request_headers.response.header_mutation.set_headers
+    }
+    assert mut[MODEL_HEADER] == "llama-70b"
+    common = body_resp.request_body.response
+    assert common.status == common.CONTINUE_AND_REPLACE
+    assert json.loads(common.body_mutation.body)["model"] == "llama-70b"
+
+
+def test_upstream_rewrite_header_beats_extracted_model():
+    """Regression: x-gateway-model-name-rewrite must win over the BBR
+    extractor's raw body model (1816 rewrite > 1964 extraction)."""
+    seen = {}
+
+    class CapturePicker(RoundRobinPicker):
+        def pick(self, req, candidates):
+            seen["model"] = req.model
+            return super().pick(req, candidates)
+
+    srv = StreamingServer(
+        make_ds(), CapturePicker(),
+        bbr_chain=PluginChain([ModelExtractorPlugin()]),
+    )
+    stream = FakeStream([
+        headers_msg(headers={mdkeys.MODEL_NAME_REWRITE_KEY: "llama-70b-ft"},
+                    end_of_stream=False),
+        body_msg(json.dumps({"model": "gpt-fast"}).encode(), end_of_stream=True),
+    ])
+    srv.process(stream)
+    assert seen["model"] == "llama-70b-ft"

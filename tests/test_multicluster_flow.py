@@ -1,0 +1,132 @@
+"""Multi-cluster export/import (proposal 1374 + apix/v1alpha1) and
+flow-control fairness tests."""
+
+from gie_tpu.api import types as api
+from gie_tpu.controller.multicluster import CONTROLLER_NAME, ClusterSet
+from gie_tpu.extproc import metadata as mdkeys
+from gie_tpu.extproc.server import PickRequest
+from gie_tpu.sched.batching import _fair_order, _Pending
+
+
+def make_pool(name="pool", export=True):
+    annotations = (
+        {api.EXPORT_ANNOTATION: api.EXPORT_SCOPE_CLUSTERSET} if export else {}
+    )
+    return api.InferencePool(
+        metadata=api.ObjectMeta(name=name, annotations=annotations),
+        spec=api.InferencePoolSpec(
+            selector=api.LabelSelector(matchLabels={"app": "vllm"}),
+            targetPorts=[api.Port(8000)],
+            endpointPickerRef=api.EndpointPickerRef(name="epp", port=api.Port(9002)),
+        ),
+    )
+
+
+def test_export_materializes_imports_in_other_members():
+    cs = ClusterSet(["east", "west", "south"])
+    cs.apply_pool("east", make_pool())
+    for member in ("west", "south"):
+        imp = cs.get_import(member, "default", "pool")
+        assert imp is not None
+        ctrl = imp.status.controllers[0]
+        assert ctrl.name == CONTROLLER_NAME
+        assert [c.name for c in ctrl.exportingClusters] == ["east"]
+    # Never in the exporting cluster itself.
+    assert cs.get_import("east", "default", "pool") is None
+
+
+def test_exported_condition_on_pool():
+    cs = ClusterSet(["east", "west"])
+    pool = make_pool()
+    cs.apply_pool("east", pool)
+    conds = [
+        p.get_condition(api.COND_EXPORTED)
+        for p in pool.status.parents
+        if p.parentRef.name == CONTROLLER_NAME
+    ]
+    assert conds[0].status == "True" and conds[0].reason == api.REASON_EXPORTED
+
+    unexported = make_pool(name="local", export=False)
+    cs.apply_pool("east", unexported)
+    conds = [
+        p.get_condition(api.COND_EXPORTED)
+        for p in unexported.status.parents
+        if p.parentRef.name == CONTROLLER_NAME
+    ]
+    assert conds[0].status == "False"
+    assert conds[0].reason == api.REASON_NOT_REQUESTED
+    assert cs.get_import("west", "default", "local") is None
+
+
+def test_multiple_exporters_merge_and_prune():
+    cs = ClusterSet(["east", "west", "south"])
+    cs.apply_pool("east", make_pool())
+    cs.apply_pool("west", make_pool())
+    imp = cs.get_import("south", "default", "pool")
+    assert [c.name for c in imp.status.controllers[0].exportingClusters] == [
+        "east", "west",
+    ]
+    cs.delete_pool("east", "default", "pool")
+    imp = cs.get_import("south", "default", "pool")
+    assert [c.name for c in imp.status.controllers[0].exportingClusters] == [
+        "west",
+    ]
+    cs.delete_pool("west", "default", "pool")
+    assert cs.get_import("south", "default", "pool") is None
+
+
+def test_fair_order_interleaves_tenants():
+    def pending(fid, i):
+        p = _Pending(
+            PickRequest(headers={mdkeys.FLOW_FAIRNESS_ID_KEY: [fid]},
+                        body=b"%d" % i),
+            candidates=[object()],
+        )
+        return p
+
+    # Tenant A floods with 6 requests; B and C have 2 each.
+    items = [pending("A", i) for i in range(6)]
+    items += [pending("B", i) for i in range(2)]
+    items += [pending("C", i) for i in range(2)]
+    ordered = _fair_order(items)
+    first_six = [it.req.headers[mdkeys.FLOW_FAIRNESS_ID_KEY][0]
+                 for it in ordered[:6]]
+    # Every tenant appears within the first wave of 6.
+    assert set(first_six) == {"A", "B", "C"}
+    # Per-tenant FIFO preserved.
+    a_bodies = [it.req.body for it in ordered
+                if it.req.headers[mdkeys.FLOW_FAIRNESS_ID_KEY][0] == "A"]
+    assert a_bodies == sorted(a_bodies, key=lambda b: int(b))
+
+
+def test_unsupported_export_scope_not_supported_reason():
+    cs = ClusterSet(["east", "west"])
+    pool = make_pool(export=False)
+    pool.metadata.annotations[api.EXPORT_ANNOTATION] = "Region"
+    cs.apply_pool("east", pool)
+    conds = [
+        p.get_condition(api.COND_EXPORTED)
+        for p in pool.status.parents
+        if p.parentRef.name == CONTROLLER_NAME
+    ]
+    assert conds[0].status == "False"
+    assert conds[0].reason == api.REASON_NOT_SUPPORTED
+    assert cs.get_import("west", "default", "pool") is None
+
+
+def test_fair_order_criticality_bands_before_fairness():
+    """CRITICAL drains before SHEDDABLE even when other tenants flood."""
+    def pending(fid, obj, i):
+        return _Pending(
+            PickRequest(headers={
+                mdkeys.FLOW_FAIRNESS_ID_KEY: [fid],
+                mdkeys.OBJECTIVE_KEY: [obj],
+            }, body=b"%d" % i),
+            candidates=[object()],
+        )
+
+    items = [pending("B", "sheddable", i) for i in range(4)]
+    items += [pending("C", "sheddable", i) for i in range(4)]
+    items.append(pending("A", "critical", 0))  # arrived last
+    ordered = _fair_order(items)
+    assert ordered[0].req.headers[mdkeys.OBJECTIVE_KEY][0] == "critical"
