@@ -64,8 +64,11 @@ class ProfileConfig:
     enable_lora: bool = True
     enable_prefix: bool = True
     shed_sheddable: bool = True  # 429 sheddable traffic when saturated
-    picker: str = "topk"         # "topk" | "random"
+    picker: str = "topk"         # "topk" | "random" | "sinkhorn"
     sample_temperature: float = 0.05
+    sinkhorn_tau: float = 0.02   # OT temperature (lower = greedier)
+    sinkhorn_iters: int = 8
+    sinkhorn_rounding_temp: float = 0.1  # randomized-rounding noise scale
 
 
 def request_cost(reqs: RequestBatch) -> jax.Array:
@@ -162,6 +165,16 @@ def scheduling_cycle(
         result = pickers.weighted_random_picker(
             total, mask, shed, reqs.valid, key,
             temperature=cfg.sample_temperature,
+        )
+    elif cfg.picker == "sinkhorn":
+        from gie_tpu.sched.sinkhorn import sinkhorn_picker
+
+        result = sinkhorn_picker(
+            total, mask, shed, reqs.valid, eps, key,
+            queue_limit=cfg.queue_limit,
+            tau=cfg.sinkhorn_tau,
+            iters=cfg.sinkhorn_iters,
+            rounding_temp=cfg.sinkhorn_rounding_temp,
         )
     else:
         result = pickers.topk_picker(total, mask, shed, reqs.valid, state.rr)

@@ -1,0 +1,99 @@
+"""Sinkhorn optimal-transport picker: batched bin-packing of N requests
+onto M endpoints (BASELINE configs[4] "learned bin-packing Picker").
+
+The deterministic argmax picker routes every request of a wave to its
+individually-best endpoint, herding onto the argmax until assumed-load
+feedback catches up. The OT formulation assigns the whole wave at once:
+
+  maximize   sum_{n,m} P[n,m] * score[n,m]
+  subject to sum_m P[n,m] = 1           (each request placed once)
+             sum_n P[n,m] <= cap[m]     (endpoint capacity this wave)
+
+solved approximately by Sinkhorn iterations on K = exp(score / tau) with
+alternating row normalization (exact) and column capping (projection), all
+dense tensor algebra under jit — no data-dependent control flow. The final
+per-request ordering comes from the transport plan, so two requests with the
+same favorite endpoint split across it and the runner-up instead of
+colliding.
+
+Capacity model: each endpoint can absorb headroom proportional to its free
+queue + KV space this wave; capacities are scaled so sum(cap) >= N, keeping
+the problem feasible (best-effort overflow still lands somewhere).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.pickers import NEG, _finalize
+from gie_tpu.sched.types import EndpointBatch, PickResult
+
+
+def capacities(
+    eps: EndpointBatch, n_requests: jax.Array, *, queue_limit: float
+) -> jax.Array:
+    """Per-endpoint wave capacity -> f32[M_MAX], scaled to sum >= the
+    EFFECTIVE request mass (valid, candidate-bearing rows — padded bucket
+    rows carry no transport mass and must not inflate the caps, or small
+    waves never bind them and the picker degenerates to argmax)."""
+    queue = eps.metrics[:, C.Metric.QUEUE_DEPTH]
+    kv = eps.metrics[:, C.Metric.KV_CACHE_UTIL]
+    headroom = jnp.clip(queue_limit - queue, 0.0, queue_limit) * jnp.clip(
+        1.0 - kv, 0.05, 1.0
+    )
+    headroom = jnp.where(eps.valid, headroom + 1e-3, 0.0)
+    total = jnp.maximum(jnp.sum(headroom), 1e-6)
+    return headroom * (n_requests / total) * 1.25  # 25% slack for feasibility
+
+
+def sinkhorn_picker(
+    scores: jax.Array,   # f32[N, M_MAX]
+    mask: jax.Array,     # bool[N, M_MAX]
+    shed: jax.Array,
+    valid: jax.Array,
+    eps: EndpointBatch,
+    key: jax.Array,
+    *,
+    queue_limit: float,
+    tau: float,
+    iters: int,
+    rounding_temp: float,
+) -> PickResult:
+    # Effective transport mass: valid rows that still have candidates
+    # (padded rows and empty-subset rows contribute nothing).
+    n_eff = jnp.maximum(
+        jnp.sum((valid & jnp.any(mask, axis=1)).astype(jnp.float32)), 1.0
+    )
+    cap = capacities(eps, n_eff, queue_limit=queue_limit)  # f32[M]
+
+    # Kernel: masked Gibbs weights. Subtract per-row max for stability.
+    row_max = jnp.max(jnp.where(mask, scores, -jnp.inf), axis=1, keepdims=True)
+    row_max = jnp.where(jnp.isfinite(row_max), row_max, 0.0)
+    k = jnp.where(mask, jnp.exp((scores - row_max) / tau), 0.0)
+
+    def body(p, _):
+        # Row normalize: each valid request distributes mass 1.
+        row = jnp.sum(p, axis=1, keepdims=True)
+        p = jnp.where(row > 0, p / row, p)
+        # Column cap: scale down overloaded endpoints.
+        col = jnp.sum(p, axis=0)
+        scale = jnp.where(col > cap, cap / jnp.maximum(col, 1e-9), 1.0)
+        return p * scale[None, :], None
+
+    plan, _ = jax.lax.scan(body, k, None, length=iters)
+    # Final row normalization so the plan is a proper per-request
+    # distribution even where capacity clipped it.
+    row = jnp.sum(plan, axis=1, keepdims=True)
+    plan = jnp.where(row > 0, plan / row, plan)
+
+    # Rounding: argmax of identical fractional rows would herd the whole
+    # wave onto one endpoint again, so Gumbel noise (scaled by
+    # rounding_temp) breaks symmetry. Note this is a GREEDY tie-breaking
+    # rounding, not mass-proportional sampling: at rounding_temp < 1 picks
+    # concentrate on each row's plan mode (~ plan^(1/temp)), which the
+    # goodput sweep preferred over true proportional rounding (temp=1).
+    g = jax.random.gumbel(key, plan.shape, jnp.float32) * rounding_temp
+    masked = jnp.where(mask & (plan > 0), jnp.log(plan + 1e-20) + g, NEG)
+    return _finalize(masked, mask, shed, valid)

@@ -86,11 +86,14 @@ class VLLMStub:
     ) -> int:
         rid = self._next_id
         self._next_id += 1
-        # Hash the ENTIRE prompt (unlike the scheduler's 32-chunk view):
-        # the stub models the real server's block cache, so hit_fraction
-        # must account for every byte of prefill it discounts.
+        # Hash the ENTIRE prompt at a fixed 64-byte granularity (the stub
+        # models the real server's block cache — independent of whatever
+        # chunk size the scheduler uses for its approximate view), so
+        # hit_fraction accounts for every byte of prefill it discounts.
         hashes, n = chunk_hashes(
-            prompt, max_chunks=max(len(prompt) // 64 + 1, 1)
+            prompt,
+            chunk_bytes=64,
+            max_chunks=max(len(prompt) // 64 + 1, 1),
         )
         req = _Req(
             rid=rid,

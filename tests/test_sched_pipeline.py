@@ -274,3 +274,54 @@ def test_shed_disabled_sheddable_degrades_like_standard():
     res = sched.pick(make_requests(1, criticality=[Criticality.SHEDDABLE]), eps)
     assert res.status[0] == Status.OK
     assert res.indices[0, 0] == 1
+
+
+def test_sinkhorn_picker_spreads_wave_under_capacity():
+    """OT picker must not herd a uniform wave onto one endpoint (the
+    failure mode of deterministic argmax within a cycle)."""
+    import collections
+
+    cfg = ProfileConfig(picker="sinkhorn", enable_prefix=False)
+    sched = Scheduler(cfg)
+    eps = make_endpoints(4, queue=[0, 0, 0, 0])
+    res = sched.pick(make_requests(64), eps)
+    counts = collections.Counter(int(i) for i in np.asarray(res.indices[:, 0]))
+    assert len(counts) == 4
+    assert max(counts.values()) < 40  # no single endpoint takes the wave
+
+
+def test_sinkhorn_respects_mask_and_status():
+    cfg = ProfileConfig(picker="sinkhorn", enable_prefix=False)
+    sched = Scheduler(cfg)
+    eps = make_endpoints(4, queue=[0, 0, 0, 0])
+    reqs = make_requests(8, subset=[[1, 2]] * 7 + [[400]])
+    res = sched.pick(reqs, eps)
+    assert set(int(i) for i in np.asarray(res.indices[:7, 0])) <= {1, 2}
+    assert res.status[7] == Status.NO_CAPACITY
+
+
+def test_sinkhorn_biases_toward_higher_capacity():
+    """Loaded endpoints get proportionally less of the wave."""
+    import collections
+
+    cfg = ProfileConfig(picker="sinkhorn", enable_prefix=False,
+                        queue_norm=16.0)
+    sched = Scheduler(cfg)
+    eps = make_endpoints(2, queue=[15, 0])
+    res = sched.pick(make_requests(64), eps)
+    counts = collections.Counter(int(i) for i in np.asarray(res.indices[:, 0]))
+    assert counts[1] > counts[0] * 2
+
+
+def test_sinkhorn_padded_wave_still_spreads():
+    """Regression: a small wave padded up to a bucket must not inflate the
+    capacity scale (padded rows carry no transport mass)."""
+    import collections
+
+    cfg = ProfileConfig(picker="sinkhorn", enable_prefix=False)
+    sched = Scheduler(cfg)
+    eps = make_endpoints(4, queue=[0, 0, 0, 0])
+    res = sched.pick(make_requests(9), eps)  # pads to bucket 64
+    counts = collections.Counter(int(i) for i in np.asarray(res.indices[:, 0]))
+    assert max(counts.values()) <= 5
+    assert len(counts) >= 3
