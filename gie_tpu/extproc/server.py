@@ -63,6 +63,8 @@ class PickResult:
     extra_headers: dict[str, str] = dataclasses.field(default_factory=dict)
     # Assumed-load units this pick added (released on served feedback).
     assumed_cost: float = 1.0
+    # Optional (feature_row, picked_at) recorded for online latency training.
+    feedback: Optional[tuple] = None
 
     @property
     def destination_value(self) -> str:

@@ -21,6 +21,7 @@ system — SURVEY.md section 5.4).
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 from typing import Optional
 
@@ -35,6 +36,16 @@ from gie_tpu.sched.prefix import match_scores
 from gie_tpu.sched.types import EndpointBatch, RequestBatch
 
 NUM_FEATURES = 8
+
+# Shared feature normalizers — build_features (device) and host_features
+# (host) MUST use these same constants or online training skews against
+# serving-time features.
+PROMPT_NORM = 4096.0
+DECODE_NORM = 1024.0
+QUEUE_NORM = 64.0
+RUNNING_NORM = 64.0
+AGE_CLIP_S = 10.0
+LOAD_NORM = 32.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,17 +87,17 @@ def build_features(
     """
     n = reqs.valid.shape[0]
     m = eps.valid.shape[0]
-    queue = eps.metrics[:, C.Metric.QUEUE_DEPTH] / 64.0
+    queue = eps.metrics[:, C.Metric.QUEUE_DEPTH] / QUEUE_NORM
     kv = eps.metrics[:, C.Metric.KV_CACHE_UTIL]
-    running = eps.metrics[:, C.Metric.RUNNING_REQUESTS] / 64.0
-    age = jnp.clip(eps.metrics[:, C.Metric.METRICS_AGE_S], 0.0, 10.0)
-    load = assumed_load / 32.0
+    running = eps.metrics[:, C.Metric.RUNNING_REQUESTS] / RUNNING_NORM
+    age = jnp.clip(eps.metrics[:, C.Metric.METRICS_AGE_S], 0.0, AGE_CLIP_S)
+    load = assumed_load / LOAD_NORM
 
     ep_feats = jnp.stack([queue, kv, running, age, load], axis=-1)  # [M, 5]
     req_feats = jnp.stack(
         [
-            reqs.prompt_len / 4096.0,
-            reqs.decode_len / 1024.0,
+            reqs.prompt_len / PROMPT_NORM,
+            reqs.decode_len / DECODE_NORM,
             (reqs.lora_id >= 0).astype(jnp.float32),
         ],
         axis=-1,
@@ -119,6 +130,32 @@ class LatencyPredictor:
         """Predicted end-to-end seconds: TTFT + TPOT * decode_len."""
         pred = self.predict(params, features)          # [..., 2]
         return pred[..., 0] + pred[..., 1] * decode_len[..., None]
+
+
+def host_features(
+    metrics_row: np.ndarray,
+    assumed_load: float,
+    prompt_len: float,
+    decode_len: float,
+    has_lora: bool,
+) -> np.ndarray:
+    """Host-side twin of build_features for ONE (request, endpoint) pair —
+    the feature row recorded at pick time for online-training feedback.
+    Shares the module-level normalizers with build_features so the two
+    paths cannot diverge."""
+    return np.asarray(
+        [
+            prompt_len / PROMPT_NORM,
+            decode_len / DECODE_NORM,
+            1.0 if has_lora else 0.0,
+            metrics_row[C.Metric.QUEUE_DEPTH] / QUEUE_NORM,
+            metrics_row[C.Metric.KV_CACHE_UTIL],
+            metrics_row[C.Metric.RUNNING_REQUESTS] / RUNNING_NORM,
+            min(max(metrics_row[C.Metric.METRICS_AGE_S], 0.0), AGE_CLIP_S),
+            assumed_load / LOAD_NORM,
+        ],
+        np.float32,
+    )
 
 
 def predictor_score_fn(predictor: LatencyPredictor):
@@ -154,7 +191,10 @@ def make_train_step(
     tx: optax.GradientTransformation,
     **jit_kwargs,
 ):
-    """Jitted AdamW step on (features[B,F], targets[B,2]) MSE.
+    """Jitted AdamW step on (features[B,F], targets[B,2], weights[B,2])
+    weighted MSE. The per-column weights let partially-observed samples
+    (e.g. served feedback measuring TTFT but not TPOT) train only the heads
+    they actually observed instead of dragging the others to zero.
 
     Params are NOT donated: the live Scheduler holds a reference to the
     current params for its scorer column, and donation would delete those
@@ -162,12 +202,13 @@ def make_train_step(
     in_shardings for the multi-chip path.
     """
 
-    def loss_fn(params, feats, targets):
+    def loss_fn(params, feats, targets, weights):
         pred = predictor.predict(params, feats)
-        return jnp.mean((pred - targets) ** 2)
+        se = weights * (pred - targets) ** 2
+        return jnp.sum(se) / jnp.maximum(jnp.sum(weights), 1.0)
 
-    def step(params, opt_state, feats, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, feats, targets)
+    def step(params, opt_state, feats, targets, weights):
+        loss, grads = jax.value_and_grad(loss_fn)(params, feats, targets, weights)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -201,16 +242,26 @@ class OnlineTrainer:
         self.batch_size = batch_size
         self._feats = np.zeros((capacity, NUM_FEATURES), np.float32)
         self._targets = np.zeros((capacity, 2), np.float32)
+        self._weights = np.zeros((capacity, 2), np.float32)
         self._n = 0
         self._head = 0
         self._lock = threading.Lock()
         self._rng = np.random.default_rng(seed)
         self.last_loss: Optional[float] = None
 
-    def observe(self, features: np.ndarray, ttft_s: float, tpot_s: float) -> None:
+    def observe(
+        self,
+        features: np.ndarray,
+        ttft_s: float,
+        tpot_s: Optional[float] = None,
+    ) -> None:
+        """Record one observation. Pass tpot_s=None when only TTFT was
+        measured — the TPOT head is masked out of the loss for that sample
+        instead of being dragged toward zero."""
         with self._lock:
             self._feats[self._head] = features
-            self._targets[self._head] = (ttft_s, tpot_s)
+            self._targets[self._head] = (ttft_s, tpot_s if tpot_s is not None else 0.0)
+            self._weights[self._head] = (1.0, 0.0 if tpot_s is None else 1.0)
             self._head = (self._head + 1) % self.capacity
             self._n = min(self._n + 1, self.capacity)
 
@@ -222,12 +273,43 @@ class OnlineTrainer:
                 return None
             feats = self._feats[:n].copy()
             targets = self._targets[:n].copy()
+            weights = self._weights[:n].copy()
         loss = None
         for _ in range(steps):
             idx = self._rng.integers(0, n, self.batch_size)
             self.params, self.opt_state, loss_arr = self._step(
-                self.params, self.opt_state, feats[idx], targets[idx]
+                self.params, self.opt_state, feats[idx], targets[idx],
+                weights[idx],
             )
             loss = float(loss_arr)
         self.last_loss = loss
         return loss
+
+    # -- durability (the system's ONLY durable state, SURVEY.md 5.4) -------
+
+    def save(self, directory: str) -> None:
+        """Checkpoint params via orbax (reference analogue: none — all EPP
+        state is soft cache; the learned policy's weights are the exception
+        the BASELINE north star introduces)."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(directory)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(path, self.params, force=True)
+
+    def restore(self, directory: str) -> bool:
+        """Restore params if a checkpoint exists; returns success. The
+        optimizer state restarts fresh (acceptable for online fine-tuning)."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(directory)
+        if not os.path.isdir(path):
+            return False
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                restored = ckptr.restore(path, item=self.params)
+        except Exception:
+            return False
+        self.params = restored
+        self.opt_state = self.tx.init(self.params)
+        return True

@@ -101,5 +101,5 @@ def sharded_train_step(mesh: Mesh, predictor, tx: optax.GradientTransformation):
 
     data = NamedSharding(mesh, P("dp", None))
     return make_train_step(
-        predictor, tx, in_shardings=(None, None, data, data)
+        predictor, tx, in_shardings=(None, None, data, data, data)
     )

@@ -29,6 +29,10 @@ class Options:
     batch_window_ms: float = 2.0
     scrape_interval_ms: float = 50.0
     model_server_type: str = "vllm"
+    # Learned latency predictor (BASELINE configs[3])
+    enable_predictor: bool = False
+    predictor_checkpoint_dir: Optional[str] = None
+    predictor_train_interval_s: float = 5.0
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -60,6 +64,14 @@ class Options:
         parser.add_argument("--model-server-type", default=d.model_server_type,
                             choices=["vllm", "triton-tensorrt-llm",
                                      "trtllm-serve", "sglang"])
+        parser.add_argument("--enable-predictor", action="store_true",
+                            default=d.enable_predictor,
+                            help="learned TTFT predictor scorer column with "
+                                 "online training")
+        parser.add_argument("--predictor-checkpoint-dir",
+                            default=d.predictor_checkpoint_dir)
+        parser.add_argument("--predictor-train-interval-s", type=float,
+                            default=d.predictor_train_interval_s)
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "Options":
@@ -76,6 +88,9 @@ class Options:
             batch_window_ms=args.batch_window_ms,
             scrape_interval_ms=args.scrape_interval_ms,
             model_server_type=args.model_server_type,
+            enable_predictor=args.enable_predictor,
+            predictor_checkpoint_dir=args.predictor_checkpoint_dir,
+            predictor_train_interval_s=args.predictor_train_interval_s,
         )
 
     def validate(self) -> None:

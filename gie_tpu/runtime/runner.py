@@ -50,7 +50,29 @@ class ExtProcServerRunner:
         self.log = get_logger("runner")
         self.cluster = cluster
         self.lora_registry = LoraRegistry()
-        self.scheduler = scheduler if scheduler is not None else Scheduler()
+        self.trainer = None
+        if scheduler is not None:
+            self.scheduler = scheduler
+        elif opts.enable_predictor:
+            # Learned TTFT column with online training (BASELINE configs[3]).
+            from gie_tpu.models.latency import (
+                LatencyPredictor,
+                OnlineTrainer,
+                predictor_score_fn,
+            )
+
+            predictor = LatencyPredictor()
+            self.trainer = OnlineTrainer(predictor)
+            if opts.predictor_checkpoint_dir:
+                if self.trainer.restore(opts.predictor_checkpoint_dir):
+                    self.log.info("predictor checkpoint restored",
+                                  dir=opts.predictor_checkpoint_dir)
+            self.scheduler = Scheduler(
+                predictor_fn=predictor_score_fn(predictor),
+                predictor_params=self.trainer.params,
+            )
+        else:
+            self.scheduler = Scheduler()
         self.metrics_store = MetricsStore()
         self.mapping = BY_NAME[opts.model_server_type]
         self.scraper = Scraper(
@@ -66,7 +88,10 @@ class ExtProcServerRunner:
             self.metrics_store,
             max_wait_s=opts.batch_window_ms / 1000.0,
             lora_registry=self.lora_registry,
+            trainer=self.trainer,
         )
+        self._train_stop = threading.Event()
+        self._train_thread: Optional[threading.Thread] = None
         self.streaming = StreamingServer(
             self.datastore, self.picker, on_served=self.picker.observe_served
         )
@@ -147,6 +172,11 @@ class ExtProcServerRunner:
             raise OSError(f"failed to bind ext-proc port {addr}")
         server.start()
         self.grpc_server = server
+        if self.trainer is not None:
+            self._train_thread = threading.Thread(
+                target=self._train_loop, daemon=True
+            )
+            self._train_thread.start()
         self.log.info(
             "ext-proc server started",
             port=port,
@@ -155,6 +185,20 @@ class ExtProcServerRunner:
             metrics_port=self.opts.metrics_port,
         )
         return port
+
+    def _train_loop(self) -> None:
+        """Periodic online training + params handoff + checkpointing."""
+        while not self._train_stop.wait(self.opts.predictor_train_interval_s):
+            try:
+                loss = self.trainer.train(steps=10)
+                if loss is None:
+                    continue
+                self.scheduler.set_predictor_params(self.trainer.params)
+                self.log.v(3).info("predictor trained", loss=loss)
+                if self.opts.predictor_checkpoint_dir:
+                    self.trainer.save(self.opts.predictor_checkpoint_dir)
+            except Exception as e:  # training must never take the EPP down
+                self.log.error("predictor training failed", err=e)
 
     def wait(self) -> None:
         if self.grpc_server is not None:
@@ -165,6 +209,9 @@ class ExtProcServerRunner:
         if self._stopped.is_set():
             return
         self._stopped.set()
+        self._train_stop.set()
+        if self._train_thread is not None:
+            self._train_thread.join(timeout=5)
         if self.grpc_server is not None:
             self.grpc_server.stop(grace).wait()
         if self.health_server is not None:

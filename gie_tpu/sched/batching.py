@@ -31,6 +31,7 @@ from gie_tpu.extproc.server import (
 from gie_tpu.extproc import metadata as mdkeys
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.hashing import batch_chunk_hashes
+from gie_tpu.models.latency import host_features
 from gie_tpu.sched.profile import Scheduler, request_cost_host
 from gie_tpu.sched.types import RequestBatch
 from gie_tpu.utils.lora import LoraRegistry
@@ -101,6 +102,7 @@ class BatchingTPUPicker:
         max_wait_s: float = 0.002,
         max_batch: int = C.N_BUCKETS[-1],
         lora_registry: Optional[LoraRegistry] = None,
+        trainer=None,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
@@ -110,6 +112,9 @@ class BatchingTPUPicker:
         # MUST be the same registry the metrics scraper interns adapter
         # names through, or affinity compares ids from two unrelated spaces.
         self.lora_registry = lora_registry if lora_registry is not None else LoraRegistry()
+        # Optional models.latency.OnlineTrainer: pick-time feature rows are
+        # recorded and completed by served feedback (measured latency).
+        self.trainer = trainer
         self._pending: list[_Pending] = []
         self._cond = threading.Condition()
         self._closed = False
@@ -136,14 +141,28 @@ class BatchingTPUPicker:
 
     def observe_served(self, served_hostport: str, ctx) -> None:
         """Served-endpoint feedback -> assumed-load release
-        (004 README:84-101)."""
+        (004 README:84-101) + latency-predictor training signal."""
         ep = self.datastore.endpoint_by_hostport(served_hostport)
         if ep is None:
             return
-        cost = getattr(getattr(ctx, "pick_result", None), "assumed_cost", 1.0)
+        pick_result = getattr(ctx, "pick_result", None)
+        cost = getattr(pick_result, "assumed_cost", 1.0)
         self.scheduler.complete(
             np.asarray([ep.slot], np.int32), np.asarray([cost], np.float32)
         )
+        feedback = getattr(pick_result, "feedback", None)
+        if self.trainer is not None and feedback is not None:
+            features, picked_at, picked_hostport = feedback
+            if served_hostport != picked_hostport:
+                # The data plane failed over to a fallback: the recorded
+                # features describe the PRIMARY endpoint, so training on
+                # this latency would mislabel the pair. Skip.
+                return
+            elapsed = max(time.monotonic() - picked_at, 1e-4)
+            # Response headers arrive ~ first token: elapsed approximates
+            # TTFT; TPOT is unobservable at this hop (no token counts), so
+            # the sample trains the TTFT head only (tpot masked).
+            self.trainer.observe(features, ttft_s=elapsed, tpot_s=None)
 
     def close(self) -> None:
         with self._cond:
@@ -210,6 +229,10 @@ class BatchingTPUPicker:
         )
         endpoints = self.datastore.endpoints()
         eps = self.metrics_store.endpoint_batch(endpoints)
+        if self.trainer is not None:
+            # One bulk device->host transfer per wave, not one per request.
+            load_snapshot = self.scheduler.snapshot_assumed_load()
+            metrics_np = np.asarray(eps.metrics)
         result = self.scheduler.pick(reqs, eps)
 
         by_slot = {ep.slot: ep for ep in endpoints}
@@ -240,5 +263,18 @@ class BatchingTPUPicker:
                     own_metrics.PICKS.labels(outcome="ok").inc()
                     res = PickResult(endpoint=picked[0], fallbacks=picked[1:])
                     res.assumed_cost = request_cost_host(float(plen[i]))
+                    if self.trainer is not None:
+                        slot = int(indices[i][0])
+                        res.feedback = (
+                            host_features(
+                                metrics_np[slot],
+                                float(load_snapshot[slot]),
+                                float(plen[i]),
+                                0.0,
+                                bool(lora[i] >= 0),
+                            ),
+                            time.monotonic(),
+                            picked[0],  # primary hostport the features describe
+                        )
                     item.result = res
             item.event.set()

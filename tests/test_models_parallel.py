@@ -109,3 +109,85 @@ def test_online_training_handoff_to_scheduler():
     sched.set_predictor_params(trainer.params)
     res2 = sched.pick(make_requests(4), eps)  # must not raise / recompile
     assert (np.asarray(res2.indices[:, 0]) >= 0).all()
+
+
+def test_checkpoint_save_restore(tmp_path):
+    """Predictor params survive a restart (the only durable state,
+    SURVEY 5.4)."""
+    p = LatencyPredictor()
+    t1 = OnlineTrainer(p, batch_size=32)
+    rng = np.random.default_rng(2)
+    for _ in range(64):
+        f = rng.uniform(0, 1, NUM_FEATURES).astype(np.float32)
+        t1.observe(f, ttft_s=f[3], tpot_s=0.01)
+    t1.train(steps=5)
+    ckpt = str(tmp_path / "predictor")
+    t1.save(ckpt)
+    t2 = OnlineTrainer(LatencyPredictor(), seed=99)
+    assert t2.restore(ckpt)
+    for a, b in zip(jax.tree.leaves(t1.params), jax.tree.leaves(t2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert not OnlineTrainer(LatencyPredictor()).restore(str(tmp_path / "none"))
+
+
+def test_picker_feedback_trains_predictor():
+    """Pick-time features + served feedback flow into the trainer through
+    the real batching picker."""
+    from gie_tpu.datastore import Datastore
+    from gie_tpu.datastore.objects import EndpointPool
+    from gie_tpu.metricsio import MetricsStore
+    from gie_tpu.sched.batching import BatchingTPUPicker
+    from gie_tpu.extproc.server import PickRequest
+
+    p = LatencyPredictor()
+    trainer = OnlineTrainer(p, batch_size=8)
+    ds = Datastore()
+    ds.pool_set(EndpointPool({"app": "x"}, [8000], "default"))
+    from gie_tpu.datastore.objects import Pod
+
+    ds.pod_update_or_add(Pod(name="p0", labels={"app": "x"}, ip="10.0.0.1"))
+    picker = BatchingTPUPicker(
+        Scheduler(), ds, MetricsStore(), max_wait_s=0.001, trainer=trainer
+    )
+    try:
+        for i in range(10):
+            res = picker.pick(
+                PickRequest(headers={}, body=b"hello %d" % i),
+                ds.endpoints(),
+            )
+            assert res.feedback is not None
+            feats, _, hostport = res.feedback
+            assert hostport == res.endpoint
+            assert feats.shape == (NUM_FEATURES,)
+
+            class Ctx:
+                pick_result = res
+
+            picker.observe_served(res.endpoint, Ctx())
+        assert trainer._n == 10
+        assert trainer.train(steps=1) is not None
+    finally:
+        picker.close()
+
+
+def test_tpot_head_masked_when_unobserved():
+    """TTFT-only samples must not drag the TPOT head to zero."""
+    p = LatencyPredictor()
+    trainer = OnlineTrainer(p, batch_size=32)
+    rng = np.random.default_rng(3)
+    # Pre-train TPOT on full observations.
+    for _ in range(256):
+        f = rng.uniform(0, 1, NUM_FEATURES).astype(np.float32)
+        trainer.observe(f, ttft_s=0.5, tpot_s=0.08)
+    for _ in range(40):
+        trainer.train(steps=5)
+    feats = rng.uniform(0, 1, (16, NUM_FEATURES)).astype(np.float32)
+    tpot_before = float(np.mean(np.asarray(p.predict(trainer.params, feats))[:, 1]))
+    # Now flood with TTFT-only samples (tpot unobserved).
+    for _ in range(512):
+        f = rng.uniform(0, 1, NUM_FEATURES).astype(np.float32)
+        trainer.observe(f, ttft_s=0.5, tpot_s=None)
+    for _ in range(40):
+        trainer.train(steps=5)
+    tpot_after = float(np.mean(np.asarray(p.predict(trainer.params, feats))[:, 1]))
+    assert tpot_after > tpot_before * 0.5  # head not collapsed toward zero
