@@ -21,6 +21,12 @@ from gie_tpu.extproc.service import SERVICE_NAME as EXTPROC_SERVICE
 
 HEALTH_SERVICE = "grpc.health.v1.Health"
 
+# Named sub-services per the endpoint-picker protocol (004 README:103-137):
+# liveness = process alive (no datastore/leader dependency); readiness and
+# the ext-proc service name = synced AND leading.
+LIVENESS_SERVICE = "liveness"
+READINESS_SERVICE = "readiness"
+
 SERVING = health_pb2.HealthCheckResponse.SERVING
 NOT_SERVING = health_pb2.HealthCheckResponse.NOT_SERVING
 
@@ -32,7 +38,9 @@ class HealthService:
         self.ready_fn = ready_fn
 
     def _status(self, service: str) -> int:
-        known = ("", EXTPROC_SERVICE, HEALTH_SERVICE)
+        if service == LIVENESS_SERVICE:
+            return SERVING  # answering at all == alive
+        known = ("", READINESS_SERVICE, EXTPROC_SERVICE, HEALTH_SERVICE)
         if service not in known:
             return health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
         return SERVING if self.ready_fn() else NOT_SERVING

@@ -33,6 +33,12 @@ class Options:
     enable_predictor: bool = False
     predictor_checkpoint_dir: Optional[str] = None
     predictor_train_interval_s: float = 5.0
+    # Multi-replica leader election (readiness gates on leadership).
+    leader_elect: bool = False
+    leader_lease_path: str = "/tmp/gie-tpu-epp.lease"
+    # InferenceObjective declarations: "name=criticality" pairs (the CLI
+    # stand-in for the CRD until a kube watch adapter supplies them).
+    objectives: list = dataclasses.field(default_factory=list)
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -72,6 +78,13 @@ class Options:
                             default=d.predictor_checkpoint_dir)
         parser.add_argument("--predictor-train-interval-s", type=float,
                             default=d.predictor_train_interval_s)
+        parser.add_argument("--leader-elect", action="store_true",
+                            default=d.leader_elect)
+        parser.add_argument("--leader-lease-path", default=d.leader_lease_path)
+        parser.add_argument("--objective", action="append", default=[],
+                            dest="objectives", metavar="NAME=CRITICALITY",
+                            help="register an InferenceObjective "
+                                 "(repeatable), e.g. premium-chat=3")
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "Options":
@@ -91,6 +104,9 @@ class Options:
             enable_predictor=args.enable_predictor,
             predictor_checkpoint_dir=args.predictor_checkpoint_dir,
             predictor_train_interval_s=args.predictor_train_interval_s,
+            leader_elect=args.leader_elect,
+            leader_lease_path=args.leader_lease_path,
+            objectives=list(args.objectives),
         )
 
     def validate(self) -> None:
@@ -106,3 +122,15 @@ class Options:
                 raise ValueError(f"--{name} {port} out of range")
         if self.verbosity < 0 or self.verbosity > 5:
             raise ValueError("-v must be 0..5")
+        for spec in self.objectives:
+            name, sep, crit = spec.partition("=")
+            if not sep or not name:
+                raise ValueError(
+                    f"--objective {spec!r} must be NAME=CRITICALITY"
+                )
+            try:
+                int(crit)
+            except ValueError:
+                raise ValueError(
+                    f"--objective {spec!r}: criticality must be an integer"
+                ) from None

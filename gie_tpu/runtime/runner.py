@@ -92,6 +92,28 @@ class ExtProcServerRunner:
         )
         self._train_stop = threading.Event()
         self._train_thread: Optional[threading.Thread] = None
+        self.elector = None
+        if opts.leader_elect:
+            from gie_tpu.runtime.leader import LeaseFileElector
+
+            self.elector = LeaseFileElector(opts.leader_lease_path)
+        # Objective registry (proposal 1199): named objectives -> bands,
+        # populated from --objective NAME=CRITICALITY declarations (the CRD
+        # watch adapter feeds the same registry in a kube deployment).
+        from gie_tpu.api.objectives import InferenceObjective, ObjectiveRegistry
+
+        self.objectives = ObjectiveRegistry()
+        for spec in opts.objectives:
+            name, _, crit = spec.partition("=")
+            self.objectives.apply(
+                InferenceObjective(
+                    name=name,
+                    pool_ref=opts.pool_name,
+                    criticality=int(crit),
+                    namespace=opts.pool_namespace,
+                )
+            )
+        self.picker.objective_registry = self.objectives
         self.streaming = StreamingServer(
             self.datastore, self.picker, on_served=self.picker.observe_served
         )
@@ -99,6 +121,15 @@ class ExtProcServerRunner:
         self.health_server: Optional[grpc.Server] = None
         self._cert_reloader = None
         self._stopped = threading.Event()
+
+    def ready(self) -> bool:
+        """Readiness per 004 README:111-115: datastore synced AND (leader
+        when electing)."""
+        if not self.datastore.pool_has_synced():
+            return False
+        if self.elector is not None and not self.elector.is_leader():
+            return False
+        return True
 
     # -- scrape lifecycle follows endpoint lifecycle -----------------------
 
@@ -150,8 +181,10 @@ class ExtProcServerRunner:
         bound ext-proc port."""
         # Dedicated health first — NOT_SERVING beats connection-refused
         # during startup (reference main.go:104-109).
+        if self.elector is not None:
+            self.elector.start()
         self.health_server, _ = start_dedicated_health_server(
-            self.datastore.pool_has_synced, self.opts.grpc_health_port
+            self.ready, self.opts.grpc_health_port
         )
         try:
             own_metrics.start_metrics_server(self.opts.metrics_port)
@@ -161,7 +194,7 @@ class ExtProcServerRunner:
         server = grpc.server(futures.ThreadPoolExecutor(max_workers=64))
         add_extproc_service(server, self.streaming)
         # Colocated health on the ext-proc port (runserver.go:117-123).
-        HealthService(self.datastore.pool_has_synced).add_to_server(server)
+        HealthService(self.ready).add_to_server(server)
         addr = f"0.0.0.0:{self.opts.grpc_port}"
         if self.opts.secure_serving:
             creds, self._cert_reloader = server_credentials(self.opts.cert_path)
@@ -218,6 +251,8 @@ class ExtProcServerRunner:
             self.health_server.stop(0)
         self.picker.close()
         self.scraper.close()
+        if self.elector is not None:
+            self.elector.stop()
         if self._cert_reloader is not None:
             self._cert_reloader.close()
         self.log.info("shutdown complete")

@@ -38,14 +38,21 @@ from gie_tpu.utils.lora import LoraRegistry
 
 import jax.numpy as jnp
 
-_CRITICALITY_BY_NAME = {
-    "critical": C.Criticality.CRITICAL,
-    "standard": C.Criticality.STANDARD,
-    "sheddable": C.Criticality.SHEDDABLE,
-}
+def _band_for(headers: dict, registry=None) -> int:
+    """Scheduler band from the objective header: a registered
+    InferenceObjective name (proposal 1199) or a literal band name."""
+    from gie_tpu.api.objectives import LITERAL_BANDS
+
+    value = headers.get(mdkeys.OBJECTIVE_KEY, [""])[0]
+    if registry is not None:
+        band = registry.resolve_band(value)
+        if band is not None:
+            return band
+    return LITERAL_BANDS.get(value.lower().strip(),
+                             int(C.Criticality.STANDARD))
 
 
-def _fair_order(items: list["_Pending"]) -> list["_Pending"]:
+def _fair_order(items: list["_Pending"], registry=None) -> list["_Pending"]:
     """Criticality bands first, round-robin by fairness ID within a band.
 
     Proposal 1199 scopes fairness within a priority band: CRITICAL drains
@@ -58,8 +65,7 @@ def _fair_order(items: list["_Pending"]) -> list["_Pending"]:
     bands: dict[int, dict[str, deque]] = {}
     band_order: dict[int, list[str]] = {}
     for it in items:
-        obj = it.req.headers.get(mdkeys.OBJECTIVE_KEY, [""])[0].lower()
-        band = int(_CRITICALITY_BY_NAME.get(obj, C.Criticality.STANDARD))
+        band = _band_for(it.req.headers, registry)
         fid = it.req.headers.get(mdkeys.FLOW_FAIRNESS_ID_KEY, [""])[0]
         per = bands.setdefault(band, {})
         if fid not in per:
@@ -115,6 +121,9 @@ class BatchingTPUPicker:
         # Optional models.latency.OnlineTrainer: pick-time feature rows are
         # recorded and completed by served feedback (measured latency).
         self.trainer = trainer
+        # Optional api.objectives.ObjectiveRegistry resolving named
+        # InferenceObjectives to criticality bands (proposal 1199).
+        self.objective_registry = None
         self._pending: list[_Pending] = []
         self._cond = threading.Condition()
         self._closed = False
@@ -187,7 +196,9 @@ class BatchingTPUPicker:
                     # interleave round-robin across fairness IDs
                     # (x-gateway-inference-fairness-id header, proposal 1199 /
                     # flow control) so one tenant cannot monopolize a wave.
-                    self._pending = _fair_order(self._pending)
+                    self._pending = _fair_order(
+                        self._pending, self.objective_registry
+                    )
                 batch = self._pending[: self.max_batch]
                 self._pending = self._pending[self.max_batch :]
             try:
@@ -210,8 +221,7 @@ class BatchingTPUPicker:
         mask = np.zeros((n, C.M_MAX), bool)
         for i, it in enumerate(batch):
             lora[i] = self.lora_registry.id_for(it.req.model)
-            obj = it.req.headers.get(mdkeys.OBJECTIVE_KEY, [""])[0].lower()
-            crit[i] = _CRITICALITY_BY_NAME.get(obj, C.Criticality.STANDARD)
+            crit[i] = _band_for(it.req.headers, self.objective_registry)
             plen[i] = float(len(prompts[i]))
             for ep in it.candidates:
                 if 0 <= ep.slot < C.M_MAX:

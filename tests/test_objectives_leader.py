@@ -1,0 +1,215 @@
+"""InferenceObjective registry (proposal 1199) + leader election tests."""
+
+import time
+
+import numpy as np
+
+from gie_tpu.api.objectives import (
+    InferenceObjective,
+    ObjectiveRegistry,
+    band_for,
+)
+from gie_tpu.runtime.leader import LeaseFileElector
+from gie_tpu.sched.constants import Criticality
+
+
+def test_band_mapping():
+    assert band_for(5) == Criticality.CRITICAL
+    assert band_for(2) == Criticality.CRITICAL
+    assert band_for(1) == Criticality.STANDARD
+    assert band_for(0) == Criticality.SHEDDABLE
+    assert band_for(-3) == Criticality.SHEDDABLE
+
+
+def test_registry_resolves_names_and_literals():
+    reg = ObjectiveRegistry()
+    reg.apply(InferenceObjective(name="premium-chat", pool_ref="pool",
+                                 criticality=3))
+    reg.apply(InferenceObjective(name="batch-jobs", pool_ref="pool",
+                                 criticality=0))
+    assert reg.resolve_band("premium-chat") == Criticality.CRITICAL
+    assert reg.resolve_band("batch-jobs") == Criticality.SHEDDABLE
+    assert reg.resolve_band("critical") == Criticality.CRITICAL  # literal
+    assert reg.resolve_band("unknown-name") is None
+    assert reg.resolve_band("") is None
+    reg.delete("default", "premium-chat")
+    assert reg.resolve_band("premium-chat") is None
+
+
+def test_objective_drives_scheduler_band():
+    """A registered sheddable objective must shed under saturation through
+    the batching picker."""
+    from gie_tpu.datastore import Datastore
+    from gie_tpu.datastore.objects import EndpointPool, Pod
+    from gie_tpu.extproc import metadata as mdkeys
+    from gie_tpu.extproc.server import PickRequest, ShedError
+    from gie_tpu.metricsio import MetricsStore
+    from gie_tpu.sched import Metric, ProfileConfig, Scheduler
+    from gie_tpu.sched.batching import BatchingTPUPicker
+
+    reg = ObjectiveRegistry()
+    reg.apply(InferenceObjective(name="batch-tier", pool_ref="p",
+                                 criticality=0))
+    ds = Datastore()
+    ds.pool_set(EndpointPool({"app": "x"}, [8000], "default"))
+    ds.pod_update_or_add(Pod(name="p0", labels={"app": "x"}, ip="10.0.0.1"))
+    ms = MetricsStore()
+    ms.update(ds.endpoints()[0].slot,
+              {Metric.QUEUE_DEPTH: 500, Metric.KV_CACHE_UTIL: 0.99})
+    picker = BatchingTPUPicker(
+        Scheduler(ProfileConfig(queue_limit=10, kv_limit=0.9)), ds, ms,
+        max_wait_s=0.001,
+    )
+    picker.objective_registry = reg
+    try:
+        try:
+            picker.pick(
+                PickRequest(headers={mdkeys.OBJECTIVE_KEY: ["batch-tier"]},
+                            body=b"x"),
+                ds.endpoints(),
+            )
+            raise AssertionError("expected ShedError")
+        except ShedError:
+            pass
+    finally:
+        picker.close()
+
+
+def test_leader_election_single_winner(tmp_path):
+    lease = str(tmp_path / "epp.lease")
+    a = LeaseFileElector(lease, lease_ttl_s=1.0, renew_interval_s=0.1)
+    b = LeaseFileElector(lease, lease_ttl_s=1.0, renew_interval_s=0.1)
+    a.start()
+    time.sleep(0.4)
+    b.start()
+    time.sleep(0.5)
+    try:
+        assert a.is_leader()
+        assert not b.is_leader()
+        # Leader dies -> follower takes over within the TTL.
+        a.stop()
+        deadline = time.time() + 5
+        while time.time() < deadline and not b.is_leader():
+            time.sleep(0.1)
+        assert b.is_leader()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_health_liveness_vs_readiness():
+    """004 README:103-137: liveness is unconditional; readiness gates."""
+    import grpc
+
+    from gie_tpu.runtime.health import (
+        LIVENESS_SERVICE,
+        READINESS_SERVICE,
+        start_dedicated_health_server,
+    )
+    import health_pb2
+
+    ready = {"v": False}
+    server, port = start_dedicated_health_server(lambda: ready["v"], 0)
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        check = ch.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        live = check(health_pb2.HealthCheckRequest(service=LIVENESS_SERVICE))
+        assert live.status == health_pb2.HealthCheckResponse.SERVING
+        rdy = check(health_pb2.HealthCheckRequest(service=READINESS_SERVICE))
+        assert rdy.status == health_pb2.HealthCheckResponse.NOT_SERVING
+        ready["v"] = True
+        rdy = check(health_pb2.HealthCheckRequest(service=READINESS_SERVICE))
+        assert rdy.status == health_pb2.HealthCheckResponse.SERVING
+        ch.close()
+    finally:
+        server.stop(0)
+
+
+def test_leader_takeover_atomic_under_contention(tmp_path):
+    """Many contenders racing for an expired lease: at most one leader at
+    any observation point."""
+    lease = str(tmp_path / "contended.lease")
+    # Seed an expired lease.
+    with open(lease, "w") as f:
+        f.write("dead-replica\n1.0")
+    electors = [
+        LeaseFileElector(lease, lease_ttl_s=2.0, renew_interval_s=0.05)
+        for _ in range(6)
+    ]
+    for e in electors:
+        e.start()
+    try:
+        time.sleep(1.0)
+        for _ in range(10):
+            leaders = [e for e in electors if e.is_leader()]
+            assert len(leaders) <= 1
+            time.sleep(0.05)
+        assert any(e.is_leader() for e in electors)
+    finally:
+        for e in electors:
+            e.stop()
+
+
+def test_future_timestamp_lease_not_eternal(tmp_path):
+    """A corrupt/future-dated lease must be taken over, not brick the
+    deployment."""
+    lease = str(tmp_path / "future.lease")
+    with open(lease, "w") as f:
+        f.write(f"ghost\n{time.time() + 9_999_999}")
+    e = LeaseFileElector(lease, lease_ttl_s=1.0, renew_interval_s=0.1)
+    e.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not e.is_leader():
+            time.sleep(0.05)
+        assert e.is_leader()
+    finally:
+        e.stop()
+
+
+def test_stale_stop_does_not_unlink_new_leader(tmp_path):
+    """A replica that lost leadership must not delete the new leader's
+    lease on shutdown."""
+    lease = str(tmp_path / "handoff.lease")
+    a = LeaseFileElector(lease, lease_ttl_s=0.5, renew_interval_s=10.0)
+    a.start()
+    time.sleep(0.2)
+    assert a.is_leader()
+    # a's renew thread sleeps 10s; its lease expires at 0.5s and b takes it.
+    b = LeaseFileElector(lease, lease_ttl_s=0.5, renew_interval_s=0.1)
+    time.sleep(0.6)
+    b.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not b.is_leader():
+        time.sleep(0.05)
+    assert b.is_leader()
+    a.stop()  # stale leader flag; must NOT unlink b's lease
+    time.sleep(0.3)
+    assert b.is_leader()
+    b.stop()
+
+
+def test_objective_flag_roundtrip():
+    """--objective NAME=CRITICALITY populates the runner registry."""
+    import argparse
+
+    from gie_tpu.runtime.options import Options
+
+    parser = argparse.ArgumentParser()
+    Options.add_flags(parser)
+    args = parser.parse_args(
+        ["--pool-name", "p", "--objective", "premium=3",
+         "--objective", "batch=0"]
+    )
+    opts = Options.from_args(args)
+    opts.validate()
+    assert opts.objectives == ["premium=3", "batch=0"]
+    import pytest as _pytest
+
+    bad = parser.parse_args(["--pool-name", "p", "--objective", "nope"])
+    with _pytest.raises(ValueError, match="NAME=CRITICALITY"):
+        Options.from_args(bad).validate()
