@@ -27,6 +27,7 @@ from typing import Optional, Protocol
 import grpc
 
 from gie_tpu.extproc import envoy, metadata, pb
+from gie_tpu.runtime import tracing
 
 MAX_REQUEST_BODY_SIZE = 10 * 1024 * 1024  # reference server.go:103
 
@@ -145,7 +146,8 @@ class StreamingServer:
                 return
             which = req.WhichOneof("request")
             if which == "request_headers":
-                self._handle_request_headers(ctx, req)
+                with tracing.span("extproc.request_headers"):
+                    self._handle_request_headers(ctx, req)
                 if req.request_headers.end_of_stream:
                     try:
                         self._pick(ctx, None)
@@ -279,10 +281,15 @@ class StreamingServer:
 
     def _pick(self, ctx: RequestContext, body: Optional[bytes]) -> PickResult:
         """reference handlers/request.go:141-163."""
+        with tracing.span("extproc.pick", candidates=len(ctx.candidates)):
+            return self._pick_inner(ctx, body)
+
+    def _pick_inner(self, ctx: RequestContext, body: Optional[bytes]) -> PickResult:
         bbr_headers: dict[str, str] = {}
         bbr_body: Optional[bytes] = None
         if self.bbr_chain is not None and body:
-            bbr_headers, bbr_body = self.bbr_chain.execute(body)
+            with tracing.span("extproc.bbr"):
+                bbr_headers, bbr_body = self.bbr_chain.execute(body)
         # Model precedence: an explicit rewrite (from BBR's rewrite plugin,
         # else the upstream rewrite header) beats the raw extracted body
         # model (proposal 1816 rewrite > 1964 extraction).

@@ -1,0 +1,5 @@
+"""Low-level TPU kernels (pallas)."""
+
+from gie_tpu.ops.fused_topk import fused_blend_topk
+
+__all__ = ["fused_blend_topk"]

@@ -1,0 +1,94 @@
+"""Fused scorer-blend + top-k pallas kernel.
+
+The scheduling cycle's pick stage consumes S scorer columns [S, N, M], a
+weight vector [S] and an eligibility mask [N, M], and needs the top-k
+(scores, indices) per request row. The XLA path materializes the blended
+[N, M] matrix to HBM and re-reads it k times for the iterative arg-max; this
+kernel fuses blend + mask + k rounds of (max, index-extract, mask-out) into
+one VMEM-resident pass per N-tile — each scorer column is read exactly once
+from HBM and nothing [N, M]-shaped is written back.
+
+Layout: grid over N tiles; each program holds its [S, BN, M] column slab and
+a [BN, M] working copy in VMEM. Index extraction uses
+min(where(x == rowmax, iota, M)) (first-max tie-break, matching jnp.argmax
+semantics) — pure VPU reductions, no sort.
+
+Used behind ProfileConfig(use_pallas_topk=True); parity with the reference
+jnp implementation is tested in interpret mode on CPU.
+
+NOTE (round 1, axon backend): pallas_call compilation through this
+container's remote-compile tunnel hangs indefinitely (even for a trivial
+out[:] = in[:] * 2 kernel), so the flag stays off by default here; on a
+standard TPU VM the kernel compiles with the normal Mosaic pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gie_tpu.sched.constants import NEG_SCORE as NEG
+
+
+def _kernel(stacked_ref, wvec_ref, mask_ref, vals_ref, idxs_ref, *, k: int):
+    s = stacked_ref.shape[0]
+    bn, m = mask_ref.shape
+    # Blend: sum_s w[s] * col[s], normalized by sum(w) (profile semantics).
+    w = wvec_ref[:]                                   # [S, 1] f32 (SMEM-ish)
+    total = jnp.zeros((bn, m), jnp.float32)
+    for si in range(s):
+        total = total + w[si, 0] * stacked_ref[si]
+    total = total / jnp.maximum(jnp.sum(w), 1e-6)
+    x = jnp.where(mask_ref[:], total, NEG)
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, (bn, m), 1)
+    for round_ in range(k):
+        rowmax = jnp.max(x, axis=1, keepdims=True)            # [BN, 1]
+        is_max = x == rowmax
+        idx = jnp.min(jnp.where(is_max, iota, m), axis=1, keepdims=True)
+        vals_ref[:, round_] = rowmax[:, 0]
+        idxs_ref[:, round_] = idx[:, 0]
+        x = jnp.where(iota == idx, NEG, x)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "block_n", "interpret"))
+def fused_blend_topk(
+    stacked: jax.Array,  # f32[S, N, M]
+    wvec: jax.Array,     # f32[S]
+    mask: jax.Array,     # bool[N, M]
+    *,
+    k: int = 4,
+    block_n: int = 128,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """-> (values f32[N, k], indices i32[N, k]); ineligible rows yield NEG
+    values (callers translate to -1 like pickers._finalize)."""
+    s, n, m = stacked.shape
+    block_n = min(block_n, n)
+    if n % block_n != 0:
+        raise ValueError(f"N={n} must be divisible by block_n={block_n}")
+    grid = (n // block_n,)
+    kernel = functools.partial(_kernel, k=k)
+    vals, idxs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((s, block_n, m), lambda i: (0, i, 0)),
+            pl.BlockSpec((s, 1), lambda i: (0, 0)),
+            pl.BlockSpec((block_n, m), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, k), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, k), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, k), jnp.float32),
+            jax.ShapeDtypeStruct((n, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(stacked, wvec.reshape(s, 1), mask)
+    return vals, idxs

@@ -1,0 +1,41 @@
+"""Lightweight span tracing for the request path.
+
+The reference has no in-tree tracing (SURVEY.md 5.1 — OTLP appears only as
+an indirect dependency); this greenfield implementation records span
+durations into a per-span prometheus histogram and, at TRACE verbosity,
+emits structured span logs. Spans nest via a context manager; the overhead
+when nobody scrapes/logs is two clock reads.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import prometheus_client as prom
+
+from gie_tpu.runtime.logging import TRACE, get_logger
+from gie_tpu.runtime.metrics import REGISTRY
+
+SPANS = prom.Histogram(
+    "gie_span_seconds",
+    "Duration of traced request-path spans",
+    ["span"],
+    buckets=(1e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0),
+    registry=REGISTRY,
+)
+
+_log = get_logger("trace")
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Time a request-path section: prometheus histogram always, TRACE-level
+    structured log when verbosity allows."""
+    started = time.monotonic()
+    try:
+        yield
+    finally:
+        elapsed = time.monotonic() - started
+        SPANS.labels(span=name).observe(elapsed)
+        _log.v(TRACE).info("span", name=name, seconds=round(elapsed, 6), **attrs)

@@ -68,6 +68,11 @@ LORA_SLOTS = 8
 # pkg/lwepp/handlers/server.go:72-77 PickResult.Fallbacks).
 FALLBACKS = 4
 
+# Sentinel for masked/ineligible score lanes. A plain Python float on
+# purpose: module-level jnp constants captured into jit dispatch ~80x
+# slower on the axon backend.
+NEG_SCORE = float(-1e9)
+
 # Prefix-table slot count (power of two).
 PREFIX_SLOTS = 1 << 15
 

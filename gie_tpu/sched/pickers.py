@@ -18,10 +18,7 @@ from gie_tpu.sched import constants as C
 from gie_tpu.sched.types import PickResult
 
 
-# Python/numpy scalars, NOT jnp arrays: a jitted function that closes over a
-# module-level device array dispatches ~80x slower on the axon TPU backend
-# (and degrades the whole process); plain scalars inline as HLO literals.
-NEG = float(-1e9)
+NEG = C.NEG_SCORE
 
 # Score quantization for tie-breaking: blended scores live in [0, 1]; deltas
 # below _TIE_RESOLUTION are treated as ties and broken by rotation. The
@@ -51,16 +48,17 @@ def _topk(masked: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     return jnp.stack(vals, axis=-1), jnp.stack(idxs, axis=-1)
 
 
-def _finalize(
-    masked: jax.Array,  # f32[N, M] score matrix with ineligible lanes at NEG
+def finalize_from_topk(
+    top_scores: jax.Array,  # f32[N, k] (NEG-filled where ineligible)
+    top_idx: jax.Array,     # i32[N, k]
     mask: jax.Array,
     shed: jax.Array,
     valid: jax.Array,
 ) -> PickResult:
-    """Shared pick postlude: top-k fallback list + status gating."""
-    top_scores, top_idx = _topk(masked, C.FALLBACKS)
+    """Status/index gating shared by every picker (including the pallas
+    fused path): ok-threshold, NO_CAPACITY/SHED cascade, OK-only indices."""
     ok = top_scores > NEG / 2
-    indices = jnp.where(ok, top_idx, -1).astype(jnp.int32)
+    indices = jnp.where(ok, top_idx.astype(jnp.int32), -1)
 
     any_candidate = jnp.any(mask, axis=-1)
     status = jnp.where(any_candidate, C.Status.OK, C.Status.NO_CAPACITY)
@@ -69,6 +67,17 @@ def _finalize(
 
     indices = jnp.where((status == C.Status.OK)[:, None], indices, -1)
     return PickResult(indices=indices, status=status, scores=top_scores)
+
+
+def _finalize(
+    masked: jax.Array,  # f32[N, M] score matrix with ineligible lanes at NEG
+    mask: jax.Array,
+    shed: jax.Array,
+    valid: jax.Array,
+) -> PickResult:
+    """Shared pick postlude: top-k fallback list + status gating."""
+    top_scores, top_idx = _topk(masked, C.FALLBACKS)
+    return finalize_from_topk(top_scores, top_idx, mask, shed, valid)
 
 
 def topk_picker(

@@ -69,6 +69,11 @@ class ProfileConfig:
     sinkhorn_tau: float = 0.02   # OT temperature (lower = greedier)
     sinkhorn_iters: int = 8
     sinkhorn_rounding_temp: float = 0.1  # randomized-rounding noise scale
+    # Fused pallas blend+topk kernel for the "topk" picker (single HBM pass
+    # over the scorer columns; first-max tie-break instead of the rotating
+    # quantized tie-break). Off by default; enable where profiling shows
+    # the kernel wins on the target backend.
+    use_pallas_topk: bool = False
 
 
 def request_cost(reqs: RequestBatch) -> jax.Array:
@@ -161,7 +166,15 @@ def scheduling_cycle(
     )
 
     # ---- Pick stage ------------------------------------------------------
-    if cfg.picker == "random":
+    if cfg.picker == "topk" and cfg.use_pallas_topk:
+        from gie_tpu.ops.fused_topk import fused_blend_topk
+
+        interp = jax.default_backend() not in ("tpu",)
+        vals, idxs = fused_blend_topk(
+            stacked, wvec, mask, k=C.FALLBACKS, interpret=interp
+        )
+        result = pickers.finalize_from_topk(vals, idxs, mask, shed, reqs.valid)
+    elif cfg.picker == "random":
         result = pickers.weighted_random_picker(
             total, mask, shed, reqs.valid, key,
             temperature=cfg.sample_temperature,

@@ -325,3 +325,17 @@ def test_sinkhorn_padded_wave_still_spreads():
     counts = collections.Counter(int(i) for i in np.asarray(res.indices[:, 0]))
     assert max(counts.values()) <= 5
     assert len(counts) >= 3
+
+
+def test_pallas_fused_topk_matches_default_path():
+    """Pallas-kernel pick path (interpret mode on CPU) must agree with the
+    default path wherever scores are untied; statuses must match exactly."""
+    cfg_ref = ProfileConfig(enable_prefix=False)
+    cfg_pl = ProfileConfig(enable_prefix=False, use_pallas_topk=True)
+    eps = make_endpoints(8, queue=[0, 3, 7, 1, 9, 2, 5, 4])
+    reqs = make_requests(8, subset=[[0, 1, 2, 3, 4, 5, 6, 7]] * 7 + [[400]])
+    r_ref = Scheduler(cfg_ref).pick(reqs, eps)
+    r_pl = Scheduler(cfg_pl).pick(reqs, eps)
+    # Distinct queue depths -> untied scores -> identical ordering.
+    assert (np.asarray(r_ref.indices) == np.asarray(r_pl.indices)).all()
+    assert (np.asarray(r_ref.status) == np.asarray(r_pl.status)).all()
