@@ -35,6 +35,7 @@ class EndpointPool:
     selector: dict[str, str]
     target_ports: list[int]
     namespace: str
+    app_protocol: str = "http"  # "http" | "kubernetes.io/h2c"
 
 
 @dataclasses.dataclass

@@ -26,7 +26,7 @@ from typing import Optional, Protocol
 
 import grpc
 
-from gie_tpu.extproc import envoy, metadata, pb
+from gie_tpu.extproc import codec, envoy, metadata, pb
 from gie_tpu.runtime import tracing
 
 MAX_REQUEST_BODY_SIZE = 10 * 1024 * 1024  # reference server.go:103
@@ -101,6 +101,11 @@ class RequestContext:
     candidates: list = dataclasses.field(default_factory=list)
     target_endpoint: str = ""
     selected_pod_ip: str = ""
+    # http-in -> gRPC-out transcoding state (proposal 2162).
+    transcoding: bool = False
+    stream_requested: bool = False
+    frame_decoder: object = None
+    response_frames: list = dataclasses.field(default_factory=list)
 
 
 class Stream(Protocol):
@@ -114,7 +119,7 @@ class StreamingServer:
     (Envoy opens an ext-proc stream per request)."""
 
     def __init__(self, datastore, picker: EndpointPicker, on_served=None,
-                 bbr_chain=None):
+                 bbr_chain=None, transcode_h2c: bool = True):
         self.datastore = datastore
         self.picker = picker
         # Served-endpoint feedback hook (004 README:84-101): called with the
@@ -124,6 +129,18 @@ class StreamingServer:
         # request body before the pick; its headers join the header mutation
         # and its body mutation is forwarded chunked.
         self.bbr_chain = bbr_chain
+        # http-in -> gRPC-out transcoding for h2c pools (proposal 2162,
+        # preferred detection: the observed InferencePool's appProtocol).
+        self.transcode_h2c = transcode_h2c
+
+    def _pool_wants_grpc(self) -> bool:
+        if not self.transcode_h2c:
+            return False
+        try:
+            pool = self.datastore.pool_get()
+        except Exception:
+            return False
+        return getattr(pool, "app_protocol", "http") == "kubernetes.io/h2c"
 
     # ------------------------------------------------------------------ #
 
@@ -207,11 +224,18 @@ class StreamingServer:
             elif which == "response_headers":
                 stream.send(self._handle_response_headers(ctx, req))
             elif which == "response_body":
-                stream.send(
-                    pb.ProcessingResponse(
-                        response_body=pb.BodyResponse(response=pb.CommonResponse())
+                if ctx.transcoding:
+                    stream.send(
+                        self._transcode_response_body(ctx, req.response_body)
                     )
-                )
+                else:
+                    stream.send(
+                        pb.ProcessingResponse(
+                            response_body=pb.BodyResponse(
+                                response=pb.CommonResponse()
+                            )
+                        )
+                    )
             else:  # trailers etc. — ignored (reference server.go:283-285)
                 continue
 
@@ -311,6 +335,26 @@ class StreamingServer:
         result.extra_headers = {**bbr_headers, **result.extra_headers}
         if result.mutated_body is None and bbr_body is not None:
             result.mutated_body = bbr_body
+
+        # http-in -> gRPC-out (proposal 2162): JSON clients talking to an
+        # h2c/gRPC pool get their (possibly BBR-mutated) completion body
+        # reframed as a gRPC GenerateRequest. gRPC-in clients pass through.
+        if (
+            body is not None
+            and self._pool_wants_grpc()
+            and not codec.is_grpc_request(ctx.headers)
+        ):
+            source = result.mutated_body if result.mutated_body is not None else body
+            framed, stream_requested = codec.json_to_generate_request(source)
+            if framed is not None:
+                ctx.stream_requested = stream_requested
+                ctx.transcoding = True
+                result.mutated_body = framed
+                result.extra_headers = {
+                    **result.extra_headers,
+                    "content-type": codec.GRPC_CONTENT_TYPE,
+                    "te": "trailers",
+                }
         ctx.target_endpoint = result.destination_value
         ctx.selected_pod_ip = result.endpoint.rsplit(":", 1)[0]
         ctx.pick_result = result
@@ -343,6 +387,53 @@ class StreamingServer:
             ),
         )
 
+    def _transcode_response_body(
+        self, ctx: RequestContext, body_msg: pb.HttpBody
+    ) -> pb.ProcessingResponse:
+        """gRPC-out response stream -> SSE (streaming) or JSON (buffered)
+        for the HTTP/JSON client (proposal 2162 response path)."""
+        passthrough = pb.ProcessingResponse(
+            response_body=pb.BodyResponse(response=pb.CommonResponse())
+        )
+        if ctx.frame_decoder is None:
+            ctx.frame_decoder = codec.FrameDecoder()
+        # Same cap as the request path: a runaway backend must not grow EPP
+        # memory unboundedly per in-flight response.
+        if ctx.frame_decoder.bytes_seen + len(body_msg.body) > MAX_REQUEST_BODY_SIZE:
+            raise ExtProcError(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"response body size limit of {MAX_REQUEST_BODY_SIZE} "
+                "bytes exceeded during transcoding",
+            )
+        try:
+            messages = ctx.frame_decoder.feed(body_msg.body)
+        except codec.FrameFormatError:
+            # Undecodable framing (compressed/corrupt): stop transcoding and
+            # pass the backend bytes through rather than kill the stream.
+            ctx.transcoding = False
+            return passthrough
+        if ctx.stream_requested:
+            out = b"".join(
+                codec.generate_response_to_sse(m) for m in messages
+            )
+            mutation = pb.BodyMutation(body=out)
+        else:
+            ctx.response_frames.extend(messages)
+            if body_msg.end_of_stream:
+                mutation = pb.BodyMutation(
+                    body=codec.generate_payloads_to_json(ctx.response_frames)
+                )
+            else:
+                mutation = pb.BodyMutation(body=b"")
+        return pb.ProcessingResponse(
+            response_body=pb.BodyResponse(
+                response=pb.CommonResponse(
+                    status=pb.CommonResponse.CONTINUE_AND_REPLACE,
+                    body_mutation=mutation,
+                )
+            )
+        )
+
     def _handle_response_headers(
         self, ctx: RequestContext, req: pb.ProcessingRequest
     ) -> pb.ProcessingResponse:
@@ -359,6 +450,13 @@ class StreamingServer:
         set_headers = {metadata.WENT_INTO_RESP_HEADERS: "true"}
         if served:
             set_headers[metadata.CONFORMANCE_TEST_RESULT_HEADER] = served
+        if ctx.transcoding:
+            # The backend answered application/grpc but the client gets
+            # SSE/JSON after transcoding — relabel accordingly (2162).
+            set_headers["content-type"] = (
+                "text/event-stream" if ctx.stream_requested
+                else "application/json"
+            )
         return pb.ProcessingResponse(
             response_headers=pb.HeadersResponse(
                 response=pb.CommonResponse(

@@ -20,4 +20,5 @@ def to_endpoint_pool(pool: InferencePool) -> EndpointPool:
         selector=dict(pool.spec.selector.matchLabels),
         target_ports=[p.number for p in pool.spec.targetPorts],
         namespace=pool.metadata.namespace,
+        app_protocol=pool.spec.appProtocol,
     )

@@ -1,0 +1,215 @@
+"""gRPC transcoding tests (proposal 2162): framing, JSON<->protobuf, SSE,
+and the full ext-proc transcode choreography for h2c pools."""
+
+import json
+
+import pytest
+
+import gie_tpu.extproc  # noqa: F401 — installs the pb path hook
+import generate_pb2
+
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool
+from gie_tpu.extproc import RoundRobinPicker, StreamingServer, codec, pb
+from tests.test_datastore import make_pod
+from tests.test_extproc import FakeStream, body_msg, dest_header, headers_msg
+
+
+def test_frame_roundtrip():
+    msgs = [b"alpha", b"", b"x" * 1000]
+    framed = b"".join(codec.frame(m) for m in msgs)
+    assert list(codec.iter_frames(framed)) == msgs
+
+
+def test_incremental_decoder_split_boundaries():
+    msgs = [b"one", b"twotwo", b"three33"]
+    framed = b"".join(codec.frame(m) for m in msgs)
+    dec = codec.FrameDecoder()
+    out = []
+    # Feed in awkward 4-byte chunks crossing every boundary.
+    for i in range(0, len(framed), 4):
+        out.extend(dec.feed(framed[i : i + 4]))
+    assert out == msgs
+
+
+def test_json_to_generate_request_completion_and_chat():
+    framed, stream = codec.json_to_generate_request(
+        json.dumps({"model": "m1", "prompt": "hello", "max_tokens": 7,
+                    "stream": True}).encode()
+    )
+    assert stream
+    (payload,) = list(codec.iter_frames(framed))
+    req = generate_pb2.GenerateRequest.FromString(payload)
+    assert (req.model, req.prompt, req.max_tokens, req.stream) == (
+        "m1", "hello", 7, True)
+
+    framed, _ = codec.json_to_generate_request(
+        json.dumps({"model": "m2", "messages": [
+            {"role": "system", "content": "be terse"},
+            {"role": "user", "content": "hi"},
+        ]}).encode()
+    )
+    (payload,) = list(codec.iter_frames(framed))
+    req = generate_pb2.GenerateRequest.FromString(payload)
+    assert "system: be terse" in req.prompt and "user: hi" in req.prompt
+
+    assert codec.json_to_generate_request(b"not json") == (None, False)
+    assert codec.json_to_generate_request(b'{"no": "prompt"}') == (None, False)
+    # Untranscodable field values refuse cleanly instead of raising.
+    assert codec.json_to_generate_request(
+        json.dumps({"prompt": "x", "max_tokens": -1}).encode()
+    ) == (None, False)
+    assert codec.json_to_generate_request(
+        json.dumps({"prompt": "x", "temperature": [1]}).encode()
+    ) == (None, False)
+
+
+def test_responses_to_json_merges_chunks():
+    frames = b"".join(
+        codec.frame(generate_pb2.GenerateResponse(text=t).SerializeToString())
+        for t in ("Hel", "lo")
+    ) + codec.frame(
+        generate_pb2.GenerateResponse(
+            text="!", finished=True, finish_reason="stop",
+            completion_tokens=3).SerializeToString()
+    )
+    out = json.loads(codec.generate_responses_to_json(frames, model="m"))
+    assert out["choices"][0]["text"] == "Hello!"
+    assert out["choices"][0]["finish_reason"] == "stop"
+    assert out["usage"]["completion_tokens"] == 3
+
+
+def test_sse_conversion_emits_done():
+    payload = generate_pb2.GenerateResponse(
+        text="tok", finished=True, finish_reason="stop").SerializeToString()
+    sse = codec.generate_response_to_sse(payload).decode()
+    assert sse.startswith("data: {")
+    assert sse.endswith("data: [DONE]\n\n")
+
+
+def make_h2c_server():
+    ds = Datastore()
+    ds.pool_set(EndpointPool({"app": "x"}, [8000], "default",
+                             app_protocol="kubernetes.io/h2c"))
+    ds.pod_update_or_add(make_pod(name="p0", labels={"app": "x"}, ip="10.0.0.1"))
+    return StreamingServer(ds, RoundRobinPicker()), ds
+
+
+def test_extproc_transcodes_request_body_for_h2c_pool():
+    srv, _ = make_h2c_server()
+    body = json.dumps({"model": "m", "prompt": "hi", "stream": False}).encode()
+    stream = FakeStream([
+        headers_msg(end_of_stream=False), body_msg(body, end_of_stream=True),
+    ])
+    srv.process(stream)
+    hdr, body_resp = stream.sent
+    muts = {o.header.key: o.header.raw_value.decode()
+            for o in hdr.request_headers.response.header_mutation.set_headers}
+    assert muts["content-type"] == codec.GRPC_CONTENT_TYPE
+    assert muts["te"] == "trailers"
+    common = body_resp.request_body.response
+    assert common.status == common.CONTINUE_AND_REPLACE
+    (payload,) = list(codec.iter_frames(common.body_mutation.body))
+    assert generate_pb2.GenerateRequest.FromString(payload).prompt == "hi"
+
+
+def test_extproc_response_stream_to_sse():
+    srv, _ = make_h2c_server()
+    req_body = json.dumps({"model": "m", "prompt": "hi", "stream": True}).encode()
+    chunk1 = codec.frame(
+        generate_pb2.GenerateResponse(text="Hel").SerializeToString())
+    chunk2 = codec.frame(generate_pb2.GenerateResponse(
+        text="lo", finished=True, finish_reason="stop").SerializeToString())
+    stream = FakeStream([
+        headers_msg(end_of_stream=False),
+        body_msg(req_body, end_of_stream=True),
+        pb.ProcessingRequest(response_body=pb.HttpBody(body=chunk1)),
+        pb.ProcessingRequest(
+            response_body=pb.HttpBody(body=chunk2, end_of_stream=True)),
+    ])
+    srv.process(stream)
+    sse1 = stream.sent[2].response_body.response.body_mutation.body.decode()
+    sse2 = stream.sent[3].response_body.response.body_mutation.body.decode()
+    assert '"text": "Hel"' in sse1
+    assert sse2.endswith("data: [DONE]\n\n")
+
+
+def test_extproc_response_buffered_to_json():
+    srv, _ = make_h2c_server()
+    req_body = json.dumps({"model": "m", "prompt": "hi", "stream": False}).encode()
+    frames = codec.frame(
+        generate_pb2.GenerateResponse(text="Hi ").SerializeToString()
+    ) + codec.frame(generate_pb2.GenerateResponse(
+        text="there", finished=True, finish_reason="stop").SerializeToString())
+    stream = FakeStream([
+        headers_msg(end_of_stream=False),
+        body_msg(req_body, end_of_stream=True),
+        pb.ProcessingRequest(
+            response_body=pb.HttpBody(body=frames, end_of_stream=True)),
+    ])
+    srv.process(stream)
+    out = json.loads(
+        stream.sent[2].response_body.response.body_mutation.body)
+    assert out["choices"][0]["text"] == "Hi there"
+
+
+def test_grpc_in_client_passes_through_unframed():
+    """gRPC-in clients (content-type application/grpc) are not transcoded."""
+    srv, _ = make_h2c_server()
+    grpc_body = codec.frame(
+        generate_pb2.GenerateRequest(model="m", prompt="x").SerializeToString())
+    stream = FakeStream([
+        headers_msg(headers={"content-type": "application/grpc"},
+                    end_of_stream=False),
+        body_msg(grpc_body, end_of_stream=True),
+    ])
+    srv.process(stream)
+    body_resp = stream.sent[1].request_body.response
+    # No CONTINUE_AND_REPLACE mutation: the body passes through as-is.
+    assert body_resp.status == pb.CommonResponse.CONTINUE
+    assert dest_header(stream.sent[0]) is not None
+
+
+def test_http_pool_not_transcoded():
+    ds = Datastore()
+    ds.pool_set(EndpointPool({"app": "x"}, [8000], "default"))  # http default
+    ds.pod_update_or_add(make_pod(name="p0", labels={"app": "x"}, ip="10.0.0.1"))
+    srv = StreamingServer(ds, RoundRobinPicker())
+    body = json.dumps({"model": "m", "prompt": "hi"}).encode()
+    stream = FakeStream([
+        headers_msg(end_of_stream=False), body_msg(body, end_of_stream=True),
+    ])
+    srv.process(stream)
+    assert stream.sent[1].request_body.response.status == pb.CommonResponse.CONTINUE
+
+
+def test_compressed_frame_falls_back_to_passthrough():
+    """A compressed response frame stops transcoding instead of killing the
+    stream."""
+    srv, _ = make_h2c_server()
+    req_body = json.dumps({"model": "m", "prompt": "hi", "stream": True}).encode()
+    compressed = b"\x01" + (5).to_bytes(4, "big") + b"zzzzz"
+    stream = FakeStream([
+        headers_msg(end_of_stream=False),
+        body_msg(req_body, end_of_stream=True),
+        pb.ProcessingRequest(
+            response_body=pb.HttpBody(body=compressed, end_of_stream=True)),
+    ])
+    srv.process(stream)
+    resp = stream.sent[2].response_body.response
+    assert resp.status == pb.CommonResponse.CONTINUE  # passthrough
+
+
+def test_transcoded_response_content_type_rewritten():
+    srv, _ = make_h2c_server()
+    req_body = json.dumps({"model": "m", "prompt": "hi", "stream": True}).encode()
+    stream = FakeStream([
+        headers_msg(end_of_stream=False),
+        body_msg(req_body, end_of_stream=True),
+        pb.ProcessingRequest(response_headers=pb.HttpHeaders()),
+    ])
+    srv.process(stream)
+    mut = {o.header.key: o.header.raw_value.decode()
+           for o in stream.sent[2].response_headers.response
+           .header_mutation.set_headers}
+    assert mut["content-type"] == "text/event-stream"
