@@ -65,6 +65,13 @@ class FrameDecoder:
         self._buf = bytearray()
         self.bytes_seen = 0
 
+    def buffered_bytes(self) -> int:
+        return len(self._buf)
+
+    def has_partial(self) -> bool:
+        """True when an incomplete frame remains (truncated stream)."""
+        return len(self._buf) > 0
+
     def feed(self, chunk: bytes) -> list[bytes]:
         self._buf.extend(chunk)
         self.bytes_seen += len(chunk)
@@ -88,10 +95,13 @@ class FrameDecoder:
 # ---------------------------------------------------------------------------
 
 
-def json_to_generate_request(body: bytes) -> tuple[Optional[bytes], bool]:
-    """OpenAI completion JSON -> (gRPC-framed GenerateRequest, stream flag).
+def json_to_generate_request(
+    body: bytes,
+) -> tuple[Optional[bytes], bool, str]:
+    """OpenAI completion JSON -> (gRPC-framed GenerateRequest, stream flag,
+    model name).
 
-    Returns (None, False) when the body is not a transcodable completion
+    Returns (None, False, "") when the body is not a transcodable completion
     request — malformed JSON, missing prompt, or field values the proto
     cannot carry (e.g. negative max_tokens) — so callers pass the body
     through untouched instead of killing the stream.
@@ -99,31 +109,43 @@ def json_to_generate_request(body: bytes) -> tuple[Optional[bytes], bool]:
     try:
         obj = json.loads(body)
     except (ValueError, UnicodeDecodeError):
-        return None, False
+        return None, False, ""
     if not isinstance(obj, dict):
-        return None, False
+        return None, False, ""
     prompt = obj.get("prompt")
     if prompt is None and isinstance(obj.get("messages"), list):
-        # Chat form: fold messages into a prompt transcript.
-        prompt = "\n".join(
-            f"{m.get('role', 'user')}: {m.get('content', '')}"
-            for m in obj["messages"]
-            if isinstance(m, dict)
-        )
+        # Chat form: fold messages into a prompt transcript. Content may be
+        # a plain string or OpenAI content-parts ([{type: text, text: ...}]).
+        lines = []
+        for m in obj["messages"]:
+            if not isinstance(m, dict):
+                continue
+            content = m.get("content")
+            if isinstance(content, list):
+                content = "".join(
+                    part.get("text", "")
+                    for part in content
+                    if isinstance(part, dict) and part.get("type") == "text"
+                )
+            elif not isinstance(content, str):
+                content = ""
+            lines.append(f"{m.get('role', 'user')}: {content}")
+        prompt = "\n".join(lines)
     if not isinstance(prompt, str):
-        return None, False
+        return None, False, ""
     stream = bool(obj.get("stream", False))
+    model = str(obj.get("model", ""))
     try:
         req = generate_pb2.GenerateRequest(
-            model=str(obj.get("model", "")),
+            model=model,
             prompt=prompt,
             max_tokens=int(obj.get("max_tokens", 16) or 16),
             temperature=float(obj.get("temperature", 1.0) or 1.0),
             stream=stream,
         )
     except (ValueError, TypeError):
-        return None, False
-    return frame(req.SerializeToString()), stream
+        return None, False, ""
+    return frame(req.SerializeToString()), stream, model
 
 
 def _completion_json(resp, model: str = "") -> dict:
@@ -176,6 +198,21 @@ def generate_response_to_sse(payload: bytes, model: str = "") -> bytes:
     if resp.finished:
         event += b"data: [DONE]\n\n"
     return event
+
+
+def error_json(message: str) -> bytes:
+    """OpenAI-style error body for transcode failures."""
+    return json.dumps(
+        {"error": {"message": message, "type": "upstream_error"}}
+    ).encode()
+
+
+def error_sse(message: str) -> bytes:
+    """SSE error event followed by the [DONE] terminator, so streaming
+    clients close cleanly instead of receiving raw gRPC bytes."""
+    return (
+        b"data: " + error_json(message) + b"\n\ndata: [DONE]\n\n"
+    )
 
 
 def is_grpc_request(headers: dict[str, list[str]]) -> bool:

@@ -103,7 +103,9 @@ class RequestContext:
     selected_pod_ip: str = ""
     # http-in -> gRPC-out transcoding state (proposal 2162).
     transcoding: bool = False
+    transcode_failed: bool = False
     stream_requested: bool = False
+    model: str = ""
     frame_decoder: object = None
     response_frames: list = dataclasses.field(default_factory=list)
 
@@ -345,10 +347,11 @@ class StreamingServer:
             and not codec.is_grpc_request(ctx.headers)
         ):
             source = result.mutated_body if result.mutated_body is not None else body
-            framed, stream_requested = codec.json_to_generate_request(source)
+            framed, stream_requested, model_name = codec.json_to_generate_request(source)
             if framed is not None:
                 ctx.stream_requested = stream_requested
                 ctx.transcoding = True
+                ctx.model = model_name
                 result.mutated_body = framed
                 result.extra_headers = {
                     **result.extra_headers,
@@ -387,52 +390,73 @@ class StreamingServer:
             ),
         )
 
+    @staticmethod
+    def _replace_body(body: bytes) -> pb.ProcessingResponse:
+        return pb.ProcessingResponse(
+            response_body=pb.BodyResponse(
+                response=pb.CommonResponse(
+                    status=pb.CommonResponse.CONTINUE_AND_REPLACE,
+                    body_mutation=pb.BodyMutation(body=body),
+                )
+            )
+        )
+
+    def _transcode_failure(self, ctx: RequestContext, message: str) -> pb.ProcessingResponse:
+        """Mid-stream transcode failure: the client already saw rewritten
+        response headers (JSON/SSE content-type), so emit a clean error in
+        the promised format and blank every further chunk — never mix raw
+        gRPC bytes into a half-transcoded response."""
+        ctx.transcode_failed = True
+        if ctx.stream_requested:
+            return self._replace_body(codec.error_sse(message))
+        return self._replace_body(codec.error_json(message))
+
     def _transcode_response_body(
         self, ctx: RequestContext, body_msg: pb.HttpBody
     ) -> pb.ProcessingResponse:
         """gRPC-out response stream -> SSE (streaming) or JSON (buffered)
         for the HTTP/JSON client (proposal 2162 response path)."""
-        passthrough = pb.ProcessingResponse(
-            response_body=pb.BodyResponse(response=pb.CommonResponse())
-        )
+        if ctx.transcode_failed:
+            return self._replace_body(b"")
         if ctx.frame_decoder is None:
             ctx.frame_decoder = codec.FrameDecoder()
-        # Same cap as the request path: a runaway backend must not grow EPP
-        # memory unboundedly per in-flight response.
-        if ctx.frame_decoder.bytes_seen + len(body_msg.body) > MAX_REQUEST_BODY_SIZE:
-            raise ExtProcError(
-                grpc.StatusCode.RESOURCE_EXHAUSTED,
-                f"response body size limit of {MAX_REQUEST_BODY_SIZE} "
-                "bytes exceeded during transcoding",
+        # Memory bound: what we HOLD (decoder buffer + buffered frames), not
+        # cumulative stream volume — long SSE streams drain continuously and
+        # must not be killed for total size.
+        held = ctx.frame_decoder.buffered_bytes() + sum(
+            len(p) for p in ctx.response_frames
+        )
+        if held + len(body_msg.body) > MAX_REQUEST_BODY_SIZE:
+            return self._transcode_failure(
+                ctx, "upstream response exceeds the transcoding buffer limit"
             )
         try:
             messages = ctx.frame_decoder.feed(body_msg.body)
-        except codec.FrameFormatError:
-            # Undecodable framing (compressed/corrupt): stop transcoding and
-            # pass the backend bytes through rather than kill the stream.
-            ctx.transcoding = False
-            return passthrough
-        if ctx.stream_requested:
-            out = b"".join(
-                codec.generate_response_to_sse(m) for m in messages
-            )
-            mutation = pb.BodyMutation(body=out)
-        else:
+            if ctx.stream_requested:
+                out = b"".join(
+                    codec.generate_response_to_sse(m, ctx.model) for m in messages
+                )
+                if body_msg.end_of_stream and ctx.frame_decoder.has_partial():
+                    return self._transcode_failure(
+                        ctx, "upstream response truncated mid-frame"
+                    )
+                return self._replace_body(out)
             ctx.response_frames.extend(messages)
-            if body_msg.end_of_stream:
-                mutation = pb.BodyMutation(
-                    body=codec.generate_payloads_to_json(ctx.response_frames)
+            if not body_msg.end_of_stream:
+                return self._replace_body(b"")
+            if ctx.frame_decoder.has_partial():
+                return self._transcode_failure(
+                    ctx, "upstream response truncated mid-frame"
                 )
-            else:
-                mutation = pb.BodyMutation(body=b"")
-        return pb.ProcessingResponse(
-            response_body=pb.BodyResponse(
-                response=pb.CommonResponse(
-                    status=pb.CommonResponse.CONTINUE_AND_REPLACE,
-                    body_mutation=mutation,
-                )
+            return self._replace_body(
+                codec.generate_payloads_to_json(ctx.response_frames, ctx.model)
             )
-        )
+        except Exception as e:
+            # Framing errors AND protobuf decode errors land here: the
+            # payload is not the Generate protocol we can decode.
+            return self._transcode_failure(
+                ctx, f"upstream response not decodable: {type(e).__name__}"
+            )
 
     def _handle_response_headers(
         self, ctx: RequestContext, req: pb.ProcessingRequest

@@ -48,10 +48,11 @@ class WorkloadConfig:
 
 def tuned_scheduler() -> Scheduler:
     """Scheduler profile tuned on the cache-constrained prefix benchmark
-    (simulation sweep, round 1): strong queue + assumed-load terms keep
-    prefix affinity from herding sessions onto hot pods, and the Sinkhorn
-    OT picker bin-packs each wave under endpoint capacities
-    (tau/rounding sweep: 1777 vs topk 1590 tok/s goodput)."""
+    (simulation sweeps, round 1): the Sinkhorn OT picker's capacity
+    constraint prevents prefix-affinity herding, which lets the prefix
+    weight run much higher than the argmax picker tolerates (prefix=4 vs 1)
+    — goodput 2328 vs topk-tuned 1590 tok/s, hit rate 0.72 vs 0.37,
+    robust across workload seeds (ratios 1.8-2.2x vs least-kv)."""
     import jax.numpy as _jnp
 
     return Scheduler(
@@ -60,9 +61,9 @@ def tuned_scheduler() -> Scheduler:
         weights=Weights(
             queue=_jnp.float32(2.0),
             kv_cache=_jnp.float32(1.0),
-            prefix=_jnp.float32(1.0),
+            prefix=_jnp.float32(4.0),
             lora=_jnp.float32(1.0),
-            assumed_load=_jnp.float32(3.0),
+            assumed_load=_jnp.float32(1.5),
             latency=_jnp.float32(0.0),
         ),
     )

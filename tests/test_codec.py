@@ -33,17 +33,17 @@ def test_incremental_decoder_split_boundaries():
 
 
 def test_json_to_generate_request_completion_and_chat():
-    framed, stream = codec.json_to_generate_request(
+    framed, stream, model_name = codec.json_to_generate_request(
         json.dumps({"model": "m1", "prompt": "hello", "max_tokens": 7,
                     "stream": True}).encode()
     )
-    assert stream
+    assert stream and model_name == "m1"
     (payload,) = list(codec.iter_frames(framed))
     req = generate_pb2.GenerateRequest.FromString(payload)
     assert (req.model, req.prompt, req.max_tokens, req.stream) == (
         "m1", "hello", 7, True)
 
-    framed, _ = codec.json_to_generate_request(
+    framed, _, _ = codec.json_to_generate_request(
         json.dumps({"model": "m2", "messages": [
             {"role": "system", "content": "be terse"},
             {"role": "user", "content": "hi"},
@@ -53,15 +53,15 @@ def test_json_to_generate_request_completion_and_chat():
     req = generate_pb2.GenerateRequest.FromString(payload)
     assert "system: be terse" in req.prompt and "user: hi" in req.prompt
 
-    assert codec.json_to_generate_request(b"not json") == (None, False)
-    assert codec.json_to_generate_request(b'{"no": "prompt"}') == (None, False)
+    assert codec.json_to_generate_request(b"not json") == (None, False, "")
+    assert codec.json_to_generate_request(b'{"no": "prompt"}') == (None, False, "")
     # Untranscodable field values refuse cleanly instead of raising.
     assert codec.json_to_generate_request(
         json.dumps({"prompt": "x", "max_tokens": -1}).encode()
-    ) == (None, False)
+    ) == (None, False, "")
     assert codec.json_to_generate_request(
         json.dumps({"prompt": "x", "temperature": [1]}).encode()
-    ) == (None, False)
+    ) == (None, False, "")
 
 
 def test_responses_to_json_merges_chunks():
@@ -183,21 +183,29 @@ def test_http_pool_not_transcoded():
     assert stream.sent[1].request_body.response.status == pb.CommonResponse.CONTINUE
 
 
-def test_compressed_frame_falls_back_to_passthrough():
-    """A compressed response frame stops transcoding instead of killing the
-    stream."""
+def test_compressed_frame_emits_clean_error():
+    """An undecodable response frame yields a clean error in the promised
+    format (the client already saw SSE/JSON response headers) and never
+    mixes raw gRPC bytes into the stream."""
     srv, _ = make_h2c_server()
     req_body = json.dumps({"model": "m", "prompt": "hi", "stream": True}).encode()
     compressed = b"\x01" + (5).to_bytes(4, "big") + b"zzzzz"
     stream = FakeStream([
         headers_msg(end_of_stream=False),
         body_msg(req_body, end_of_stream=True),
+        pb.ProcessingRequest(response_body=pb.HttpBody(body=compressed)),
         pb.ProcessingRequest(
-            response_body=pb.HttpBody(body=compressed, end_of_stream=True)),
+            response_body=pb.HttpBody(body=b"more raw", end_of_stream=True)),
     ])
     srv.process(stream)
-    resp = stream.sent[2].response_body.response
-    assert resp.status == pb.CommonResponse.CONTINUE  # passthrough
+    err = stream.sent[2].response_body.response
+    assert err.status == pb.CommonResponse.CONTINUE_AND_REPLACE
+    out = err.body_mutation.body.decode()
+    assert '"error"' in out and out.endswith("data: [DONE]\n\n")
+    # Subsequent chunks are blanked, never passed through raw.
+    tail = stream.sent[3].response_body.response
+    assert tail.status == pb.CommonResponse.CONTINUE_AND_REPLACE
+    assert tail.body_mutation.body == b""
 
 
 def test_transcoded_response_content_type_rewritten():
@@ -213,3 +221,54 @@ def test_transcoded_response_content_type_rewritten():
            for o in stream.sent[2].response_headers.response
            .header_mutation.set_headers}
     assert mut["content-type"] == "text/event-stream"
+
+
+def test_truncated_final_frame_reports_error():
+    """A partial trailing frame at end_of_stream must not produce a silent
+    200 with missing text."""
+    srv, _ = make_h2c_server()
+    req_body = json.dumps({"model": "m", "prompt": "hi", "stream": False}).encode()
+    good = codec.frame(
+        generate_pb2.GenerateResponse(text="partial").SerializeToString())
+    truncated = good + b"\x00" + (99).to_bytes(4, "big") + b"short"
+    stream = FakeStream([
+        headers_msg(end_of_stream=False),
+        body_msg(req_body, end_of_stream=True),
+        pb.ProcessingRequest(
+            response_body=pb.HttpBody(body=truncated, end_of_stream=True)),
+    ])
+    srv.process(stream)
+    out = json.loads(stream.sent[2].response_body.response.body_mutation.body)
+    assert "error" in out
+    assert "truncated" in out["error"]["message"]
+
+
+def test_model_echoed_in_transcoded_response():
+    srv, _ = make_h2c_server()
+    req_body = json.dumps({"model": "llama-3", "prompt": "hi",
+                           "stream": False}).encode()
+    frames = codec.frame(generate_pb2.GenerateResponse(
+        text="ok", finished=True, finish_reason="stop").SerializeToString())
+    stream = FakeStream([
+        headers_msg(end_of_stream=False),
+        body_msg(req_body, end_of_stream=True),
+        pb.ProcessingRequest(
+            response_body=pb.HttpBody(body=frames, end_of_stream=True)),
+    ])
+    srv.process(stream)
+    out = json.loads(stream.sent[2].response_body.response.body_mutation.body)
+    assert out["model"] == "llama-3"
+
+
+def test_chat_content_parts_fold_to_text():
+    framed, _, _ = codec.json_to_generate_request(json.dumps({
+        "model": "m",
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "part one "},
+            {"type": "image_url", "image_url": {"url": "http://x"}},
+            {"type": "text", "text": "part two"},
+        ]}],
+    }).encode())
+    (payload,) = list(codec.iter_frames(framed))
+    req = generate_pb2.GenerateRequest.FromString(payload)
+    assert req.prompt == "user: part one part two"

@@ -1,6 +1,6 @@
 """Goodput regression: the batched TPU policy must beat the reference's
 default least-kv scorer on the cache-constrained prefix benchmark
-(BASELINE north star: >= 1.3x; asserted at 1.2x for short-run noise)."""
+(BASELINE north star: >= 1.3x; currently 2.15x, asserted at 1.5x)."""
 
 from gie_tpu.simulator import StubConfig
 from gie_tpu.simulator.cluster import SimCluster, WorkloadConfig, tuned_scheduler
@@ -30,5 +30,5 @@ def test_tpu_beats_least_kv_goodput():
     base = run("least-kv")
     tpu = run("tpu")
     assert tpu.prefix_hit_rate > base.prefix_hit_rate + 0.1
-    assert tpu.goodput_tokens_per_s > base.goodput_tokens_per_s * 1.25
+    assert tpu.goodput_tokens_per_s > base.goodput_tokens_per_s * 1.5
     assert tpu.ttft_p50_s < base.ttft_p50_s
