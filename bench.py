@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
 import time
 
@@ -29,8 +30,43 @@ import jax
 import numpy as np
 
 
+def _device_watchdog(timeout_s: float = 180.0):
+    """Fail fast when the TPU backend is unreachable.
+
+    The axon tunnel dials a local relay; if the relay is down,
+    jax.devices() blocks forever — far worse for the driver than a clean
+    nonzero exit. Probe device init in a daemon thread and bail with
+    diagnostics if it does not come up in time.
+    """
+    import threading
+
+    result: list = []
+
+    def probe() -> None:
+        try:
+            result.append(jax.devices())
+        except Exception as e:  # surfaced below
+            result.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not result:
+        print(
+            f"FATAL: JAX backend failed to initialize within {timeout_s:.0f}s "
+            "(axon relay unreachable?) — aborting instead of hanging",
+            file=sys.stderr,
+        )
+        os._exit(3)
+    if isinstance(result[0], Exception):
+        print(f"FATAL: JAX backend init failed: {result[0]}", file=sys.stderr)
+        os._exit(3)
+
+
 def main() -> None:
     import jax.numpy as jnp
+
+    _device_watchdog()
 
     from gie_tpu.sched import constants as C
     from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle

@@ -63,7 +63,6 @@ class FrameDecoder:
 
     def __init__(self) -> None:
         self._buf = bytearray()
-        self.bytes_seen = 0
 
     def buffered_bytes(self) -> int:
         return len(self._buf)
@@ -74,7 +73,6 @@ class FrameDecoder:
 
     def feed(self, chunk: bytes) -> list[bytes]:
         self._buf.extend(chunk)
-        self.bytes_seen += len(chunk)
         out = []
         while len(self._buf) >= 5:
             flag = self._buf[0]
@@ -123,9 +121,11 @@ def json_to_generate_request(
             content = m.get("content")
             if isinstance(content, list):
                 content = "".join(
-                    part.get("text", "")
+                    part["text"]
                     for part in content
-                    if isinstance(part, dict) and part.get("type") == "text"
+                    if isinstance(part, dict)
+                    and part.get("type") == "text"
+                    and isinstance(part.get("text"), str)
                 )
             elif not isinstance(content, str):
                 content = ""

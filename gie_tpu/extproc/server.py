@@ -26,6 +26,8 @@ from typing import Optional, Protocol
 
 import grpc
 
+from google.protobuf.message import DecodeError as _DecodeError
+
 from gie_tpu.extproc import codec, envoy, metadata, pb
 from gie_tpu.runtime import tracing
 
@@ -108,6 +110,7 @@ class RequestContext:
     model: str = ""
     frame_decoder: object = None
     response_frames: list = dataclasses.field(default_factory=list)
+    held_bytes: int = 0  # running size of buffered response_frames
 
 
 class Stream(Protocol):
@@ -423,9 +426,7 @@ class StreamingServer:
         # Memory bound: what we HOLD (decoder buffer + buffered frames), not
         # cumulative stream volume — long SSE streams drain continuously and
         # must not be killed for total size.
-        held = ctx.frame_decoder.buffered_bytes() + sum(
-            len(p) for p in ctx.response_frames
-        )
+        held = ctx.frame_decoder.buffered_bytes() + ctx.held_bytes
         if held + len(body_msg.body) > MAX_REQUEST_BODY_SIZE:
             return self._transcode_failure(
                 ctx, "upstream response exceeds the transcoding buffer limit"
@@ -442,6 +443,7 @@ class StreamingServer:
                     )
                 return self._replace_body(out)
             ctx.response_frames.extend(messages)
+            ctx.held_bytes += sum(len(m) for m in messages)
             if not body_msg.end_of_stream:
                 return self._replace_body(b"")
             if ctx.frame_decoder.has_partial():
@@ -451,9 +453,9 @@ class StreamingServer:
             return self._replace_body(
                 codec.generate_payloads_to_json(ctx.response_frames, ctx.model)
             )
-        except Exception as e:
-            # Framing errors AND protobuf decode errors land here: the
-            # payload is not the Generate protocol we can decode.
+        except (codec.FrameFormatError, _DecodeError) as e:
+            # The payload is not the Generate protocol we can decode; EPP
+            # programming errors are NOT masked here — they propagate.
             return self._transcode_failure(
                 ctx, f"upstream response not decodable: {type(e).__name__}"
             )

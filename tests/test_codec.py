@@ -272,3 +272,16 @@ def test_chat_content_parts_fold_to_text():
     (payload,) = list(codec.iter_frames(framed))
     req = generate_pb2.GenerateRequest.FromString(payload)
     assert req.prompt == "user: part one part two"
+
+
+def test_non_string_text_part_ignored():
+    """Client-controlled garbage in content parts must not crash the
+    request path."""
+    framed, _, _ = codec.json_to_generate_request(json.dumps({
+        "messages": [{"role": "u", "content": [
+            {"type": "text", "text": 123},
+            {"type": "text", "text": "ok"},
+        ]}],
+    }).encode())
+    (payload,) = list(codec.iter_frames(framed))
+    assert generate_pb2.GenerateRequest.FromString(payload).prompt == "u: ok"
