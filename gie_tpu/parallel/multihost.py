@@ -1,0 +1,84 @@
+"""Multi-host distributed operation (the DCN scaling path).
+
+The reference ecosystem scales its control plane by replication and its
+model servers by NCCL/MPI (SURVEY.md 2.10); the TPU-native equivalents here
+ride JAX's distributed runtime: `jax.distributed.initialize` forms the
+multi-process system (coordination over DCN), every process contributes its
+local chips to one GLOBAL mesh, and the same jitted programs (predictor
+train step, scheduling cycle) run SPMD with XLA inserting cross-host
+collectives.
+
+Tested for real in tests/test_multihost.py: two OS processes form a
+2-device global mesh on CPU and execute one dp-sharded predictor train step
+whose gradients all-reduce across the process boundary (the CI stand-in for
+ICI/DCN).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
+
+
+def initialize(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+) -> None:
+    """Join the multi-process JAX system (call once, before device use).
+
+    `coordinator_address` is "host:port" of process 0 — the jax.distributed
+    analogue of the reference model servers' MPI rendezvous.
+    """
+    jax.distributed.initialize(
+        coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(tp: int = 1) -> Mesh:
+    """("dp","tp") mesh over ALL processes' devices (layout owned by
+    mesh.make_mesh; jax.devices() is already global across processes)."""
+    from gie_tpu.parallel.mesh import make_mesh
+
+    n = len(jax.devices())
+    if tp <= 0 or n % tp != 0:
+        raise ValueError(f"tp={tp} does not divide device count {n}")
+    return make_mesh(n, tp=tp)
+
+
+def host_local_batch_to_global(
+    mesh: Mesh, local_batch: np.ndarray, spec: Optional[P] = None
+) -> jax.Array:
+    """Assemble a globally-sharded array from each process's local shard
+    (each host loads its own slice — no host ever materializes the global
+    batch, the multi-host data-loading contract)."""
+    spec = spec if spec is not None else P("dp", *([None] * (local_batch.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_process_local_data(sharding, local_batch)
+
+
+def multihost_train_step(mesh: Mesh, seed: int = 0):
+    """Build (step_fn, params, opt_state) for the predictor on the global
+    mesh: dp-sharded batch, replicated params; XLA all-reduces gradients
+    across hosts. Optimizer hyperparameters come from the predictor config
+    (same as OnlineTrainer); the sharded step is owned by
+    mesh.sharded_train_step so single- and multi-host paths cannot diverge.
+    """
+    import optax
+
+    from gie_tpu.models.latency import LatencyPredictor
+    from gie_tpu.parallel.mesh import sharded_train_step
+
+    predictor = LatencyPredictor()
+    params = predictor.init(jax.random.PRNGKey(seed))
+    tx = optax.adamw(
+        predictor.cfg.learning_rate, weight_decay=predictor.cfg.weight_decay
+    )
+    opt_state = tx.init(params)
+    step = sharded_train_step(mesh, predictor, tx)
+    return step, params, opt_state

@@ -1,0 +1,48 @@
+"""Worker process for the multi-host test (not collected by pytest).
+
+Usage: python tests/multihost_worker.py <process_id> <num_processes> <port>
+Joins the distributed system, runs one dp-sharded predictor train step on
+the global mesh with a process-local batch shard, prints the loss.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from gie_tpu.models.latency import NUM_FEATURES  # noqa: E402
+from gie_tpu.parallel import multihost  # noqa: E402
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    multihost.initialize(f"127.0.0.1:{port}", nproc, pid)
+    mesh = multihost.global_mesh(tp=1)
+    step, params, opt_state = multihost.multihost_train_step(mesh)
+
+    # Each process supplies only ITS shard of the global batch.
+    per_host = 8
+    rng = np.random.default_rng(pid)
+    feats = rng.uniform(0, 1, (per_host, NUM_FEATURES)).astype(np.float32)
+    targets = rng.uniform(0, 1, (per_host, 2)).astype(np.float32)
+    weights = np.ones((per_host, 2), np.float32)
+
+    g_feats = multihost.host_local_batch_to_global(mesh, feats)
+    g_targets = multihost.host_local_batch_to_global(mesh, targets)
+    g_weights = multihost.host_local_batch_to_global(mesh, weights)
+
+    params, opt_state, loss = step(params, opt_state, g_feats, g_targets,
+                                   g_weights)
+    jax.block_until_ready(loss)
+    print(f"MULTIHOST_OK pid={pid} devices={len(jax.devices())} "
+          f"loss={float(loss):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
