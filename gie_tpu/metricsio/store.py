@@ -53,6 +53,12 @@ class MetricsStore:
             self._scraped_at[slot] = time.time() if now is None else now
             self._has_data[slot] = True
 
+    def host_queue_depths(self) -> np.ndarray:
+        """Host-side copy of the queue-depth column (flow-control hold
+        checks run before any device work)."""
+        with self._lock:
+            return self._metrics[:, C.Metric.QUEUE_DEPTH].copy()
+
     def remove(self, slot: int) -> None:
         """Forget a reclaimed slot (wired to Datastore.on_slot_reclaimed)."""
         with self._lock:

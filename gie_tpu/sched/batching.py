@@ -109,6 +109,9 @@ class BatchingTPUPicker:
         max_batch: int = C.N_BUCKETS[-1],
         lora_registry: Optional[LoraRegistry] = None,
         trainer=None,
+        hold_max_s: float = 0.0,
+        hold_queue_limit: float = 128.0,
+        hold_retry_s: float = 0.01,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
@@ -124,6 +127,14 @@ class BatchingTPUPicker:
         # Optional api.objectives.ObjectiveRegistry resolving named
         # InferenceObjectives to criticality bands (proposal 1199).
         self.objective_registry = None
+        # Flow-control wait queueing (the reference flow-control layer's
+        # queue-until-capacity semantics): when > 0, non-critical requests
+        # whose pick landed on a saturated endpoint are HELD and re-scheduled
+        # until capacity frees or the hold deadline passes (then best-effort).
+        # Ext-proc permits this: the headers response is simply not sent yet.
+        self.hold_max_s = hold_max_s
+        self.hold_queue_limit = hold_queue_limit
+        self.hold_retry_s = hold_retry_s
         self._pending: list[_Pending] = []
         self._cond = threading.Condition()
         self._closed = False
@@ -202,15 +213,54 @@ class BatchingTPUPicker:
                 batch = self._pending[: self.max_batch]
                 self._pending = self._pending[self.max_batch :]
             try:
-                self._run_batch(batch)
+                held = self._run_batch(batch)
             except Exception as e:  # propagate to all waiters
                 for item in batch:
                     item.error = ExtProcError(
                         grpc.StatusCode.INTERNAL, f"scheduler failure: {e}"
                     )
                     item.event.set()
+                continue
+            if held:
+                with self._cond:
+                    # Held items rejoin at the HEAD (they arrived first);
+                    # pace retries only when nothing NEW is waiting, so a
+                    # fully-saturated pool doesn't busy-spin the collector
+                    # and fresh arrivals are never delayed by the pacing.
+                    new_arrivals = len(self._pending) > 0
+                    self._pending = held + self._pending
+                    if not new_arrivals:
+                        self._cond.wait(self.hold_retry_s)
 
-    def _run_batch(self, batch: list[_Pending]) -> None:
+    def _run_batch(self, batch: list[_Pending]) -> list["_Pending"]:
+        # Flow-control hold decision happens BEFORE any scheduling, so a
+        # held request never touches device state (assumed load, prefix
+        # inserts, tick) — it simply waits for capacity or its deadline.
+        # Criterion: non-critical, within deadline, and EVERY candidate is
+        # saturated (if any candidate has capacity, schedule now — the
+        # cycle will steer there anyway).
+        held: list[_Pending] = []
+        if self.hold_max_s > 0:
+            queues = self.metrics_store.host_queue_depths()
+            now = time.monotonic()
+            runnable: list[_Pending] = []
+            for it in batch:
+                band = _band_for(it.req.headers, self.objective_registry)
+                if (
+                    band != C.Criticality.CRITICAL
+                    and now - it.enqueued_at < self.hold_max_s
+                    and all(
+                        queues[ep.slot] >= self.hold_queue_limit
+                        for ep in it.candidates
+                        if 0 <= ep.slot < C.M_MAX
+                    )
+                ):
+                    held.append(it)
+                else:
+                    runnable.append(it)
+            batch = runnable
+            if not batch:
+                return held
         n = len(batch)
         prompts = [it.req.body or b"" for it in batch]
         hashes, counts = batch_chunk_hashes(prompts)
@@ -288,3 +338,4 @@ class BatchingTPUPicker:
                         )
                     item.result = res
             item.event.set()
+        return held

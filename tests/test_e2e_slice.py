@@ -173,3 +173,106 @@ def test_sheddable_429_headers_only_request(stack):
     kinds = [r.WhichOneof("response") for r in stream.sent]
     assert kinds == ["immediate_response"]
     assert stream.sent[0].immediate_response.status_code == 429
+
+
+def test_flow_control_hold_until_capacity():
+    """Flow-control wait queueing: a request picked onto a saturated
+    endpoint is held and completes once capacity frees (reference
+    flow-control queue-until-capacity semantics)."""
+    import time
+
+    sched2 = Scheduler(ProfileConfig())
+    ms2 = MetricsStore()
+    ds2 = Datastore()
+    ds2.pool_set(
+        EndpointPool(selector={"app": "vllm"}, target_ports=[8000],
+                     namespace="default")
+    )
+    ds2.pod_update_or_add(make_pod(name="h0", ip="10.0.1.1"))
+    slot = ds2.endpoints()[0].slot
+    ms2.update(slot, {Metric.QUEUE_DEPTH: 500, Metric.KV_CACHE_UTIL: 0.5})
+    picker2 = BatchingTPUPicker(
+        sched2, ds2, ms2, max_wait_s=0.002,
+        hold_max_s=5.0, hold_queue_limit=100, hold_retry_s=0.01,
+    )
+    try:
+        from gie_tpu.extproc.server import PickRequest
+
+        result_box = {}
+
+        def do_pick():
+            result_box["res"] = picker2.pick(
+                PickRequest(headers={}, body=b"held request"), ds2.endpoints()
+            )
+
+        t = threading.Thread(target=do_pick)
+        start = time.monotonic()
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive()  # held: no capacity yet
+        ms2.update(slot, {Metric.QUEUE_DEPTH: 1, Metric.KV_CACHE_UTIL: 0.2})
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert result_box["res"].endpoint == "10.0.1.1:8000"
+        assert time.monotonic() - start < 4.0  # released by capacity, not deadline
+    finally:
+        picker2.close()
+
+
+def test_flow_control_deadline_best_effort():
+    """Hold deadline expiry resolves best-effort instead of waiting forever."""
+    import time
+
+    sched2 = Scheduler(ProfileConfig())
+    ms2 = MetricsStore()
+    ds2 = Datastore()
+    ds2.pool_set(
+        EndpointPool(selector={"app": "vllm"}, target_ports=[8000],
+                     namespace="default")
+    )
+    ds2.pod_update_or_add(make_pod(name="h1", ip="10.0.1.2"))
+    ms2.update(ds2.endpoints()[0].slot, {Metric.QUEUE_DEPTH: 500})
+    picker2 = BatchingTPUPicker(
+        sched2, ds2, ms2, max_wait_s=0.002,
+        hold_max_s=0.5, hold_queue_limit=100, hold_retry_s=0.01,
+    )
+    try:
+        from gie_tpu.extproc.server import PickRequest
+
+        start = time.monotonic()
+        res = picker2.pick(PickRequest(headers={}, body=b"x"), ds2.endpoints())
+        elapsed = time.monotonic() - start
+        assert res.endpoint == "10.0.1.2:8000"
+        assert 0.4 < elapsed < 3.0  # waited ~the deadline, then best-effort
+    finally:
+        picker2.close()
+
+
+def test_flow_control_critical_not_held():
+    import time
+
+    sched2 = Scheduler(ProfileConfig())
+    ms2 = MetricsStore()
+    ds2 = Datastore()
+    ds2.pool_set(
+        EndpointPool(selector={"app": "vllm"}, target_ports=[8000],
+                     namespace="default")
+    )
+    ds2.pod_update_or_add(make_pod(name="h2", ip="10.0.1.3"))
+    ms2.update(ds2.endpoints()[0].slot, {Metric.QUEUE_DEPTH: 500})
+    picker2 = BatchingTPUPicker(
+        sched2, ds2, ms2, max_wait_s=0.002,
+        hold_max_s=5.0, hold_queue_limit=100,
+    )
+    try:
+        from gie_tpu.extproc.server import PickRequest
+
+        start = time.monotonic()
+        res = picker2.pick(
+            PickRequest(headers={mdkeys.OBJECTIVE_KEY: ["critical"]}, body=b"x"),
+            ds2.endpoints(),
+        )
+        assert res.endpoint == "10.0.1.3:8000"
+        assert time.monotonic() - start < 2.0  # never held
+    finally:
+        picker2.close()
