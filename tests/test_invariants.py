@@ -1,0 +1,75 @@
+"""Randomized invariant sweep over the scheduling cycle.
+
+Property-style coverage across pickers and configs: whatever the inputs,
+the cycle must uphold the protocol contracts — picks only within the
+eligibility mask, consistent status/index pairing, no invalid-slot leaks,
+assumed load non-negative, distinct fallback entries.
+"""
+
+import functools
+
+import jax
+import numpy as np
+import pytest
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
+from gie_tpu.sched.types import SchedState, Weights
+from gie_tpu.utils.testing import make_endpoints, make_requests
+
+
+@pytest.mark.parametrize("picker", ["topk", "random", "sinkhorn"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_cycle_invariants_random_inputs(picker, seed):
+    rng = np.random.default_rng(seed)
+    m = int(rng.integers(1, 40))
+    n = int(rng.integers(1, 50))
+    eps = make_endpoints(
+        m,
+        queue=rng.integers(0, 200, m).tolist(),
+        kv=rng.uniform(0, 1.0, m).tolist(),
+        max_lora=float(rng.integers(0, 6)),
+    )
+    subsets = []
+    for _ in range(n):
+        r = rng.uniform()
+        if r < 0.3:
+            subsets.append(None)  # no hint
+        elif r < 0.4:
+            subsets.append([int(x) for x in rng.integers(400, 500, 2)])  # dead
+        else:
+            k = int(rng.integers(1, m + 1))
+            subsets.append(rng.choice(m, size=k, replace=False).tolist())
+    prompts = [bytes(rng.integers(65, 90, int(rng.integers(0, 2000)),
+                                  dtype=np.uint8)) for _ in range(n)]
+    reqs = make_requests(
+        n,
+        prompts=prompts,
+        subset=subsets,
+        lora_id=rng.integers(-1, 5, n).tolist(),
+        criticality=rng.integers(0, 3, n).tolist(),
+    )
+    cfg = ProfileConfig(picker=picker, queue_limit=float(rng.integers(10, 300)))
+    fn = jax.jit(functools.partial(scheduling_cycle, cfg=cfg, predictor_fn=None))
+    result, state = fn(
+        SchedState.init(), reqs, eps, Weights.default(),
+        jax.random.PRNGKey(seed), None,
+    )
+    indices = np.asarray(result.indices)
+    status = np.asarray(result.status)
+    mask = np.asarray(reqs.subset_mask) & np.asarray(eps.valid)[None, :]
+
+    for i in range(n):
+        if status[i] == C.Status.OK:
+            assert indices[i, 0] >= 0
+            for j in indices[i]:
+                if j >= 0:
+                    assert mask[i, j], f"pick {j} outside mask for row {i}"
+            picked = [int(x) for x in indices[i] if x >= 0]
+            assert len(set(picked)) == len(picked), "duplicate fallbacks"
+        else:
+            assert (indices[i] == -1).all(), "non-OK rows must carry no picks"
+        if subsets[i] is not None and all(s >= 400 for s in subsets[i]):
+            assert status[i] != C.Status.OK, "dead subset must not be OK"
+    assert (np.asarray(state.assumed_load) >= 0).all()
+    assert int(state.tick) == 1
