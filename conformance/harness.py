@@ -71,11 +71,31 @@ class _FakeStream:
 
 class EppInstance:
     """One EPP per pool: the real server components, plus a replica count so
-    the suite can take it down (EppUnAvailableFailOpen)."""
+    the suite can take it down (EppUnAvailableFailOpen).
 
-    def __init__(self, env: "ConformanceEnv", pool_ns: str, pool_name: str):
+    picker_mode: "rr" (the lwepp-parity round-robin) or "tpu" (the full
+    batched scheduler through BatchingTPUPicker — proving conformance holds
+    for the real scheduling path, not just the trivial picker).
+    """
+
+    def __init__(self, env: "ConformanceEnv", pool_ns: str, pool_name: str,
+                 picker_mode: str = "rr"):
         self.datastore = Datastore()
-        self.server = StreamingServer(self.datastore, RoundRobinPicker())
+        self._closers = []
+        if picker_mode == "tpu":
+            from gie_tpu.metricsio import MetricsStore
+            from gie_tpu.sched.batching import BatchingTPUPicker
+            from gie_tpu.sched.profile import Scheduler
+
+            picker = BatchingTPUPicker(
+                Scheduler(), self.datastore, MetricsStore(), max_wait_s=0.002
+            )
+            self._closers.append(picker.close)
+        elif picker_mode == "rr":
+            picker = RoundRobinPicker()
+        else:
+            raise ValueError(f"unknown picker_mode {picker_mode!r}")
+        self.server = StreamingServer(self.datastore, picker)
         self.replicas = 1
         gknn = GKNN(api.GROUP, "InferencePool", pool_ns, pool_name)
         self._pool_rec = InferencePoolReconciler(env.cluster, self.datastore, gknn)
@@ -90,9 +110,14 @@ class EppInstance:
     def available(self) -> bool:
         return self.replicas > 0
 
+    def close(self) -> None:
+        for fn in self._closers:
+            fn()
+
 
 class ConformanceEnv:
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0, picker_mode: str = "rr"):
+        self.picker_mode = picker_mode
         self.cluster = FakeCluster()
         self.gateways: dict[str, Gateway] = {}
         self.routes: dict[tuple[str, str], HTTPRoute] = {}
@@ -119,12 +144,20 @@ class ConformanceEnv:
         self.cluster.apply_pool(pool)
         key = (pool.metadata.namespace, pool.metadata.name)
         if key not in self.epps:
-            self.epps[key] = EppInstance(self, *key)
+            self.epps[key] = EppInstance(self, *key,
+                                         picker_mode=self.picker_mode)
         self._reconcile_statuses()
+
+    def close(self) -> None:
+        """Tear down every EPP instance (picker collector threads etc.)."""
+        for epp in self.epps.values():
+            epp.close()
 
     def delete_pool(self, namespace: str, name: str) -> None:
         self.cluster.delete_pool(namespace, name)
-        self.epps.pop((namespace, name), None)
+        epp = self.epps.pop((namespace, name), None)
+        if epp is not None:
+            epp.close()
         self._reconcile_statuses()
 
     def apply_route(self, route: HTTPRoute) -> None:

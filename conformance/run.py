@@ -8,11 +8,25 @@ environment and writes the versioned ConformanceReport.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import traceback
 
 
+def _force_cpu() -> None:
+    """Conformance is protocol-level; it must not depend on (or hang on)
+    accelerator availability. Mirrors tests/conftest.py."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:
+        pass
+
+
 def main(argv=None) -> int:
+    _force_cpu()
     parser = argparse.ArgumentParser(prog="gie-tpu-conformance")
     parser.add_argument("--report", default="conformance-report.yaml")
     args = parser.parse_args(argv)
@@ -29,11 +43,17 @@ def main(argv=None) -> int:
         if name.startswith("test_") and name != "test_zzz_emit_report"
         and callable(fn)
     ]
+    import inspect
+
     failed = 0
     for name, fn in tests:
-        env = suite.env.__wrapped__()  # the fixture body builds the env
         try:
-            fn(env)
+            params = inspect.signature(fn).parameters
+            if params:
+                env = suite.env.__wrapped__()  # fixture body builds the env
+                fn(env)
+            else:
+                fn()  # self-contained test (builds its own environment)
             print(f"PASS {name}")
         except Exception:
             failed += 1

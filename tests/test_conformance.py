@@ -340,6 +340,42 @@ def test_destination_endpoint_served(env):
     assert served.startswith(pod.ip + ":")
 
 
+@record("GatewayFollowingEPPRoutingTPUScheduler")
+def test_routing_conformance_with_tpu_scheduler():
+    """The strictest routing test, run against the REAL batched TPU
+    scheduler (BatchingTPUPicker) instead of round-robin: 100 steered
+    requests per subset size, zero misroutes tolerated."""
+    env = ConformanceEnv(picker_mode="tpu")
+    env.apply_gateway(Gateway("primary-gateway"))
+    env.apply_service(Service("epp-svc"))
+    env.deploy_model_servers("primary-model-server", 3, {"app": "primary"})
+    env.apply_pool(make_pool("pool-tpu", {"app": "primary"}))
+    env.apply_route(simple_route("route-tpu", "primary-gateway", "pool-tpu"))
+    pods = [p for p in env.cluster.list_pods("default")
+            if p.labels.get("app") == "primary"]
+    try:
+        for subset_size in (1, 2, 3):
+            subset = pods[:subset_size]
+            allowed = {p.name for p in subset}
+            steering = ",".join(p.ip for p in subset)
+            served = collections.Counter()
+            for _ in range(100):
+                resp = env.send(
+                    "primary-gateway", "x", "/",
+                    headers={mdkeys.TEST_ENDPOINT_SELECTION_HEADER: steering},
+                )
+                assert resp.status == 200
+                served[resp.backend_pod] += 1
+            assert set(served) <= allowed, f"misroutes: {served} vs {allowed}"
+        # Unsteered traffic also routes only to pool pods.
+        for _ in range(20):
+            resp = env.send("primary-gateway", "x", "/")
+            assert resp.status == 200
+            assert resp.backend_pod.startswith("primary-")
+    finally:
+        env.close()
+
+
 def test_zzz_emit_report(tmp_path):
     """Write the versioned ConformanceReport (reference
     conformancereport.go:39-56). Runs last by name ordering."""
@@ -348,3 +384,5 @@ def test_zzz_emit_report(tmp_path):
     text = path.read_text()
     assert "ConformanceReport" in text
     assert "Passed" in text
+
+
