@@ -291,24 +291,17 @@ class OnlineTrainer:
         """Checkpoint params via orbax (reference analogue: none — all EPP
         state is soft cache; the learned policy's weights are the exception
         the BASELINE north star introduces)."""
-        import orbax.checkpoint as ocp
+        from gie_tpu.utils.checkpoint import save_pytree
 
-        path = os.path.abspath(directory)
-        with ocp.PyTreeCheckpointer() as ckptr:
-            ckptr.save(path, self.params, force=True)
+        save_pytree(directory, self.params)
 
     def restore(self, directory: str) -> bool:
         """Restore params if a checkpoint exists; returns success. The
         optimizer state restarts fresh (acceptable for online fine-tuning)."""
-        import orbax.checkpoint as ocp
+        from gie_tpu.utils.checkpoint import restore_pytree
 
-        path = os.path.abspath(directory)
-        if not os.path.isdir(path):
-            return False
-        try:
-            with ocp.PyTreeCheckpointer() as ckptr:
-                restored = ckptr.restore(path, item=self.params)
-        except Exception:
+        restored = restore_pytree(directory, self.params)
+        if restored is None:
             return False
         self.params = restored
         self.opt_state = self.tx.init(self.params)

@@ -314,3 +314,28 @@ class Scheduler:
     def snapshot_assumed_load(self) -> np.ndarray:
         with self._lock:
             return np.asarray(self.state.assumed_load)
+
+    # -- optional warm-restart persistence ---------------------------------
+    # The reference explicitly accepts prefix-index loss on restart
+    # (0602 README:93); offering a checkpoint anyway lets a restarted EPP
+    # keep its cache affinity instead of relearning it from cold traffic.
+
+    def save_state(self, directory: str) -> None:
+        from gie_tpu.utils.checkpoint import save_pytree
+
+        with self._lock:
+            # Materialize under the lock: the live state's buffers are
+            # donated (deleted) by the next pick; a reference snapshot
+            # would intermittently fail mid-save under traffic.
+            host_state = jax.tree.map(np.asarray, self.state)
+        save_pytree(directory, host_state)
+
+    def restore_state(self, directory: str) -> bool:
+        from gie_tpu.utils.checkpoint import restore_pytree
+
+        restored = restore_pytree(directory, SchedState.init())
+        if restored is None:
+            return False
+        with self._lock:
+            self.state = restored
+        return True

@@ -1,0 +1,38 @@
+"""Shared orbax checkpoint helpers.
+
+One implementation of the save/restore pattern used by the latency
+predictor and the scheduler warm-restart path. `save_pytree` materializes
+leaves to host BEFORE serializing: callers' live pytrees may alias device
+buffers that donating jits delete concurrently, so a reference snapshot
+would intermittently fail mid-save under traffic.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+
+def save_pytree(directory: str, tree) -> None:
+    import orbax.checkpoint as ocp
+
+    host_tree = jax.tree.map(np.asarray, tree)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(directory), host_tree, force=True)
+
+
+def restore_pytree(directory: str, template):
+    """Restore into `template`'s structure; returns the restored tree or
+    None when the directory is missing/unreadable."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(directory)
+    if not os.path.isdir(path):
+        return None
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            return ckptr.restore(path, item=template)
+    except Exception:
+        return None
