@@ -21,7 +21,6 @@ system — SURVEY.md section 5.4).
 from __future__ import annotations
 
 import dataclasses
-import os
 import threading
 from typing import Optional
 

@@ -39,6 +39,8 @@ class Options:
     # InferenceObjective declarations: "name=criticality" pairs (the CLI
     # stand-in for the CRD until a kube watch adapter supplies them).
     objectives: list = dataclasses.field(default_factory=list)
+    # Declarative scheduler profile (YAML: picker/thresholds/plugins/weights).
+    scheduler_config: Optional[str] = None
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -81,6 +83,9 @@ class Options:
         parser.add_argument("--leader-elect", action="store_true",
                             default=d.leader_elect)
         parser.add_argument("--leader-lease-path", default=d.leader_lease_path)
+        parser.add_argument("--scheduler-config", default=d.scheduler_config,
+                            help="YAML scheduler profile "
+                                 "(picker/thresholds/plugins/weights)")
         parser.add_argument("--objective", action="append", default=[],
                             dest="objectives", metavar="NAME=CRITICALITY",
                             help="register an InferenceObjective "
@@ -107,6 +112,7 @@ class Options:
             leader_elect=args.leader_elect,
             leader_lease_path=args.leader_lease_path,
             objectives=list(args.objectives),
+            scheduler_config=args.scheduler_config,
         )
 
     def validate(self) -> None:

@@ -53,26 +53,38 @@ class ExtProcServerRunner:
         self.trainer = None
         if scheduler is not None:
             self.scheduler = scheduler
-        elif opts.enable_predictor:
-            # Learned TTFT column with online training (BASELINE configs[3]).
-            from gie_tpu.models.latency import (
-                LatencyPredictor,
-                OnlineTrainer,
-                predictor_score_fn,
-            )
-
-            predictor = LatencyPredictor()
-            self.trainer = OnlineTrainer(predictor)
-            if opts.predictor_checkpoint_dir:
-                if self.trainer.restore(opts.predictor_checkpoint_dir):
-                    self.log.info("predictor checkpoint restored",
-                                  dir=opts.predictor_checkpoint_dir)
-            self.scheduler = Scheduler(
-                predictor_fn=predictor_score_fn(predictor),
-                predictor_params=self.trainer.params,
-            )
         else:
-            self.scheduler = Scheduler()
+            from gie_tpu.sched.profile import ProfileConfig
+
+            cfg, weights = ProfileConfig(), None
+            if opts.scheduler_config:
+                from gie_tpu.sched.config import load_scheduler_config_file
+
+                cfg, weights = load_scheduler_config_file(opts.scheduler_config)
+            predictor_fn = predictor_params = None
+            if opts.enable_predictor:
+                # Learned TTFT column with online training (configs[3]);
+                # COMPOSES with --scheduler-config rather than ignoring it.
+                from gie_tpu.models.latency import (
+                    LatencyPredictor,
+                    OnlineTrainer,
+                    predictor_score_fn,
+                )
+
+                predictor = LatencyPredictor()
+                self.trainer = OnlineTrainer(predictor)
+                if opts.predictor_checkpoint_dir:
+                    if self.trainer.restore(opts.predictor_checkpoint_dir):
+                        self.log.info("predictor checkpoint restored",
+                                      dir=opts.predictor_checkpoint_dir)
+                predictor_fn = predictor_score_fn(predictor)
+                predictor_params = self.trainer.params
+            self.scheduler = Scheduler(
+                cfg,
+                weights=weights,
+                predictor_fn=predictor_fn,
+                predictor_params=predictor_params,
+            )
         self.metrics_store = MetricsStore()
         self.mapping = BY_NAME[opts.model_server_type]
         self.scraper = Scraper(

@@ -1,0 +1,89 @@
+"""Declarative scheduler configuration.
+
+The reference's scheduler architecture calls for a declarative plugin /
+profile configuration API (reference docs/proposals/0845-scheduler-
+architecture-proposal/README.md:92, and the text plugin config referenced by
+003:33). Here one YAML document configures the whole batched profile:
+
+    picker: sinkhorn
+    queue_limit: 128
+    load_decay: 0.95
+    plugins:            # enable/disable scorer stages
+      prefix: true
+      lora: true
+      saturation: true
+    weights:            # profile-level blend weights
+      queue: 2.0
+      prefix: 4.0
+      assumed_load: 1.5
+
+Unknown keys fail loudly (a typo'd knob must not silently no-op).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import yaml
+
+from gie_tpu.sched.profile import ProfileConfig
+from gie_tpu.sched.types import Weights
+
+_PLUGIN_FLAGS = {
+    "prefix": "enable_prefix",
+    "lora": "enable_lora",
+    "saturation": "enable_saturation",
+}
+
+_WEIGHT_FIELDS = {f.name for f in dataclasses.fields(Weights)}
+_CONFIG_FIELDS = {f.name for f in dataclasses.fields(ProfileConfig)}
+
+
+def load_scheduler_config(text: str) -> tuple[ProfileConfig, Weights]:
+    """YAML -> (ProfileConfig, Weights); raises ValueError on unknown keys."""
+    doc = yaml.safe_load(text) or {}
+    if not isinstance(doc, dict):
+        raise ValueError("scheduler config must be a YAML mapping")
+
+    cfg_kwargs: dict = {}
+    weights = Weights.default()
+
+    for key, value in doc.items():
+        if key == "plugins":
+            if not isinstance(value, dict):
+                raise ValueError("plugins must be a mapping of name: bool")
+            for name, enabled in value.items():
+                if name not in _PLUGIN_FLAGS:
+                    raise ValueError(
+                        f"unknown plugin {name!r}; known: {sorted(_PLUGIN_FLAGS)}"
+                    )
+                cfg_kwargs[_PLUGIN_FLAGS[name]] = bool(enabled)
+        elif key == "weights":
+            if not isinstance(value, dict):
+                raise ValueError("weights must be a mapping of name: number")
+            for name, w in value.items():
+                if name not in _WEIGHT_FIELDS:
+                    raise ValueError(
+                        f"unknown weight {name!r}; known: {sorted(_WEIGHT_FIELDS)}"
+                    )
+                weights = weights.replace(**{name: jnp.float32(float(w))})
+        elif key == "picker":
+            if value not in ("topk", "random", "sinkhorn"):
+                raise ValueError(
+                    f"unknown picker {value!r}; known: topk, random, sinkhorn"
+                )
+            cfg_kwargs[key] = value
+        elif key in _CONFIG_FIELDS:
+            cfg_kwargs[key] = value
+        else:
+            raise ValueError(
+                f"unknown scheduler config key {key!r}; known: "
+                f"{sorted(_CONFIG_FIELDS | {'plugins', 'weights'})}"
+            )
+    return ProfileConfig(**cfg_kwargs), weights
+
+
+def load_scheduler_config_file(path: str) -> tuple[ProfileConfig, Weights]:
+    with open(path) as f:
+        return load_scheduler_config(f.read())

@@ -92,28 +92,29 @@ def request_cost_host(prompt_len: float, decode_len: float = 0.0) -> float:
     return float(np.clip((prompt_len + decode_len) / 2048.0, 0.25, 8.0))
 
 
-def scheduling_cycle(
+def build_stages(
     state: SchedState,
     reqs: RequestBatch,
     eps: EndpointBatch,
     weights: Weights,
-    key: jax.Array,
-    predictor_params,
     *,
     cfg: ProfileConfig,
     predictor_fn: Optional[PredictorFn],
-) -> tuple[PickResult, SchedState]:
-    """One full scheduling cycle. Pure; jit-compiled per (N-bucket, cfg)."""
-    # ---- Filter stage ----------------------------------------------------
+    predictor_params,
+):
+    """Filter + score stages shared by scheduling_cycle and explain:
+    -> (mask, shed, named column dict, stacked [S,N,M], wvec [S], total).
+
+    Saturation is a soft filter (004 README:77-80 + 006 saturation
+    semantics): when unsaturated candidates exist they are preferred; when
+    ALL candidates are saturated, SHEDDABLE traffic is shed with 429 while
+    STANDARD degrades to best-effort over the full candidate set (CRITICAL
+    bypasses inside saturation_mask).
+    """
     mask = filters.base_mask(reqs, eps)
     membership = filters.lora_membership(reqs, eps) if cfg.enable_lora else None
     if cfg.enable_lora:
         mask &= filters.lora_capacity_mask(reqs, eps, membership)
-    # Saturation is a soft filter (004 README:77-80 + 006 saturation
-    # semantics): when unsaturated candidates exist they are preferred; when
-    # ALL candidates are saturated, SHEDDABLE traffic is shed with 429 while
-    # STANDARD degrades to best-effort over the full candidate set (CRITICAL
-    # bypasses inside saturation_mask).
     if cfg.enable_saturation:
         sat_mask = mask & filters.saturation_mask(
             reqs, eps, queue_limit=cfg.queue_limit, kv_limit=cfg.kv_limit
@@ -133,36 +134,49 @@ def scheduling_cycle(
     else:
         shed = jnp.zeros(reqs.valid.shape, bool)
 
-    # ---- Score stage -----------------------------------------------------
-    cols: list[jax.Array] = []
-    wts: list[jax.Array] = []
-    cols.append(jnp.broadcast_to(
-        scorers.queue_score(eps, queue_norm=cfg.queue_norm)[None, :], mask.shape))
-    wts.append(weights.queue)
-    cols.append(jnp.broadcast_to(scorers.kv_cache_score(eps)[None, :], mask.shape))
-    wts.append(weights.kv_cache)
-    cols.append(jnp.broadcast_to(
-        scorers.assumed_load_score(state.assumed_load, load_norm=cfg.load_norm)[None, :],
-        mask.shape))
-    wts.append(weights.assumed_load)
+    named: dict[str, jax.Array] = {
+        "queue": jnp.broadcast_to(
+            scorers.queue_score(eps, queue_norm=cfg.queue_norm)[None, :],
+            mask.shape),
+        "kv_cache": jnp.broadcast_to(
+            scorers.kv_cache_score(eps)[None, :], mask.shape),
+        "assumed_load": jnp.broadcast_to(
+            scorers.assumed_load_score(
+                state.assumed_load, load_norm=cfg.load_norm)[None, :],
+            mask.shape),
+    }
     if cfg.enable_prefix:
-        cols.append(
-            prefix.match_scores(
-                state.prefix, reqs, state.tick, max_age=cfg.prefix_max_age
-            )
-        )
-        wts.append(weights.prefix)
+        named["prefix"] = prefix.match_scores(
+            state.prefix, reqs, state.tick, max_age=cfg.prefix_max_age)
     if cfg.enable_lora:
-        cols.append(scorers.lora_affinity_score(reqs, eps, membership))
-        wts.append(weights.lora)
+        named["lora"] = scorers.lora_affinity_score(reqs, eps, membership)
     if predictor_fn is not None:
-        cols.append(predictor_fn(predictor_params, reqs, eps, state.assumed_load))
-        wts.append(weights.latency)
+        named["latency"] = predictor_fn(
+            predictor_params, reqs, eps, state.assumed_load)
 
-    stacked = jnp.stack(cols)                       # [S, N, M]
-    wvec = jnp.stack(wts)                           # [S]
+    stacked = jnp.stack(list(named.values()))       # [S, N, M]
+    wvec = jnp.stack([getattr(weights, k) for k in named])  # [S]
     total = jnp.einsum("s,snm->nm", wvec, stacked) / jnp.maximum(
         jnp.sum(wvec), jnp.float32(1e-6)
+    )
+    return mask, shed, named, stacked, wvec, total
+
+
+def scheduling_cycle(
+    state: SchedState,
+    reqs: RequestBatch,
+    eps: EndpointBatch,
+    weights: Weights,
+    key: jax.Array,
+    predictor_params,
+    *,
+    cfg: ProfileConfig,
+    predictor_fn: Optional[PredictorFn],
+) -> tuple[PickResult, SchedState]:
+    """One full scheduling cycle. Pure; jit-compiled per (N-bucket, cfg)."""
+    mask, shed, _named, stacked, wvec, total = build_stages(
+        state, reqs, eps, weights,
+        cfg=cfg, predictor_fn=predictor_fn, predictor_params=predictor_params,
     )
 
     # ---- Pick stage ------------------------------------------------------
@@ -303,6 +317,34 @@ class Scheduler:
         Swapped under the lock so in-flight cycles see a consistent tree."""
         with self._lock:
             self.predictor_params = params
+
+    def explain(
+        self, reqs: RequestBatch, eps: EndpointBatch
+    ) -> dict[str, np.ndarray]:
+        """Debug surface: per-scorer columns + blended total + eligibility
+        mask for a batch, WITHOUT touching scheduler state (the per-request
+        CycleState introspection of 0845, as tensors). Uses the SAME
+        build_stages the scheduling cycle runs, so the decomposition cannot
+        diverge from the real pick (saturation and shedding included)."""
+        n = int(np.asarray(reqs.valid).shape[0])
+        bucket = bucket_for(n)
+        reqs = pad_requests(reqs, bucket)
+        with self._lock:
+            # Host materialization: the live buffers are donated (deleted)
+            # by the next pick, so a reference snapshot would race.
+            state = jax.tree.map(np.asarray, self.state)
+            weights = self.weights
+            params = self.predictor_params
+        mask, shed, named, _stacked, _wvec, total = build_stages(
+            state, reqs, eps, weights,
+            cfg=self.cfg, predictor_fn=self.predictor_fn,
+            predictor_params=params,
+        )
+        out = {name: np.asarray(col)[:n] for name, col in named.items()}
+        out["total"] = np.asarray(total)[:n]
+        out["mask"] = np.asarray(mask)[:n]
+        out["shed"] = np.asarray(shed)[:n]
+        return out
 
     def evict_endpoint(self, slot: int) -> None:
         """Invalidate all prefix-cache knowledge of an endpoint slot (pod
