@@ -1,0 +1,200 @@
+"""Kubernetes watch adapter: the real-cluster ClusterClient.
+
+The in-process FakeCluster serves tests and the demo; this adapter plugs an
+actual kube-apiserver into the same seam (reference analogue:
+controller-runtime's cached client + watches, controller_manager.go:45-68).
+The `kubernetes` package is not available in the build container, so imports
+are lazy and failure is a clear actionable error; the translation logic
+(k8s objects -> gie_tpu objects, watch events -> reconciler fan-out) is
+factored into pure functions tested against duck-typed fakes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from gie_tpu.api import types as api
+from gie_tpu.controller.cluster import WatchEvent
+from gie_tpu.datastore.objects import Pod
+
+
+def pod_from_k8s(obj) -> Pod:
+    """corev1.Pod -> datastore Pod.
+
+    Accepts BOTH key shapes seen in practice: camelCase (raw watch-event /
+    manifest dicts) and snake_case (the kubernetes client's .to_dict()
+    output). Readiness = PodReady condition True (reference pod.go:24-36).
+    """
+    if hasattr(obj, "to_dict"):
+        obj = obj.to_dict()
+    meta = obj.get("metadata") or {}
+    status = obj.get("status") or {}
+
+    def get(o, camel, default=None):
+        if not isinstance(o, dict):
+            return default
+        value = o.get(camel)
+        if value is None:
+            value = o.get(_snake(camel))
+        return default if value is None else value
+
+    conditions = get(status, "conditions", []) or []
+    ready = any(
+        get(c, "type") == "Ready" and get(c, "status") == "True"
+        for c in conditions
+        if isinstance(c, dict)
+    )
+    return Pod(
+        name=get(meta, "name", ""),
+        namespace=get(meta, "namespace", "default"),
+        labels=dict(get(meta, "labels", {}) or {}),
+        annotations=dict(get(meta, "annotations", {}) or {}),
+        ip=get(status, "podIP", "") or "",
+        ready=ready,
+        deletionTimestamp=get(meta, "deletionTimestamp", None),
+    )
+
+
+def _snake(camel: str) -> str:
+    """camelCase -> snake_case matching the kubernetes client's to_dict
+    keys (podIP -> pod_ip, deletionTimestamp -> deletion_timestamp)."""
+    out = []
+    prev_lower = False
+    for ch in camel:
+        if ch.isupper():
+            if prev_lower:
+                out.append("_")
+            out.append(ch.lower())
+            prev_lower = False
+        else:
+            out.append(ch)
+            prev_lower = True
+    return "".join(out)
+
+
+class KubeClusterClient:
+    """ClusterClient over a real kube-apiserver.
+
+    Requires the `kubernetes` Python client at runtime; constructing without
+    it raises ImportError with instructions (tests exercise the translation
+    functions above directly, which need no client)."""
+
+    def __init__(self, namespace: str, pool_name: str,
+                 kubeconfig: Optional[str] = None):
+        try:
+            from kubernetes import client, config, watch  # noqa: F401
+        except ImportError as e:  # pragma: no cover - env without kubernetes
+            raise ImportError(
+                "KubeClusterClient needs the `kubernetes` package; install "
+                "it in the deployment image (the build container ships "
+                "without it — use FakeCluster/--demo there)"
+            ) from e
+        try:
+            if kubeconfig:
+                config.load_kube_config(kubeconfig)
+            else:
+                config.load_incluster_config()
+        except Exception as e:
+            raise RuntimeError(
+                "no usable Kubernetes configuration: pass --kubeconfig "
+                "outside a cluster, or run in-cluster with a service "
+                f"account ({type(e).__name__}: {e})"
+            ) from e
+        self._core = client.CoreV1Api()
+        self._custom = client.CustomObjectsApi()
+        self._watchmod = watch
+        self.namespace = namespace
+        self.pool_name = pool_name
+        self._subscribers: list[Callable[[WatchEvent], None]] = []
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- ClusterClient surface --------------------------------------------
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        try:
+            return pod_from_k8s(
+                self._core.read_namespaced_pod(name, namespace).to_dict()
+            )
+        except Exception as e:
+            # Only a confirmed 404 means "deleted" (the reconciler evicts on
+            # None); transient apiserver failures must NOT drop endpoints.
+            if getattr(e, "status", None) == 404:
+                return None
+            raise
+
+    def list_pods(self, namespace: str) -> list[Pod]:
+        pods = self._core.list_namespaced_pod(namespace).items
+        return [pod_from_k8s(p.to_dict()) for p in pods]
+
+    def get_pool(self, namespace: str, name: str) -> Optional[api.InferencePool]:
+        try:
+            obj = self._custom.get_namespaced_custom_object(
+                api.GROUP, api.VERSION, namespace, "inferencepools", name
+            )
+            return api.pool_from_dict(obj)
+        except Exception as e:
+            if getattr(e, "status", None) == 404:
+                return None
+            raise
+
+    # -- watch fan-out (reconciler wiring seam) ----------------------------
+
+    def subscribe(self, fn: Callable[[WatchEvent], None]) -> None:
+        self._subscribers.append(fn)
+
+    def start(self) -> None:
+        """Run pod + pool watches, fanning events to subscribers."""
+        for target in (self._watch_pods, self._watch_pools):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _emit(self, event: WatchEvent) -> None:
+        for fn in list(self._subscribers):
+            fn(event)
+
+    def _watch_pods(self) -> None:  # pragma: no cover - needs a cluster
+        w = self._watchmod.Watch()
+        while not self._stop.is_set():
+            try:
+                for ev in w.stream(self._core.list_namespaced_pod,
+                                   self.namespace, timeout_seconds=60):
+                    self._emit(watch_event_from_k8s(ev, "Pod"))
+                    if self._stop.is_set():
+                        return
+            except Exception:
+                self._stop.wait(1.0)
+
+    def _watch_pools(self) -> None:  # pragma: no cover - needs a cluster
+        w = self._watchmod.Watch()
+        while not self._stop.is_set():
+            try:
+                for ev in w.stream(
+                    self._custom.list_namespaced_custom_object,
+                    api.GROUP, api.VERSION, self.namespace, "inferencepools",
+                    timeout_seconds=60,
+                ):
+                    self._emit(watch_event_from_k8s(ev, "InferencePool"))
+                    if self._stop.is_set():
+                        return
+            except Exception:
+                self._stop.wait(1.0)
+
+
+def watch_event_from_k8s(ev: dict, kind: str) -> WatchEvent:
+    """kubernetes watch event dict -> WatchEvent (pure; tested)."""
+    obj = ev.get("object", {})
+    if hasattr(obj, "to_dict"):
+        obj = obj.to_dict()
+    meta = obj.get("metadata", {}) or {}
+    return WatchEvent(
+        type=ev.get("type", "MODIFIED"),
+        kind=kind,
+        namespace=meta.get("namespace", "default"),
+        name=meta.get("name", ""),
+    )

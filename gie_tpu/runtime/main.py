@@ -105,23 +105,39 @@ def main(argv=None) -> int:
         help="run against an in-process simulated cluster",
     )
     parser.add_argument("--demo-pods", type=int, default=4)
+    parser.add_argument(
+        "--kube", action="store_true",
+        help="connect to a real kube-apiserver (in-cluster config, or "
+             "--kubeconfig); requires the kubernetes package",
+    )
+    parser.add_argument("--kubeconfig", default=None)
     args = parser.parse_args(argv)
     opts = Options.from_args(args)
     opts.validate()
     set_verbosity(opts.verbosity)
     log = get_logger("main")
 
+    kube_client = None
     if args.demo:
         cluster = _demo_cluster(opts, args.demo_pods)
+    elif args.kube:
+        from gie_tpu.controller.kube import KubeClusterClient
+
+        kube_client = KubeClusterClient(
+            opts.pool_namespace, opts.pool_name, kubeconfig=args.kubeconfig
+        )
+        cluster = kube_client
     else:
         log.error(
-            "no cluster integration configured; run with --demo or provide "
-            "a ClusterClient adapter"
+            "no cluster integration configured; run with --demo (simulated) "
+            "or --kube (real apiserver via the kubernetes package)"
         )
         return 2
 
     runner = ExtProcServerRunner(opts, cluster)
     runner.setup()
+    if kube_client is not None:
+        kube_client.start()  # watches begin after reconcilers subscribe
     runner.start()
 
     stop = threading.Event()
@@ -134,6 +150,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, on_signal)
     log.info("serving", pool=opts.pool_name)
     stop.wait()
+    if kube_client is not None:
+        kube_client.stop()
     runner.stop()
     return 0
 

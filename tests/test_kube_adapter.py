@@ -1,0 +1,91 @@
+"""Kubernetes adapter translation tests (pure functions; no cluster)."""
+
+import pytest
+
+from gie_tpu.api.types import pool_from_dict
+from gie_tpu.controller.kube import (
+    KubeClusterClient,
+    pod_from_k8s,
+    watch_event_from_k8s,
+)
+
+
+def test_pod_from_k8s_dict():
+    pod = pod_from_k8s({
+        "metadata": {
+            "name": "vllm-0", "namespace": "inference",
+            "labels": {"app": "vllm"},
+            "annotations": {"inference.networking.k8s.io/active-ports": "8000"},
+        },
+        "status": {
+            "podIP": "10.4.2.1",
+            "conditions": [
+                {"type": "Initialized", "status": "True"},
+                {"type": "Ready", "status": "True"},
+            ],
+        },
+    })
+    assert pod.name == "vllm-0" and pod.namespace == "inference"
+    assert pod.ip == "10.4.2.1" and pod.ready
+    assert pod.annotations["inference.networking.k8s.io/active-ports"] == "8000"
+
+
+def test_pod_not_ready_without_ready_condition():
+    pod = pod_from_k8s({
+        "metadata": {"name": "p", "namespace": "d"},
+        "status": {"podIP": "1.2.3.4",
+                   "conditions": [{"type": "Ready", "status": "False"}]},
+    })
+    assert not pod.ready
+    pod2 = pod_from_k8s({"metadata": {"name": "p"}, "status": {}})
+    assert not pod2.ready and pod2.ip == ""
+
+
+def test_pool_from_k8s_manifest():
+    pool = pool_from_dict({
+        "apiVersion": "inference.networking.k8s.io/v1",
+        "kind": "InferencePool",
+        "metadata": {"name": "my-pool", "namespace": "inference"},
+        "spec": {
+            "selector": {"matchLabels": {"app": "vllm"}},
+            "targetPorts": [{"number": 8000}, {"number": 8002}],
+            "endpointPickerRef": {"name": "epp", "port": {"number": 9002},
+                                  "failureMode": "FailOpen"},
+        },
+    })
+    pool.validate()
+    assert pool.metadata.name == "my-pool"
+    assert [p.number for p in pool.spec.targetPorts] == [8000, 8002]
+    assert pool.spec.endpointPickerRef.failureMode == "FailOpen"
+
+
+def test_watch_event_translation():
+    ev = watch_event_from_k8s(
+        {"type": "ADDED",
+         "object": {"metadata": {"name": "p1", "namespace": "ns"}}},
+        "Pod",
+    )
+    assert (ev.type, ev.kind, ev.namespace, ev.name) == ("ADDED", "Pod", "ns", "p1")
+
+
+def test_pod_from_k8s_snake_case_to_dict_shape():
+    """The kubernetes client's .to_dict() emits snake_case keys — IP and
+    deletion timestamp must survive."""
+    pod = pod_from_k8s({
+        "metadata": {"name": "p", "namespace": "n",
+                     "deletion_timestamp": "2026-01-01T00:00:00Z"},
+        "status": {"pod_ip": "10.9.9.9",
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+    assert pod.ip == "10.9.9.9"
+    assert pod.deletionTimestamp == "2026-01-01T00:00:00Z"
+    assert pod.ready
+
+
+def test_client_requires_kubernetes_package():
+    import importlib.util
+
+    if importlib.util.find_spec("kubernetes") is not None:
+        pytest.skip("kubernetes installed; ImportError branch unreachable")
+    with pytest.raises(ImportError, match="kubernetes"):
+        KubeClusterClient("default", "pool")
