@@ -32,3 +32,33 @@ def test_tpu_beats_least_kv_goodput():
     assert tpu.prefix_hit_rate > base.prefix_hit_rate + 0.1
     assert tpu.goodput_tokens_per_s > base.goodput_tokens_per_s * 1.5
     assert tpu.ttft_p50_s < base.ttft_p50_s
+
+
+def test_tpu_beats_least_kv_multilora():
+    """BASELINE configs[2]: LoRA-affinity + queue-depth joint scoring must
+    dominate the baseline when adapter cold-loads are expensive."""
+    wl = WorkloadConfig(
+        arrival_qps=70.0,
+        n_sessions=64,
+        system_prompt_bytes=4096,
+        user_suffix_bytes=128,
+        decode_tokens_mean=32.0,
+        ttft_slo_s=2.5,
+        lora_adapters=12,
+    )
+    stub = StubConfig(
+        max_running=8,
+        prefill_tokens_per_s=4000.0,
+        decode_tokens_per_s=50.0,
+        prefix_cache_chunks=2048,
+        max_lora=4,
+        lora_load_s=0.5,
+    )
+    results = {}
+    for policy in ("least-kv", "tpu"):
+        cluster = SimCluster(n_pods=8, stub_cfg=stub, seed=0)
+        sched = tuned_scheduler() if policy == "tpu" else None
+        results[policy] = cluster.run(policy, wl, duration_s=12.0,
+                                      scheduler=sched)
+    assert (results["tpu"].goodput_tokens_per_s
+            > results["least-kv"].goodput_tokens_per_s * 2.0)

@@ -1,0 +1,45 @@
+"""Guard against the axon 80x-dispatch landmine: a jitted program that
+closes over a MODULE-LEVEL jnp array dispatches ~80x slower on this TPU
+backend and degrades the whole process (see pickers.NEG history). This
+static scan fails if anyone reintroduces one."""
+
+import ast
+import pathlib
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "gie_tpu"
+
+
+def _module_level_jnp_calls(tree: ast.Module) -> list[str]:
+    hits = []
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        for call in ast.walk(value):
+            if not isinstance(call, ast.Call):
+                continue
+            func = call.func
+            # jnp.<anything>(...) at module level creates a device array.
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "jnp"):
+                names = [ast.unparse(t) for t in targets]
+                hits.append(f"{', '.join(names)} = jnp.{func.attr}(...)")
+    return hits
+
+
+def test_no_module_level_jnp_constants():
+    offenders = []
+    for path in PKG.rglob("*.py"):
+        tree = ast.parse(path.read_text())
+        for hit in _module_level_jnp_calls(tree):
+            offenders.append(f"{path.relative_to(PKG.parent)}: {hit}")
+    assert not offenders, (
+        "module-level jnp constants captured into jit dispatch ~80x slower "
+        "on the axon backend — use Python/numpy scalars instead:\n"
+        + "\n".join(offenders)
+    )
