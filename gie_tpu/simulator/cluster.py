@@ -27,6 +27,7 @@ from gie_tpu.metricsio.mappings import VLLM
 from gie_tpu.metricsio.scrape import parse_scrape
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.hashing import batch_chunk_hashes
+from gie_tpu.models.latency import host_features
 from gie_tpu.sched.profile import ProfileConfig, Scheduler, request_cost_host
 from gie_tpu.sched.types import RequestBatch, Weights
 from gie_tpu.simulator.vllm_stub import StubConfig, VLLMStub
@@ -119,6 +120,8 @@ class SimCluster:
         dt: float = 0.02,
         scrape_interval_s: float = 0.05,
         scheduler: Optional[Scheduler] = None,
+        trainer=None,
+        train_every_s: float = 1.0,
     ) -> RunStats:
         wl = workload
         sessions = [
@@ -131,7 +134,11 @@ class SimCluster:
         rr_counter = 0
         clock = 0.0
         next_scrape = 0.0
+        next_train = train_every_s
         completions = []
+        # (pod_slot, stub_rid) -> pick-time feature row for online training
+        # (BASELINE configs[3]: the predictor learns from served timings).
+        feature_log: dict[tuple[int, int], np.ndarray] = {}
         self._scrape_all(0.0)
 
         while clock < duration_s:
@@ -159,13 +166,37 @@ class SimCluster:
                     policy, scheduler, prompts, decodes, loras, clock, rr_counter
                 )
                 rr_counter += n_new
+                if trainer is not None:
+                    # Pick-time truth for training features: the LIVE
+                    # assumed-load vector (what serving-time features see)
+                    # and scrape age — never constants, or the predictor
+                    # trains on a different feature space than it scores.
+                    loads = (scheduler.snapshot_assumed_load()
+                             if scheduler is not None else None)
                 for prompt, decode, lora, pod in zip(prompts, decodes, loras, picks):
-                    self.stubs[pod].submit(prompt, decode_tokens=decode, lora=lora)
+                    rid = self.stubs[pod].submit(
+                        prompt, decode_tokens=decode, lora=lora)
+                    if trainer is not None:
+                        row = self.store._metrics[pod].copy()
+                        row[C.Metric.METRICS_AGE_S] = max(
+                            clock - self.store._scraped_at[pod], 0.0)
+                        feature_log[(pod, rid)] = host_features(
+                            row,
+                            float(loads[pod]) if loads is not None else 0.0,
+                            float(len(prompt)),
+                            float(decode),
+                            lora is not None,
+                        )
 
             # --- advance the fleet ----------------------------------------
             for slot, stub in enumerate(self.stubs):
                 for comp in stub.step(dt):
                     completions.append(comp)
+                    if trainer is not None:
+                        feats = feature_log.pop((slot, comp.rid), None)
+                        if feats is not None:
+                            trainer.observe(
+                                feats, ttft_s=comp.ttft_s, tpot_s=comp.tpot_s)
                     if scheduler is not None and policy == "tpu":
                         # Release exactly what pick time charged.
                         cost = request_cost_host(
@@ -179,6 +210,10 @@ class SimCluster:
             if clock >= next_scrape:
                 self._scrape_all(clock)
                 next_scrape = clock + scrape_interval_s
+            if trainer is not None and clock >= next_train:
+                if trainer.train(steps=5) is not None and scheduler is not None:
+                    scheduler.set_predictor_params(trainer.params)
+                next_train = clock + train_every_s
 
         # --- stats ---------------------------------------------------------
         if not completions:

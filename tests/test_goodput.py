@@ -62,3 +62,42 @@ def test_tpu_beats_least_kv_multilora():
                                       scheduler=sched)
     assert (results["tpu"].goodput_tokens_per_s
             > results["least-kv"].goodput_tokens_per_s * 2.0)
+
+
+def test_predictor_trains_online_in_sim_without_regression():
+    """BASELINE configs[3]: the predictor column learns from real sim
+    completions and must not regress goodput."""
+    import jax.numpy as jnp
+
+    from gie_tpu.models.latency import (
+        LatencyPredictor,
+        OnlineTrainer,
+        predictor_score_fn,
+    )
+    from gie_tpu.sched import ProfileConfig, Scheduler, Weights
+
+    p = LatencyPredictor()
+    trainer = OnlineTrainer(p, batch_size=64)
+    sched = Scheduler(
+        ProfileConfig(load_decay=0.95, load_norm=8, queue_norm=16,
+                      picker="sinkhorn"),
+        weights=Weights(
+            queue=jnp.float32(2.0), kv_cache=jnp.float32(1.0),
+            prefix=jnp.float32(4.0), lora=jnp.float32(1.0),
+            assumed_load=jnp.float32(1.5), latency=jnp.float32(1.0),
+        ),
+        predictor_fn=predictor_score_fn(p),
+        predictor_params=trainer.params,
+    )
+    base = run("least-kv", duration=12.0)
+    wl = WorkloadConfig(
+        arrival_qps=75.0, n_sessions=64, system_prompt_bytes=8192,
+        user_suffix_bytes=128, decode_tokens_mean=32.0, ttft_slo_s=2.5,
+    )
+    stub = StubConfig(max_running=8, prefill_tokens_per_s=4000.0,
+                      decode_tokens_per_s=50.0, prefix_cache_chunks=2048)
+    cluster = SimCluster(n_pods=8, stub_cfg=stub, seed=0)
+    stats = cluster.run("tpu", wl, duration_s=12.0, scheduler=sched,
+                        trainer=trainer)
+    assert trainer.last_loss is not None and trainer.last_loss < 1.0
+    assert stats.goodput_tokens_per_s > base.goodput_tokens_per_s * 1.2

@@ -1,7 +1,9 @@
 """Guard against the axon 80x-dispatch landmine: a jitted program that
-closes over a MODULE-LEVEL jnp array dispatches ~80x slower on this TPU
-backend and degrades the whole process (see pickers.NEG history). This
-static scan fails if anyone reintroduces one."""
+closes over a MODULE-IMPORT-TIME jnp array dispatches ~80x slower on this
+TPU backend and degrades the whole process (see pickers.NEG history). This
+static scan fails on any device-array creation that executes at import
+time: module-level assignments, class-body assignments, and function
+default arguments — under ANY alias of jax.numpy."""
 
 import ast
 import pathlib
@@ -9,37 +11,69 @@ import pathlib
 PKG = pathlib.Path(__file__).resolve().parent.parent / "gie_tpu"
 
 
-def _module_level_jnp_calls(tree: ast.Module) -> list[str]:
-    hits = []
-    for node in tree.body:
-        targets = []
-        if isinstance(node, ast.Assign):
-            targets, value = node.targets, node.value
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets, value = [node.target], node.value
-        else:
+def _jnp_aliases(tree: ast.Module) -> set[str]:
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy":
+                    aliases.add(a.asname or "jax.numpy")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and any(a.name == "numpy" for a in node.names):
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def _calls_jnp(value: ast.AST, aliases: set[str]) -> bool:
+    for call in ast.walk(value):
+        if not isinstance(call, ast.Call):
             continue
-        for call in ast.walk(value):
-            if not isinstance(call, ast.Call):
-                continue
-            func = call.func
-            # jnp.<anything>(...) at module level creates a device array.
-            if (isinstance(func, ast.Attribute)
-                    and isinstance(func.value, ast.Name)
-                    and func.value.id == "jnp"):
-                names = [ast.unparse(t) for t in targets]
-                hits.append(f"{', '.join(names)} = jnp.{func.attr}(...)")
-    return hits
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id in aliases:
+                return True
+            # dotted alias like jax.numpy.zeros
+            if (isinstance(base, ast.Attribute)
+                    and ast.unparse(base) in aliases):
+                return True
+    return False
 
 
-def test_no_module_level_jnp_constants():
+def _import_time_values(tree: ast.Module):
+    """Yield (description, value-node) pairs evaluated at import time."""
+    def from_body(body, where):
+        for node in body:
+            if isinstance(node, ast.Assign):
+                names = ", ".join(ast.unparse(t) for t in node.targets)
+                yield f"{where}{names}", node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                yield f"{where}{ast.unparse(node.target)}", node.value
+            elif isinstance(node, ast.ClassDef):
+                yield from from_body(node.body, f"{where}{node.name}.")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None
+                ]:
+                    yield f"{where}{node.name}(default)", d
+
+    yield from from_body(tree.body, "")
+
+
+def test_no_import_time_jnp_constants():
     offenders = []
     for path in PKG.rglob("*.py"):
         tree = ast.parse(path.read_text())
-        for hit in _module_level_jnp_calls(tree):
-            offenders.append(f"{path.relative_to(PKG.parent)}: {hit}")
+        aliases = _jnp_aliases(tree)
+        if not aliases:
+            continue
+        for desc, value in _import_time_values(tree):
+            if _calls_jnp(value, aliases):
+                offenders.append(f"{path.relative_to(PKG.parent)}: {desc}")
     assert not offenders, (
-        "module-level jnp constants captured into jit dispatch ~80x slower "
-        "on the axon backend — use Python/numpy scalars instead:\n"
+        "import-time jnp device arrays captured into jit dispatch ~80x "
+        "slower on the axon backend — use Python/numpy scalars instead:\n"
         + "\n".join(offenders)
     )
