@@ -1,0 +1,56 @@
+"""Typed API clients (the clientset analogue, reference C3 client-go/).
+
+The reference generates a full clientset/informers/listers tree; the
+Python-native equivalent is a thin typed facade over any object store that
+speaks the ClusterClient/FakeCluster surface: get/list/apply/delete plus
+status updates, returning the typed objects of gie_tpu.api.types.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from gie_tpu.api import types as api
+
+
+class InferencePoolClient:
+    """Typed access to InferencePool objects (clientset.InferencePools())."""
+
+    def __init__(self, store):
+        # `store` is any FakeCluster-shaped object store (apply_pool /
+        # get_pool / delete_pool); the kube adapter satisfies reads and
+        # forwards writes through the CustomObjects API in deployments.
+        self._store = store
+
+    def get(self, name: str, namespace: str = "default") -> Optional[api.InferencePool]:
+        return self._store.get_pool(namespace, name)
+
+    def apply(self, pool: api.InferencePool) -> api.InferencePool:
+        pool.validate()
+        self._store.apply_pool(pool)
+        return pool
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        self._store.delete_pool(namespace, name)
+
+    def update_status(
+        self, pool: api.InferencePool, status: api.InferencePoolStatus
+    ) -> api.InferencePool:
+        """Status-subresource style update: validates the 32-parent bound
+        before committing (CRD status schema)."""
+        status.validate()
+        pool.status = status
+        self._store.apply_pool(pool)
+        return pool
+
+    def to_yaml(self, pool: api.InferencePool) -> str:
+        import yaml
+
+        return yaml.safe_dump(api.pool_to_dict(pool), sort_keys=False)
+
+    def from_yaml(self, text: str) -> api.InferencePool:
+        import yaml
+
+        pool = api.pool_from_dict(yaml.safe_load(text))
+        pool.validate()
+        return pool

@@ -117,3 +117,41 @@ def test_crd_generation(tmp_path):
     assert "port number must be unique" in str(tp["x-kubernetes-validations"])
     epp = spec["properties"]["endpointPickerRef"]
     assert "has(self.port)" in str(epp["x-kubernetes-validations"])
+
+
+def test_typed_client_crud_and_yaml_roundtrip():
+    """Typed clientset facade (C3 analogue): CRUD + manifest round trips
+    against a FakeCluster store."""
+    from gie_tpu.api.client import InferencePoolClient
+    from gie_tpu.controller import FakeCluster
+
+    store = FakeCluster()
+    client = InferencePoolClient(store)
+    pool = make_pool()
+    client.apply(pool)
+    got = client.get("pool")
+    assert got is pool
+
+    text = client.to_yaml(got)
+    back = client.from_yaml(text)
+    assert back.spec.targetPorts[0].number == 8000
+    assert back.spec.endpointPickerRef.name == "epp"
+
+    status = api.InferencePoolStatus()
+    ps = api.ParentStatus(parentRef=api.ParentReference(name="gw"))
+    ps.set_condition(api.Condition(api.COND_ACCEPTED, "True", api.REASON_ACCEPTED))
+    status.parents.append(ps)
+    events = []
+    store.subscribe(events.append)
+    client.update_status(got, status)
+    # The status write must COMMIT to the store (watch event observed),
+    # not just mutate the local object.
+    assert any(e.type == "MODIFIED" and e.name == "pool" for e in events)
+    assert client.get("pool").status.parents[0].parentRef.name == "gw"
+
+    client.delete("pool")
+    assert client.get("pool") is None
+
+    bad = make_pool(targetPorts=[api.Port(1), api.Port(1)])
+    with pytest.raises(api.ValidationError):
+        client.apply(bad)
