@@ -17,30 +17,46 @@ class InferencePoolClient:
     """Typed access to InferencePool objects (clientset.InferencePools())."""
 
     def __init__(self, store):
-        # `store` is any FakeCluster-shaped object store (apply_pool /
-        # get_pool / delete_pool); the kube adapter satisfies reads and
-        # forwards writes through the CustomObjects API in deployments.
+        # `store` is any object store with get_pool (reads) and, for write
+        # support, apply_pool/delete_pool (FakeCluster has all three; the
+        # kube adapter is read-only today, so writes raise a clear
+        # NotImplementedError instead of an AttributeError).
         self._store = store
+
+    def _write(self, method: str, *args) -> None:
+        fn = getattr(self._store, method, None)
+        if fn is None:
+            raise NotImplementedError(
+                f"store {type(self._store).__name__} is read-only "
+                f"(no {method}); apply changes through kubectl / the "
+                "CustomObjects API in real clusters"
+            )
+        fn(*args)
 
     def get(self, name: str, namespace: str = "default") -> Optional[api.InferencePool]:
         return self._store.get_pool(namespace, name)
 
     def apply(self, pool: api.InferencePool) -> api.InferencePool:
         pool.validate()
-        self._store.apply_pool(pool)
+        self._write("apply_pool", pool)
         return pool
 
     def delete(self, name: str, namespace: str = "default") -> None:
-        self._store.delete_pool(namespace, name)
+        self._write("delete_pool", namespace, name)
 
     def update_status(
         self, pool: api.InferencePool, status: api.InferencePoolStatus
     ) -> api.InferencePool:
         """Status-subresource style update: validates the 32-parent bound
-        before committing (CRD status schema)."""
+        and commits BEFORE mutating the caller's object, so a store-side
+        rejection never leaves the local object diverged from the store."""
         status.validate()
+        import copy
+
+        committed = copy.deepcopy(pool)
+        committed.status = status
+        self._write("apply_pool", committed)
         pool.status = status
-        self._store.apply_pool(pool)
         return pool
 
     def to_yaml(self, pool: api.InferencePool) -> str:

@@ -1,5 +1,15 @@
 """Low-level TPU kernels (pallas)."""
 
-from gie_tpu.ops.fused_topk import fused_blend_topk
 
-__all__ = ["fused_blend_topk"]
+def interpret_default() -> bool:
+    """One policy for all pallas ops: compile only on real TPU backends,
+    interpret elsewhere (CPU tests; the axon tunnel's pallas remote compile
+    hangs — see fused_topk.py)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+from gie_tpu.ops.fused_topk import fused_blend_topk  # noqa: E402
+
+__all__ = ["fused_blend_topk", "interpret_default"]

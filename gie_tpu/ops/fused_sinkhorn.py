@@ -1,0 +1,61 @@
+"""Fused Sinkhorn-iterations pallas kernel.
+
+The OT picker alternates row normalization and column capping over the
+[N, M] transport plan `iters` times (gie_tpu/sched/sinkhorn.py). Under XLA
+each iteration's plan round-trips HBM; this kernel keeps the whole plan in
+VMEM (2 MB at the north-star 1024x512 f32 — well under the ~16 MB budget)
+and runs the full loop on-chip, writing HBM once.
+
+Single-program kernel (no grid): the column cap couples every row, so the
+plan cannot tile over N without cross-tile reductions; holding it resident
+is both simplest and fastest at these shapes.
+
+Parity with the lax.scan reference is tested in interpret mode; behind
+ProfileConfig(use_pallas_sinkhorn=True) (default off — pallas compilation
+hangs on this container's axon tunnel, see fused_topk.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(k_ref, cap_ref, out_ref, *, iters: int):
+    cap = cap_ref[0, :]                                   # [M]
+
+    def body(_, p):
+        row = jnp.sum(p, axis=1, keepdims=True)
+        p = jnp.where(row > 0, p / row, p)
+        col = jnp.sum(p, axis=0)
+        scale = jnp.where(col > cap, cap / jnp.maximum(col, 1e-9), 1.0)
+        return p * scale[None, :]
+
+    plan = jax.lax.fori_loop(0, iters, body, k_ref[:])
+    row = jnp.sum(plan, axis=1, keepdims=True)
+    out_ref[:] = jnp.where(row > 0, plan / row, plan)
+
+
+@functools.partial(jax.jit, static_argnames=("iters", "interpret"))
+def fused_sinkhorn_plan(
+    kernel_matrix: jax.Array,  # f32[N, M] masked Gibbs weights (0 = masked)
+    cap: jax.Array,            # f32[M] per-endpoint wave capacity
+    *,
+    iters: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """-> row-normalized transport plan f32[N, M]."""
+    n, m = kernel_matrix.shape
+    return pl.pallas_call(
+        functools.partial(_kernel, iters=iters),
+        in_specs=[
+            pl.BlockSpec((n, m), lambda: (0, 0)),
+            pl.BlockSpec((1, m), lambda: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n, m), lambda: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, m), jnp.float32),
+        interpret=interpret,
+    )(kernel_matrix, cap.reshape(1, m))

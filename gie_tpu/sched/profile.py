@@ -74,6 +74,9 @@ class ProfileConfig:
     # quantized tie-break). Off by default; enable where profiling shows
     # the kernel wins on the target backend.
     use_pallas_topk: bool = False
+    # VMEM-resident pallas loop for the sinkhorn iterations (same default-
+    # off rationale).
+    use_pallas_sinkhorn: bool = False
 
 
 def request_cost(reqs: RequestBatch) -> jax.Array:
@@ -181,11 +184,11 @@ def scheduling_cycle(
 
     # ---- Pick stage ------------------------------------------------------
     if cfg.picker == "topk" and cfg.use_pallas_topk:
+        from gie_tpu.ops import interpret_default
         from gie_tpu.ops.fused_topk import fused_blend_topk
 
-        interp = jax.default_backend() not in ("tpu",)
         vals, idxs = fused_blend_topk(
-            stacked, wvec, mask, k=C.FALLBACKS, interpret=interp
+            stacked, wvec, mask, k=C.FALLBACKS, interpret=interpret_default()
         )
         result = pickers.finalize_from_topk(vals, idxs, mask, shed, reqs.valid)
     elif cfg.picker == "random":
@@ -202,6 +205,7 @@ def scheduling_cycle(
             tau=cfg.sinkhorn_tau,
             iters=cfg.sinkhorn_iters,
             rounding_temp=cfg.sinkhorn_rounding_temp,
+            use_pallas=cfg.use_pallas_sinkhorn,
         )
     else:
         result = pickers.topk_picker(total, mask, shed, reqs.valid, state.rr)

@@ -60,6 +60,7 @@ def sinkhorn_picker(
     tau: float,
     iters: int,
     rounding_temp: float,
+    use_pallas: bool = False,
 ) -> PickResult:
     # Effective transport mass: valid rows that still have candidates
     # (padded rows and empty-subset rows contribute nothing).
@@ -73,20 +74,28 @@ def sinkhorn_picker(
     row_max = jnp.where(jnp.isfinite(row_max), row_max, 0.0)
     k = jnp.where(mask, jnp.exp((scores - row_max) / tau), 0.0)
 
-    def body(p, _):
-        # Row normalize: each valid request distributes mass 1.
-        row = jnp.sum(p, axis=1, keepdims=True)
-        p = jnp.where(row > 0, p / row, p)
-        # Column cap: scale down overloaded endpoints.
-        col = jnp.sum(p, axis=0)
-        scale = jnp.where(col > cap, cap / jnp.maximum(col, 1e-9), 1.0)
-        return p * scale[None, :], None
+    if use_pallas:
+        # VMEM-resident iteration loop (one HBM write for the whole solve).
+        from gie_tpu.ops import interpret_default
+        from gie_tpu.ops.fused_sinkhorn import fused_sinkhorn_plan
 
-    plan, _ = jax.lax.scan(body, k, None, length=iters)
-    # Final row normalization so the plan is a proper per-request
-    # distribution even where capacity clipped it.
-    row = jnp.sum(plan, axis=1, keepdims=True)
-    plan = jnp.where(row > 0, plan / row, plan)
+        plan = fused_sinkhorn_plan(
+            k, cap, iters=iters, interpret=interpret_default())
+    else:
+        def body(p, _):
+            # Row normalize: each valid request distributes mass 1.
+            row = jnp.sum(p, axis=1, keepdims=True)
+            p = jnp.where(row > 0, p / row, p)
+            # Column cap: scale down overloaded endpoints.
+            col = jnp.sum(p, axis=0)
+            scale = jnp.where(col > cap, cap / jnp.maximum(col, 1e-9), 1.0)
+            return p * scale[None, :], None
+
+        plan, _ = jax.lax.scan(body, k, None, length=iters)
+        # Final row normalization so the plan is a proper per-request
+        # distribution even where capacity clipped it.
+        row = jnp.sum(plan, axis=1, keepdims=True)
+        plan = jnp.where(row > 0, plan / row, plan)
 
     # Rounding: argmax of identical fractional rows would herd the whole
     # wave onto one endpoint again, so Gumbel noise (scaled by
