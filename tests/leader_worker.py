@@ -1,0 +1,14 @@
+"""Worker for the two-process leader-election test (not collected)."""
+import sys
+import time
+
+from gie_tpu.runtime.leader import LeaseFileElector
+
+lease, seconds = sys.argv[1], float(sys.argv[2])
+e = LeaseFileElector(lease, lease_ttl_s=1.0, renew_interval_s=0.1)
+e.start()
+deadline = time.time() + seconds
+while time.time() < deadline:
+    print(f"LEADER={int(e.is_leader())} t={time.time():.2f}", flush=True)
+    time.sleep(0.2)
+e.stop()

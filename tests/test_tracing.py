@@ -1,0 +1,46 @@
+"""Tracing spans: histogram observation + TRACE-level logs."""
+
+import io
+import json
+
+from gie_tpu.runtime.logging import Logger, set_verbosity
+from gie_tpu.runtime.metrics import REGISTRY
+from gie_tpu.runtime.tracing import SPANS, span
+
+
+def _count(name: str) -> float:
+    for metric in REGISTRY.collect():
+        for sample in metric.samples:
+            if (sample.name == "gie_span_seconds_count"
+                    and sample.labels.get("span") == name):
+                return sample.value
+    return 0.0
+
+
+def test_span_records_histogram_and_survives_exceptions():
+    before = _count("unit.test")
+    with span("unit.test", attr="x"):
+        pass
+    try:
+        with span("unit.test"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert _count("unit.test") == before + 2  # recorded even on raise
+
+
+def test_span_trace_log_emission(monkeypatch):
+    import gie_tpu.runtime.tracing as tracing
+
+    buf = io.StringIO()
+    monkeypatch.setattr(tracing, "_log", Logger("trace", stream=buf))
+    set_verbosity(5)
+    try:
+        with span("logged.section", candidates=3):
+            pass
+    finally:
+        set_verbosity(2)
+    line = json.loads(buf.getvalue().splitlines()[-1])
+    assert line["name"] == "logged.section"
+    assert line["candidates"] == 3
+    assert line["seconds"] >= 0
