@@ -55,6 +55,10 @@ class Response:
     body: bytes = b""
     backend_pod: str = ""       # which echo pod served
     protocol: str = "http"      # appProtocol used for the backend hop
+    # What the backend actually received after EPP mutations (gRPC
+    # transcoding etc.): body bytes + forwarded content-type.
+    backend_received: bytes = b""
+    backend_content_type: str = ""
 
 
 class _FakeStream:
@@ -395,12 +399,28 @@ class ConformanceEnv:
         if chosen is None:
             return Response(503, {}, b"no live destination")
 
+        # Apply EPP body mutations (BBR rewrites, gRPC transcoding): the
+        # data plane forwards the CONTINUE_AND_REPLACE chunks, not the
+        # original body (proposal 2162 request path).
+        forwarded_body = body
+        mutated = [
+            sent.request_body.response.body_mutation.body
+            for sent in stream.sent
+            if sent.WhichOneof("response") == "request_body"
+            and sent.request_body.response.status
+            == pb.CommonResponse.CONTINUE_AND_REPLACE
+        ]
+        if mutated:
+            forwarded_body = b"".join(mutated)
+
         # Forward to the echo backend, honoring X-Echo-Set-Header.
         echo_extra = {}
         if "X-Echo-Set-Header" in set_headers:
             k, _, v = set_headers["X-Echo-Set-Header"].partition(":")
             echo_extra[k.strip()] = v.strip()
-        resp = self._echo(pool, chosen, echo_extra, body)
+        resp = self._echo(pool, chosen, echo_extra, forwarded_body)
+        resp.backend_content_type = set_headers.get(
+            "content-type", headers.get("content-type", ""))
 
         # Response phase: report the served endpoint back to the EPP
         # (004 README:84-101) and apply its response-header mutation.
@@ -437,4 +457,5 @@ class ConformanceEnv:
             backend_pod=pod.name,
             protocol="h2c" if pool.spec.appProtocol == api.APP_PROTOCOL_H2C
             else "http",
+            backend_received=body,  # every path records what the pod got
         )

@@ -340,6 +340,39 @@ def test_destination_endpoint_served(env):
     assert served.startswith(pod.ip + ":")
 
 
+@record("GatewayGRPCModelServerTranscoding")
+def test_grpc_model_server_transcoding(env):
+    """gRPC-support conformance (proposal 2162): an h2c pool receives
+    gRPC-framed GenerateRequests transcoded from the client's OpenAI JSON,
+    with content-type/te rewritten; routing identity still holds."""
+    import json
+
+    import gie_tpu.extproc  # noqa: F401 — pb path hook
+    import generate_pb2
+
+    from gie_tpu.extproc import codec
+
+    env.apply_pool(make_pool("pool-grpc", {"app": "primary"},
+                             app_protocol=api.APP_PROTOCOL_H2C))
+    env.apply_route(simple_route("route-grpc", "primary-gateway", "pool-grpc"))
+    body = json.dumps({"model": "m", "prompt": "transcode me",
+                       "max_tokens": 5}).encode()
+    resp = env.send("primary-gateway", "x", "/", body=body, method="POST")
+    assert resp.status == 200
+    assert resp.backend_pod.startswith("primary-")
+    assert resp.backend_content_type == codec.GRPC_CONTENT_TYPE
+    (payload,) = list(codec.iter_frames(resp.backend_received))
+    req = generate_pb2.GenerateRequest.FromString(payload)
+    assert req.prompt == "transcode me" and req.max_tokens == 5
+    # Plain-http pools are untouched by transcoding.
+    env.apply_pool(make_pool("pool-plain", {"app": "secondary"}, ports=(8001,)))
+    env.apply_route(simple_route("route-plain", "primary-gateway",
+                                 "pool-plain", path="/plain"))
+    resp = env.send("primary-gateway", "x", "/plain", body=body, method="POST")
+    assert resp.status == 200
+    assert resp.backend_received == body
+
+
 @record("GatewayFollowingEPPRoutingTPUScheduler")
 def test_routing_conformance_with_tpu_scheduler():
     """The strictest routing test, run against the REAL batched TPU

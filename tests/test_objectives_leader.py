@@ -243,18 +243,19 @@ def test_two_process_leader_election(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    # Reconstruct the timeline: bucket samples by time, assert <=1 leader
-    # per bucket and >=1 leader overall in the steady state.
+    # Reconstruct the timeline at fine (0.1s) granularity: near-instant
+    # samples must never show two leaders (a legitimate handover separated
+    # by >= the sample period is fine), and a leader must emerge.
     samples = []
     for i, out in enumerate(outs):
         for line in out.splitlines():
-            flag, t = line.split()
-            samples.append((round(float(t.split("=")[1]), 0), i,
+            flag, ts = line.split()
+            samples.append((round(float(ts.split("=")[1]), 1), i,
                             int(flag.split("=")[1])))
-    by_bucket = {}
+    by_bucket: dict = {}
     for bucket, proc_i, flag in samples:
-        by_bucket.setdefault(bucket, {})[proc_i] = max(
-            by_bucket.setdefault(bucket, {}).get(proc_i, 0), flag)
+        d = by_bucket.setdefault(bucket, {})
+        d[proc_i] = max(d.get(proc_i, 0), flag)
     leaders_per_bucket = [sum(v.values()) for v in by_bucket.values()]
     assert max(leaders_per_bucket) <= 1, "two simultaneous leaders observed"
     assert any(leaders_per_bucket), "no leader ever elected"

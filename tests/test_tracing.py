@@ -5,7 +5,7 @@ import json
 
 from gie_tpu.runtime.logging import Logger, set_verbosity
 from gie_tpu.runtime.metrics import REGISTRY
-from gie_tpu.runtime.tracing import SPANS, span
+from gie_tpu.runtime.tracing import span
 
 
 def _count(name: str) -> float:
