@@ -54,12 +54,15 @@ class ExtProcServerRunner:
         if scheduler is not None:
             self.scheduler = scheduler
         else:
-            from gie_tpu.sched.profile import ProfileConfig
+            # Production default: the swept tuned profile; an explicit
+            # --scheduler-config replaces it wholesale.
+            from gie_tpu.sched.config import (
+                load_scheduler_config_file,
+                tuned_profile,
+            )
 
-            cfg, weights = ProfileConfig(), None
+            cfg, weights = tuned_profile()
             if opts.scheduler_config:
-                from gie_tpu.sched.config import load_scheduler_config_file
-
                 cfg, weights = load_scheduler_config_file(opts.scheduler_config)
             predictor_fn = predictor_params = None
             if opts.enable_predictor:
@@ -79,6 +82,16 @@ class ExtProcServerRunner:
                                       dir=opts.predictor_checkpoint_dir)
                 predictor_fn = predictor_score_fn(predictor)
                 predictor_params = self.trainer.params
+                if weights is not None and float(weights.latency) == 0.0:
+                    # The learned column must actually participate in the
+                    # blend; a zero weight would train it for nothing.
+                    import jax.numpy as jnp_
+
+                    weights = weights.replace(latency=jnp_.float32(1.0))
+                    self.log.info(
+                        "predictor enabled: latency weight raised to 1.0 "
+                        "(set weights.latency in --scheduler-config to tune)"
+                    )
             self.scheduler = Scheduler(
                 cfg,
                 weights=weights,

@@ -28,8 +28,8 @@ from gie_tpu.metricsio.scrape import parse_scrape
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.hashing import batch_chunk_hashes
 from gie_tpu.models.latency import host_features
-from gie_tpu.sched.profile import ProfileConfig, Scheduler, request_cost_host
-from gie_tpu.sched.types import RequestBatch, Weights
+from gie_tpu.sched.profile import Scheduler, request_cost_host
+from gie_tpu.sched.types import RequestBatch
 from gie_tpu.simulator.vllm_stub import StubConfig, VLLMStub
 from gie_tpu.utils.lora import LoraRegistry
 
@@ -48,26 +48,13 @@ class WorkloadConfig:
 
 
 def tuned_scheduler() -> Scheduler:
-    """Scheduler profile tuned on the cache-constrained prefix benchmark
-    (simulation sweeps, round 1): the Sinkhorn OT picker's capacity
-    constraint prevents prefix-affinity herding, which lets the prefix
-    weight run much higher than the argmax picker tolerates (prefix=4 vs 1)
-    — goodput 2328 vs topk-tuned 1590 tok/s, hit rate 0.72 vs 0.37,
-    robust across workload seeds (ratios 1.8-2.2x vs least-kv)."""
-    import jax.numpy as _jnp
+    """Scheduler built from sched.config.tuned_profile() — the round-1
+    swept Sinkhorn profile (goodput 2.15x vs least-kv; see
+    docs/BENCH_NOTES.md for the sweep history)."""
+    from gie_tpu.sched.config import tuned_profile
 
-    return Scheduler(
-        ProfileConfig(load_decay=0.95, load_norm=8.0, queue_norm=16.0,
-                      picker="sinkhorn"),
-        weights=Weights(
-            queue=_jnp.float32(2.0),
-            kv_cache=_jnp.float32(1.0),
-            prefix=_jnp.float32(4.0),
-            lora=_jnp.float32(1.0),
-            assumed_load=_jnp.float32(1.5),
-            latency=_jnp.float32(0.0),
-        ),
-    )
+    cfg, weights = tuned_profile()
+    return Scheduler(cfg, weights=weights)
 
 
 @dataclasses.dataclass

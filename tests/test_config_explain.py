@@ -76,3 +76,20 @@ def test_explain_matches_actual_pick():
     for i in range(3):
         best = int(np.argmax(np.where(out["mask"][i], out["total"][i], -1e9)))
         assert int(res.indices[i, 0]) == best
+
+
+def test_tuned_profile_matches_committed_yaml():
+    """tuned_profile() and config/scheduler/sinkhorn-tuned.yaml are two
+    statements of the production default — they must never drift."""
+    import dataclasses
+    import os
+
+    from gie_tpu.sched.config import load_scheduler_config_file, tuned_profile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg_yaml, w_yaml = load_scheduler_config_file(
+        os.path.join(repo, "config", "scheduler", "sinkhorn-tuned.yaml"))
+    cfg_code, w_code = tuned_profile()
+    assert cfg_yaml == cfg_code
+    for f in dataclasses.fields(w_yaml):
+        assert float(getattr(w_yaml, f.name)) == float(getattr(w_code, f.name)), f.name
