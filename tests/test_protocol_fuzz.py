@@ -1,0 +1,94 @@
+"""ext-proc protocol fuzz: arbitrary message sequences, malformed bodies,
+and odd orderings must produce clean protocol outcomes (responses,
+ExtProcError) — never an unhandled exception or a hang."""
+
+import random
+
+import pytest
+from google.protobuf import struct_pb2
+
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool
+from gie_tpu.extproc import RoundRobinPicker, StreamingServer, metadata as mdkeys, pb
+from gie_tpu.extproc.server import ExtProcError
+from tests.test_datastore import make_pod
+from tests.test_extproc import FakeStream
+
+
+def make_server(h2c: bool = False) -> StreamingServer:
+    ds = Datastore()
+    ds.pool_set(EndpointPool(
+        {"app": "x"}, [8000], "default",
+        app_protocol="kubernetes.io/h2c" if h2c else "http"))
+    for i in range(3):
+        ds.pod_update_or_add(make_pod(name=f"p{i}", ip=f"10.0.0.{i}"))
+    return StreamingServer(ds, RoundRobinPicker())
+
+
+def random_message(rng: random.Random) -> pb.ProcessingRequest:
+    choice = rng.random()
+    if choice < 0.3:
+        hm = pb.HeaderMap()
+        for _ in range(rng.randint(0, 4)):
+            key = rng.choice([
+                "content-type", mdkeys.TEST_ENDPOINT_SELECTION_HEADER,
+                mdkeys.OBJECTIVE_KEY, mdkeys.MODEL_NAME_REWRITE_KEY,
+                "x-random", "",
+            ])
+            value = rng.choice([
+                b"", b"10.0.0.1", b"\xff\xfe garbage", b"critical",
+                bytes(rng.randbytes(rng.randint(0, 40))),
+            ])
+            hm.headers.append(pb.HeaderValue(key=key, raw_value=value))
+        return pb.ProcessingRequest(request_headers=pb.HttpHeaders(
+            headers=hm, end_of_stream=rng.random() < 0.5))
+    if choice < 0.6:
+        body = rng.choice([
+            b"", b"{not json", b'{"model": 3}', b"\x00" * rng.randint(0, 100),
+            b'{"model": "m", "prompt": "x", "stream": true}',
+            bytes(rng.randbytes(rng.randint(0, 200))),
+        ])
+        return pb.ProcessingRequest(request_body=pb.HttpBody(
+            body=body, end_of_stream=rng.random() < 0.5))
+    if choice < 0.8:
+        req = pb.ProcessingRequest(response_headers=pb.HttpHeaders())
+        if rng.random() < 0.5:
+            st = struct_pb2.Struct()
+            st.fields[mdkeys.DESTINATION_ENDPOINT_SERVED_KEY].string_value = (
+                rng.choice(["10.0.0.1:8000", "bogus", ""]))
+            req.metadata_context.filter_metadata[
+                rng.choice([mdkeys.DESTINATION_ENDPOINT_NAMESPACE, "other"])
+            ].CopyFrom(st)
+        return req
+    return pb.ProcessingRequest(response_body=pb.HttpBody(
+        body=bytes(rng.randbytes(rng.randint(0, 64))),
+        end_of_stream=rng.random() < 0.5))
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("h2c", [False, True])
+def test_random_message_sequences_never_crash(seed, h2c):
+    rng = random.Random(seed * 2 + int(h2c))
+    srv = make_server(h2c=h2c)
+    for _ in range(40):
+        msgs = [random_message(rng) for _ in range(rng.randint(1, 6))]
+        stream = FakeStream(msgs)
+        try:
+            srv.process(stream)
+        except ExtProcError:
+            pass  # clean protocol errors are legitimate outcomes
+        # Every emitted response must be a well-formed ProcessingResponse.
+        for resp in stream.sent:
+            assert resp.WhichOneof("response") is not None
+
+
+def test_duplicate_headers_messages_tolerated():
+    """A misbehaving data plane sending two header phases must not corrupt
+    the stream (second parse overwrites candidates; no crash)."""
+    srv = make_server()
+    hm = pb.HeaderMap()
+    msg = pb.ProcessingRequest(
+        request_headers=pb.HttpHeaders(headers=hm, end_of_stream=True))
+    stream = FakeStream([msg, msg])
+    srv.process(stream)
+    assert len(stream.sent) == 2
