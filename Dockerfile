@@ -1,0 +1,36 @@
+# gie-tpu EPP container image (reference lwepp.Dockerfile parity: the
+# reference builds a distroless static Go binary; the TPU-native EPP is a
+# Python/JAX process plus a small native library, so the image is a slim
+# Python base with the native chunker built in a throwaway stage).
+#
+# Build:  docker build -t gie-tpu-epp .
+# Run  :  docker run -p 9002:9002 -p 9003:9003 -p 9090:9090 gie-tpu-epp \
+#             --pool-name my-pool --kube
+#
+# NOTE: requirements below name the runtime deps this tree was built
+# against (jax/flax/optax/orbax/grpcio/protobuf/prometheus-client/pyyaml/
+# cryptography + `kubernetes` for --kube). Pin versions to your fleet's
+# JAX/TPU release; TPU images should derive from your libtpu base instead
+# of python:slim.
+
+FROM python:3.12-slim AS native-build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native/ native/
+# Force a rebuild: the repo tracks a host-built .so whose mtime would
+# otherwise make `make` no-op and ship a foreign-ABI binary.
+RUN make -C native clean all
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir \
+        "jax[tpu]" flax optax orbax-checkpoint \
+        grpcio protobuf prometheus-client pyyaml cryptography kubernetes
+WORKDIR /app
+COPY gie_tpu/ gie_tpu/
+COPY config/ config/
+COPY --from=native-build /src/native/libgiechunker.so native/libgiechunker.so
+
+# Ports: ext-proc gRPC / dedicated health / prometheus metrics.
+EXPOSE 9002 9003 9090
+ENTRYPOINT ["python", "-m", "gie_tpu.runtime.main"]

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Release packaging (reference hack/release-*.sh parity): stamp the bundle
+# version, regenerate CRDs, run the suite + conformance, and emit a
+# versioned artifact directory with manifests + conformance report.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+if [[ -n "$(git status --porcelain)" ]]; then
+    echo "ERROR: working tree is dirty; commit or stash before releasing" >&2
+    exit 1
+fi
+VERSION=$(python -c "from gie_tpu.version import BUNDLE_VERSION; print(BUNDLE_VERSION)")
+OUT="dist/${VERSION}"
+rm -rf "${OUT}"
+
+echo "==> release ${VERSION}"
+make native generate
+python -m pytest tests/ -q
+python -m conformance.run --report "conformance-report-${VERSION}.yaml"
+
+mkdir -p "${OUT}"
+cp -r config/crd/bases "${OUT}/crds"
+cp config/scheduler/sinkhorn-tuned.yaml "${OUT}/"
+mv "conformance-report-${VERSION}.yaml" "${OUT}/"
+git rev-parse HEAD > "${OUT}/COMMIT"
+echo "==> artifacts in ${OUT}"
+ls -l "${OUT}"
