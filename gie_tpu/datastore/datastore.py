@@ -71,6 +71,9 @@ class Datastore:
         self._lock = threading.RLock()
         self._pool: Optional[EndpointPool] = None
         self._endpoints: dict[str, Endpoint] = {}  # key: "<ns>/<pod>-rank-<i>"
+        # hostport index for the served-feedback hot path (one lookup per
+        # response instead of an O(n) scan).
+        self._by_hostport: dict[str, Endpoint] = {}
         self._free_slots: list[int] = list(range(max_slots))
         heapq.heapify(self._free_slots)
         self._on_slot_reclaimed = on_slot_reclaimed
@@ -129,7 +132,7 @@ class Datastore:
                 if port in active:
                     if existing is None:
                         slot = self._alloc_slot()
-                        self._endpoints[key] = Endpoint(
+                        ep = Endpoint(
                             name=f"{pod.name}-rank-{idx}",
                             namespace=pod.namespace,
                             pod_name=pod.name,
@@ -139,13 +142,17 @@ class Datastore:
                             slot=slot,
                             labels=dict(pod.labels),
                         )
+                        self._endpoints[key] = ep
+                        self._by_hostport[ep.hostport] = ep
                     else:
                         # Refresh mutable fields in place; slot is sticky.
                         # Port too: a targetPorts change re-binds the same
                         # rank index to a new port number.
+                        self._by_hostport.pop(existing.hostport, None)
                         existing.address = pod.ip
                         existing.port = port
                         existing.labels = dict(pod.labels)
+                        self._by_hostport[existing.hostport] = existing
                 else:
                     if existing is not None:
                         self._remove_endpoint(key)
@@ -179,10 +186,7 @@ class Datastore:
 
     def endpoint_by_hostport(self, hostport: str) -> Optional[Endpoint]:
         with self._lock:
-            for e in self._endpoints.values():
-                if e.hostport == hostport:
-                    return e
-            return None
+            return self._by_hostport.get(hostport)
 
     def slot_map(self) -> dict[str, int]:
         """hostport -> slot for subset-mask construction."""
@@ -204,6 +208,8 @@ class Datastore:
 
     def _remove_endpoint(self, key: str) -> None:
         ep = self._endpoints.pop(key)
+        if self._by_hostport.get(ep.hostport) is ep:
+            del self._by_hostport[ep.hostport]
         heapq.heappush(self._free_slots, ep.slot)
         if self._on_slot_reclaimed is not None:
             self._on_slot_reclaimed(ep.slot)

@@ -254,3 +254,26 @@ def test_target_port_renumber_updates_existing_endpoints():
     eps = ds.endpoints()
     assert [e.port for e in eps] == [9000]
     assert eps[0].slot == old_slot  # rank identity (and slot) preserved
+
+
+def test_hostport_index_tracks_lifecycle():
+    """endpoint_by_hostport must stay consistent through add/refresh/
+    renumber/delete (it indexes the served-feedback hot path)."""
+    ds = Datastore()
+    ds.pool_set(POOL)
+    ds.pod_update_or_add(make_pod())
+    assert ds.endpoint_by_hostport("10.0.0.1:8000").pod_name == "p1"
+    # IP change re-keys the index.
+    ds.pod_update_or_add(make_pod(ip="10.0.0.9"))
+    assert ds.endpoint_by_hostport("10.0.0.1:8000") is None
+    assert ds.endpoint_by_hostport("10.0.0.9:8000").pod_name == "p1"
+    # Port renumber re-keys it too.
+    ds.pool_set(
+        EndpointPool(selector={"app": "vllm"}, target_ports=[9000, 8002],
+                     namespace="default"),
+        pod_lister=lambda: [make_pod(ip="10.0.0.9")],
+    )
+    assert ds.endpoint_by_hostport("10.0.0.9:8000") is None
+    assert ds.endpoint_by_hostport("10.0.0.9:9000") is not None
+    ds.pod_delete("default", "p1")
+    assert ds.endpoint_by_hostport("10.0.0.9:9000") is None
