@@ -147,8 +147,11 @@ class Datastore:
                     else:
                         # Refresh mutable fields in place; slot is sticky.
                         # Port too: a targetPorts change re-binds the same
-                        # rank index to a new port number.
-                        self._by_hostport.pop(existing.hostport, None)
+                        # rank index to a new port number. Only pop OUR
+                        # entry: on transient hostport collisions (k8s IP
+                        # reuse) another live endpoint may own the key.
+                        if self._by_hostport.get(existing.hostport) is existing:
+                            del self._by_hostport[existing.hostport]
                         existing.address = pod.ip
                         existing.port = port
                         existing.labels = dict(pod.labels)

@@ -277,3 +277,23 @@ def test_hostport_index_tracks_lifecycle():
     assert ds.endpoint_by_hostport("10.0.0.9:9000") is not None
     ds.pod_delete("default", "p1")
     assert ds.endpoint_by_hostport("10.0.0.9:9000") is None
+
+
+def test_hostport_collision_does_not_unindex_other_endpoint():
+    """k8s IP reuse: pod B takes A's old IP while A's stale endpoint still
+    exists; refreshing A must not evict B's index entry."""
+    ds = Datastore()
+    ds.pool_set(
+        EndpointPool(selector={"app": "vllm"}, target_ports=[8000],
+                     namespace="default")
+    )
+    ds.pod_update_or_add(make_pod(name="a", ip="10.0.0.5"))
+    # B is created with A's hostport (A not yet updated/deleted).
+    ds.pod_update_or_add(make_pod(name="b", ip="10.0.0.5"))
+    # A refreshes away to a new IP — B must stay indexed at the shared key.
+    ds.pod_update_or_add(make_pod(name="a", ip="10.0.0.6"))
+    assert ds.endpoint_by_hostport("10.0.0.5:8000").pod_name == "b"
+    assert ds.endpoint_by_hostport("10.0.0.6:8000").pod_name == "a"
+    # Deleting A later must not remove B's entry either.
+    ds.pod_delete("default", "a")
+    assert ds.endpoint_by_hostport("10.0.0.5:8000").pod_name == "b"
