@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native generate test test-unit test-conformance bench bench-goodput clean
+.PHONY: all native generate test test-unit test-conformance bench bench-goodput release clean
 
 all: native generate
 
@@ -33,6 +33,10 @@ bench:
 bench-goodput:
 	$(PY) bench_goodput.py
 
+# Versioned release artifacts (CRDs, tuned profile, conformance report).
+release:
+	bash hack/release.sh
+
 clean:
 	$(MAKE) -C native clean
-	rm -f conformance-report.yaml
+	rm -rf dist conformance-report*.yaml
