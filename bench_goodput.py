@@ -11,16 +11,40 @@ dense MetricsStore -> jitted scheduling cycle -> submit -> termination
 feedback. Prints one JSON line; detail to stderr.
 
 (The driver's official benchmark is bench.py; this script is the goodput
-evidence and runs anywhere — CPU is fine, the sim is host-dominated.)
+evidence. The sim is host-dominated, so it runs on the CPU platform by
+default — forced IN-PROCESS before gie_tpu is imported, because the axon
+TPU backend hangs forever at init when its relay is down, and environment
+variables alone do not override the sitecustomize-registered platform.
+Set GIE_GOODPUT_PLATFORM=tpu (or axon) to opt into chip runs.)
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 
+def _force_platform() -> None:
+    platform = os.environ.get("GIE_GOODPUT_PLATFORM", "cpu")
+    import jax
+
+    # config.update silently no-ops when a backend already initialized
+    # (e.g. invoked from a process that already did TPU work), so verify
+    # the platform actually took and say so when it did not.
+    jax.config.update("jax_platforms", platform)
+    active = jax.default_backend()
+    if active != platform:
+        print(
+            f"WARNING: requested platform '{platform}' but backend is "
+            f"'{active}' (JAX initialized before this script ran) — "
+            "timings reflect that backend",
+            file=sys.stderr,
+        )
+
+
 def main() -> None:
+    _force_platform()
     from gie_tpu.simulator import StubConfig
     from gie_tpu.simulator.cluster import SimCluster, WorkloadConfig, tuned_scheduler
 
