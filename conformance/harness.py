@@ -370,7 +370,7 @@ class ConformanceEnv:
 
         if stream.sent and stream.sent[0].WhichOneof("response") == "immediate_response":
             imm = stream.sent[0].immediate_response
-            return Response(imm.status_code, {}, imm.body)
+            return Response(imm.status.code, {}, imm.body)
 
         # Extract destination from the headers response; verify the dual
         # dynamic-metadata signal agrees (004 README:46-82).

@@ -5,16 +5,7 @@ docs/proposals/004-endpoint-picker-protocol/README.md, implemented by
 pkg/lwepp/handlers + pkg/common/envoy).
 """
 
-import os
-import sys
-
-# The protoc output uses flat imports; expose it as a package attribute.
-_PB_DIR = os.path.join(os.path.dirname(__file__), "pb")
-if _PB_DIR not in sys.path:
-    sys.path.insert(0, _PB_DIR)
-
-import extproc_pb2 as pb  # noqa: E402
-
+from gie_tpu.extproc import pb
 from gie_tpu.extproc import metadata  # noqa: E402
 from gie_tpu.extproc.server import (  # noqa: E402
     EndpointPicker,

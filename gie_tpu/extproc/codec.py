@@ -21,7 +21,7 @@ import json
 import struct
 from typing import Iterator, Optional
 
-import generate_pb2  # via the gie_tpu.extproc pb path hook
+from gie_tpu.extproc.pb import generate_pb2
 
 GRPC_CONTENT_TYPE = "application/grpc"
 

@@ -18,8 +18,22 @@ BODY_BYTE_LIMIT = 62_000
 
 
 def get_header_value(header: pb.HeaderValue) -> str:
-    """raw_value wins over (unused) string value (reference headers.go:27-33)."""
-    return header.raw_value.decode("utf-8", "replace")
+    """raw_value (bytes, field 3) wins over the string value (field 2);
+    Envoy populates exactly one (reference headers.go:27-33)."""
+    if header.raw_value:
+        return header.raw_value.decode("utf-8", "replace")
+    return header.value
+
+
+def make_immediate_response(
+    status_code: int, *, details: str = "", body: bytes = b""
+) -> pb.ImmediateResponse:
+    """ImmediateResponse with the wire-correct envoy.type.v3.HttpStatus
+    message (NOT a bare integer) — the 429-shed / 503 contract of the
+    endpoint-picker protocol (004 README:77-80)."""
+    return pb.ImmediateResponse(
+        status=pb.HttpStatus(code=status_code), details=details, body=body
+    )
 
 
 def extract_header_value(headers: pb.HttpHeaders, key: str) -> Optional[str]:

@@ -1,0 +1,21 @@
+#!/bin/sh
+# Regenerate the Envoy ext-proc protobuf modules into ../pb.
+# Post-processing: protoc emits absolute `from envoy...` imports; rewrite
+# them to this package's path so imports never depend on sys.path order
+# (gie_tpu/extproc/envoy.py would shadow the generated `envoy` package
+# when running from this directory).
+set -e
+cd "$(dirname "$0")/.."
+protoc -I proto --python_out=pb \
+  proto/envoy/config/core/v3/base.proto \
+  proto/envoy/type/v3/http_status.proto \
+  proto/envoy/service/ext_proc/v3/external_processor.proto
+sed -i 's/^from envoy\./from gie_tpu.extproc.pb.envoy./' \
+  pb/envoy/service/ext_proc/v3/external_processor_pb2.py
+for d in pb/envoy pb/envoy/config pb/envoy/config/core pb/envoy/config/core/v3 \
+         pb/envoy/type pb/envoy/type/v3 pb/envoy/service pb/envoy/service/ext_proc \
+         pb/envoy/service/ext_proc/v3; do
+  : > "$d/__init__.py"
+done
+# Flat single-file protos (health, generate) keep the original flow.
+protoc -I proto --python_out=pb proto/health.proto proto/generate.proto

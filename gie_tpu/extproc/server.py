@@ -176,8 +176,8 @@ class StreamingServer:
                     except ShedError:
                         stream.send(
                             pb.ProcessingResponse(
-                                immediate_response=pb.ImmediateResponse(
-                                    status_code=429, details="request shed"
+                                immediate_response=envoy.make_immediate_response(
+                                    429, details="request shed"
                                 )
                             )
                         )
@@ -200,8 +200,8 @@ class StreamingServer:
                     except ShedError:
                         stream.send(
                             pb.ProcessingResponse(
-                                immediate_response=pb.ImmediateResponse(
-                                    status_code=429, details="request shed"
+                                immediate_response=envoy.make_immediate_response(
+                                    429, details="request shed"
                                 )
                             )
                         )
@@ -241,7 +241,11 @@ class StreamingServer:
                             )
                         )
                     )
-            else:  # trailers etc. — ignored (reference server.go:283-285)
+            else:
+                # request_trailers / response_trailers parse (wire-correct
+                # fields 4/7) but are ignored, matching the reference
+                # (server.go:283-285). Envoy only sends them when the
+                # processing mode asks, which this EPP never does.
                 continue
 
     # ------------------------------------------------------------------ #

@@ -16,7 +16,7 @@ from typing import Callable
 import grpc
 
 import gie_tpu.extproc  # noqa: F401 — installs the pb path hook
-import health_pb2  # via gie_tpu.extproc pb path hook
+from gie_tpu.extproc.pb import health_pb2
 from gie_tpu.extproc.service import SERVICE_NAME as EXTPROC_SERVICE
 
 HEALTH_SERVICE = "grpc.health.v1.Health"

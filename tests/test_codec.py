@@ -6,7 +6,7 @@ import json
 import pytest
 
 import gie_tpu.extproc  # noqa: F401 — installs the pb path hook
-import generate_pb2
+from gie_tpu.extproc.pb import generate_pb2
 
 from gie_tpu.datastore import Datastore
 from gie_tpu.datastore.objects import EndpointPool

@@ -348,7 +348,7 @@ def test_grpc_model_server_transcoding(env):
     import json
 
     import gie_tpu.extproc  # noqa: F401 — pb path hook
-    import generate_pb2
+    from gie_tpu.extproc.pb import generate_pb2
 
     from gie_tpu.extproc import codec
 

@@ -116,7 +116,7 @@ def test_sheddable_429_immediate_response(stack):
     # ImmediateResponse 429 (004 README:80).
     kinds = [r.WhichOneof("response") for r in stream.sent]
     assert kinds == ["immediate_response"]
-    assert stream.sent[0].immediate_response.status_code == 429
+    assert stream.sent[0].immediate_response.status.code == 429
 
 
 def test_critical_served_even_saturated(stack):
@@ -172,7 +172,7 @@ def test_sheddable_429_headers_only_request(stack):
     stream = run_request(srv, headers={mdkeys.OBJECTIVE_KEY: "sheddable"})
     kinds = [r.WhichOneof("response") for r in stream.sent]
     assert kinds == ["immediate_response"]
-    assert stream.sent[0].immediate_response.status_code == 429
+    assert stream.sent[0].immediate_response.status.code == 429
 
 
 def test_flow_control_hold_until_capacity():

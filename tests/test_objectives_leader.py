@@ -106,7 +106,7 @@ def test_health_liveness_vs_readiness():
         READINESS_SERVICE,
         start_dedicated_health_server,
     )
-    import health_pb2
+    from gie_tpu.extproc.pb import health_pb2
 
     ready = {"v": False}
     server, port = start_dedicated_health_server(lambda: ready["v"], 0)

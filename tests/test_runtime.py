@@ -97,7 +97,7 @@ def test_cert_reloader_hot_swap(tmp_path):
 def test_health_gated_on_pool_sync():
     """reference runserver.go:132-157: NOT_SERVING until PoolHasSynced."""
     from gie_tpu.runtime.health import start_dedicated_health_server
-    import health_pb2  # available after the runtime.health import hook
+    from gie_tpu.extproc.pb import health_pb2
 
     ready = {"v": False}
     server, port = start_dedicated_health_server(lambda: ready["v"], 0)
