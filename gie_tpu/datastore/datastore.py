@@ -36,7 +36,9 @@ class PoolNotSyncedError(RuntimeError):
 
 # Called with the freed slot whenever an endpoint is removed, so the
 # scheduler can invalidate per-slot device state (prefix presence, assumed
-# load) before the slot is reused.
+# load) before the slot is reused. Invoked AFTER the datastore lock is
+# released: the callback may block (scraper thread joins, device dispatch)
+# and must not stall concurrent data-plane readers.
 SlotReclaimedFn = Callable[[int], None]
 
 
@@ -78,6 +80,8 @@ class Datastore:
         heapq.heapify(self._free_slots)
         self._on_slot_reclaimed = on_slot_reclaimed
         self._max_slots = max_slots
+        # Slots freed under the lock, awaiting callback delivery outside it.
+        self._pending_reclaims: list[int] = []
 
     # ---- pool ------------------------------------------------------------
 
@@ -98,6 +102,7 @@ class Datastore:
             )
             if (old is None or changed) and pod_lister is not None:
                 self._resync_all(pod_lister())
+        self._drain_reclaims()
 
     def pool_get(self) -> EndpointPool:
         with self._lock:
@@ -116,6 +121,7 @@ class Datastore:
             self._pool = None
             for key in list(self._endpoints):
                 self._remove_endpoint(key)
+        self._drain_reclaims()
 
     # ---- pods / endpoints ------------------------------------------------
 
@@ -124,50 +130,54 @@ class Datastore:
         endpoint per active rank (reference PodUpdateOrAddIfNotExist,
         datastore.go:195-255)."""
         with self._lock:
-            pool = self.pool_get()
-            active = set(_active_ports(pod, pool.target_ports))
-            for idx, port in enumerate(pool.target_ports):
-                key = self._key(pod.namespace, pod.name, idx)
-                existing = self._endpoints.get(key)
-                if port in active:
-                    if existing is None:
-                        slot = self._alloc_slot()
-                        ep = Endpoint(
-                            name=f"{pod.name}-rank-{idx}",
-                            namespace=pod.namespace,
-                            pod_name=pod.name,
-                            address=pod.ip,
-                            port=port,
-                            rank=idx,
-                            slot=slot,
-                            labels=dict(pod.labels),
-                        )
-                        self._endpoints[key] = ep
-                        self._by_hostport[ep.hostport] = ep
-                    else:
-                        # Refresh mutable fields in place; slot is sticky.
-                        # Port too: a targetPorts change re-binds the same
-                        # rank index to a new port number. Only pop OUR
-                        # entry: on transient hostport collisions (k8s IP
-                        # reuse) another live endpoint may own the key.
-                        if self._by_hostport.get(existing.hostport) is existing:
-                            del self._by_hostport[existing.hostport]
-                        existing.address = pod.ip
-                        existing.port = port
-                        existing.labels = dict(pod.labels)
-                        self._by_hostport[existing.hostport] = existing
+            self._pod_update_or_add_locked(pod)
+        self._drain_reclaims()
+
+    def _pod_update_or_add_locked(self, pod: Pod) -> None:
+        pool = self.pool_get()
+        active = set(_active_ports(pod, pool.target_ports))
+        for idx, port in enumerate(pool.target_ports):
+            key = self._key(pod.namespace, pod.name, idx)
+            existing = self._endpoints.get(key)
+            if port in active:
+                if existing is None:
+                    slot = self._alloc_slot()
+                    ep = Endpoint(
+                        name=f"{pod.name}-rank-{idx}",
+                        namespace=pod.namespace,
+                        pod_name=pod.name,
+                        address=pod.ip,
+                        port=port,
+                        rank=idx,
+                        slot=slot,
+                        labels=dict(pod.labels),
+                    )
+                    self._endpoints[key] = ep
+                    self._by_hostport[ep.hostport] = ep
                 else:
-                    if existing is not None:
-                        self._remove_endpoint(key)
-            # Drop stale ranks beyond the current targetPorts length
-            # (targetPorts shrink during resync, datastore.go:267-304).
-            rank = len(pool.target_ports)
-            while True:
-                key = self._key(pod.namespace, pod.name, rank)
-                if key not in self._endpoints:
-                    break
-                self._remove_endpoint(key)
-                rank += 1
+                    # Refresh mutable fields in place; slot is sticky.
+                    # Port too: a targetPorts change re-binds the same
+                    # rank index to a new port number. Only pop OUR
+                    # entry: on transient hostport collisions (k8s IP
+                    # reuse) another live endpoint may own the key.
+                    if self._by_hostport.get(existing.hostport) is existing:
+                        del self._by_hostport[existing.hostport]
+                    existing.address = pod.ip
+                    existing.port = port
+                    existing.labels = dict(pod.labels)
+                    self._by_hostport[existing.hostport] = existing
+            else:
+                if existing is not None:
+                    self._remove_endpoint(key)
+        # Drop stale ranks beyond the current targetPorts length
+        # (targetPorts shrink during resync, datastore.go:267-304).
+        rank = len(pool.target_ports)
+        while True:
+            key = self._key(pod.namespace, pod.name, rank)
+            if key not in self._endpoints:
+                break
+            self._remove_endpoint(key)
+            rank += 1
 
     def pod_delete(self, namespace: str, pod_name: str) -> None:
         """Drop all rank endpoints of a pod (reference PodDelete,
@@ -176,6 +186,7 @@ class Datastore:
             prefix = f"{namespace}/{pod_name}-rank-"
             for key in [k for k in self._endpoints if k.startswith(prefix)]:
                 self._remove_endpoint(key)
+        self._drain_reclaims()
 
     def endpoints(
         self, predicate: Optional[Callable[[Endpoint], bool]] = None
@@ -213,9 +224,36 @@ class Datastore:
         ep = self._endpoints.pop(key)
         if self._by_hostport.get(ep.hostport) is ep:
             del self._by_hostport[ep.hostport]
-        heapq.heappush(self._free_slots, ep.slot)
-        if self._on_slot_reclaimed is not None:
-            self._on_slot_reclaimed(ep.slot)
+        if self._on_slot_reclaimed is None:
+            heapq.heappush(self._free_slots, ep.slot)
+        else:
+            # The slot stays OUT of the free heap until its reclaim callback
+            # has run (the callback contract is "before the slot is reused"):
+            # pushing now would let a concurrent allocation grab the slot and
+            # then have the deferred callback wipe the new owner's state.
+            self._pending_reclaims.append(ep.slot)
+
+    def _drain_reclaims(self) -> None:
+        """Deliver queued slot-reclaim callbacks, then return the slots to
+        the free heap. Must be called WITHOUT the lock held: the runner's
+        callback joins scraper threads and dispatches to the device, either
+        of which would otherwise block every concurrent endpoints()/
+        endpoint_by_hostport() reader for seconds during churn."""
+        with self._lock:
+            pending, self._pending_reclaims = self._pending_reclaims, []
+        for i, slot in enumerate(pending):
+            try:
+                if self._on_slot_reclaimed is not None:
+                    self._on_slot_reclaimed(slot)
+            except BaseException:
+                # Return this slot and requeue the rest so a raising
+                # callback can never permanently leak scheduler capacity.
+                with self._lock:
+                    heapq.heappush(self._free_slots, slot)
+                    self._pending_reclaims.extend(pending[i + 1:])
+                raise
+            with self._lock:
+                heapq.heappush(self._free_slots, slot)
 
     def _resync_all(self, pods: Iterable[Pod]) -> None:
         """Full diff against the lister (reference podResyncAll,
@@ -230,7 +268,7 @@ class Datastore:
             )
             if labels_match and is_pod_ready(pod):
                 matching.add(f"{pod.namespace}/{pod.name}")
-                self.pod_update_or_add(pod)
+                self._pod_update_or_add_locked(pod)
         for key in list(self._endpoints):
             ep = self._endpoints[key]
             if f"{ep.namespace}/{ep.pod_name}" not in matching:

@@ -66,6 +66,11 @@ class PickResult:
     extra_headers: dict[str, str] = dataclasses.field(default_factory=dict)
     # Assumed-load units this pick added (released on served feedback).
     assumed_cost: float = 1.0
+    # Scheduler slot the assumed cost was CHARGED to (the primary pick).
+    # Served feedback releases this slot, not the slot of whichever endpoint
+    # the data plane failed over to — otherwise the primary's charge leaks
+    # and the fallback gets a spurious release.
+    charged_slot: Optional[int] = None
     # Optional (feature_row, picked_at) recorded for online latency training.
     feedback: Optional[tuple] = None
 

@@ -85,7 +85,8 @@ def _fair_order(items: list["_Pending"], registry=None) -> list["_Pending"]:
 
 
 class _Pending:
-    __slots__ = ("req", "candidates", "event", "result", "error", "enqueued_at")
+    __slots__ = ("req", "candidates", "event", "result", "error",
+                 "enqueued_at", "abandoned")
 
     def __init__(self, req: PickRequest, candidates: list):
         self.req = req
@@ -94,6 +95,10 @@ class _Pending:
         self.result: Optional[PickResult] = None
         self.error: Optional[Exception] = None
         self.enqueued_at = time.monotonic()
+        # Set when the caller's pick() wait expired: the collector must DROP
+        # the item rather than schedule it — a scheduled pick charges assumed
+        # load that no served feedback will ever release.
+        self.abandoned = False
 
 
 class BatchingTPUPicker:
@@ -112,6 +117,7 @@ class BatchingTPUPicker:
         hold_max_s: float = 0.0,
         hold_queue_limit: float = 128.0,
         hold_retry_s: float = 0.01,
+        pick_timeout_s: float = 60.0,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
@@ -135,6 +141,7 @@ class BatchingTPUPicker:
         self.hold_max_s = hold_max_s
         self.hold_queue_limit = hold_queue_limit
         self.hold_retry_s = hold_retry_s
+        self.pick_timeout_s = pick_timeout_s
         self._pending: list[_Pending] = []
         self._cond = threading.Condition()
         self._closed = False
@@ -153,7 +160,15 @@ class BatchingTPUPicker:
                 raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "picker shut down")
             self._pending.append(item)
             self._cond.notify()
-        item.event.wait()
+        # Bounded wait: if the collector ever wedges (device hang, bug), fail
+        # the stream instead of hanging the ext-proc thread forever. Budget =
+        # flow-control hold window + a generous scheduling allowance (first
+        # jit compile of a new batch bucket can take tens of seconds).
+        if not item.event.wait(self.hold_max_s + self.pick_timeout_s):
+            item.abandoned = True
+            raise ExtProcError(
+                grpc.StatusCode.UNAVAILABLE, "scheduler did not respond in time"
+            )
         if item.error is not None:
             raise item.error
         assert item.result is not None
@@ -162,14 +177,29 @@ class BatchingTPUPicker:
     def observe_served(self, served_hostport: str, ctx) -> None:
         """Served-endpoint feedback -> assumed-load release
         (004 README:84-101) + latency-predictor training signal."""
-        ep = self.datastore.endpoint_by_hostport(served_hostport)
-        if ep is None:
-            return
         pick_result = getattr(ctx, "pick_result", None)
         cost = getattr(pick_result, "assumed_cost", 1.0)
-        self.scheduler.complete(
-            np.asarray([ep.slot], np.int32), np.asarray([cost], np.float32)
-        )
+        # Release the slot the cycle CHARGED (the primary pick), not the slot
+        # of whichever endpoint actually served: on data-plane failover the
+        # primary's charge would leak and the fallback would get a spurious
+        # release. Guard against slot reuse — if the primary was evicted, its
+        # eviction already cleared the slot's load, so skip the release.
+        release_slot = None
+        charged_slot = getattr(pick_result, "charged_slot", None)
+        primary = getattr(pick_result, "endpoint", None)
+        if charged_slot is not None and primary is not None:
+            ep = self.datastore.endpoint_by_hostport(primary)
+            if ep is not None and ep.slot == charged_slot:
+                release_slot = charged_slot
+        else:  # legacy pick results without charge bookkeeping
+            ep = self.datastore.endpoint_by_hostport(served_hostport)
+            if ep is not None:
+                release_slot = ep.slot
+        if release_slot is not None:
+            self.scheduler.complete(
+                np.asarray([release_slot], np.int32),
+                np.asarray([cost], np.float32),
+            )
         feedback = getattr(pick_result, "feedback", None)
         if self.trainer is not None and feedback is not None:
             features, picked_at, picked_hostport = feedback
@@ -193,29 +223,44 @@ class BatchingTPUPicker:
     # -- collector ---------------------------------------------------------
 
     def _loop(self) -> None:
+        # The collector must NEVER die: every code path that can raise is
+        # inside a try whose handler fails the affected waiters and keeps
+        # looping. A dead collector would hang every in-flight and future
+        # pick() (bounded only by the pick() wait timeout).
         while True:
-            with self._cond:
-                while not self._pending and not self._closed:
-                    self._cond.wait()
-                if self._closed and not self._pending:
-                    return
-                # Micro-batch window: collect stragglers before draining.
-                if len(self._pending) < self.max_batch:
-                    self._cond.wait(self.max_wait_s)
-                if len(self._pending) > self.max_batch:
-                    # Flow-control fairness: when demand exceeds one cycle,
-                    # interleave round-robin across fairness IDs
-                    # (x-gateway-inference-fairness-id header, proposal 1199 /
-                    # flow control) so one tenant cannot monopolize a wave.
-                    self._pending = _fair_order(
-                        self._pending, self.objective_registry
-                    )
-                batch = self._pending[: self.max_batch]
-                self._pending = self._pending[self.max_batch :]
+            batch: list[_Pending] = []
             try:
+                with self._cond:
+                    while not self._pending and not self._closed:
+                        self._cond.wait()
+                    if self._closed and not self._pending:
+                        return
+                    # Micro-batch window: collect stragglers before draining.
+                    if len(self._pending) < self.max_batch:
+                        self._cond.wait(self.max_wait_s)
+                    if len(self._pending) > self.max_batch:
+                        # Flow-control fairness: when demand exceeds one
+                        # cycle, interleave round-robin across fairness IDs
+                        # (x-gateway-inference-fairness-id header, proposal
+                        # 1199) so one tenant cannot monopolize a wave.
+                        self._pending = _fair_order(
+                            self._pending, self.objective_registry
+                        )
+                    batch = self._pending[: self.max_batch]
+                    self._pending = self._pending[self.max_batch :]
                 held = self._run_batch(batch)
             except Exception as e:  # propagate to all waiters
+                if not batch:
+                    # Failure in the pre-batch section (fair ordering /
+                    # registry resolution): the poisoned item is still in
+                    # self._pending and would wedge the loop permanently —
+                    # fail the whole queue rather than hang it.
+                    with self._cond:
+                        batch, self._pending = self._pending, []
                 for item in batch:
+                    # A fresh exception per waiter: handler threads raise
+                    # these concurrently, and a shared instance would race
+                    # on __traceback__/__context__ across threads.
                     item.error = ExtProcError(
                         grpc.StatusCode.INTERNAL, f"scheduler failure: {e}"
                     )
@@ -233,6 +278,11 @@ class BatchingTPUPicker:
                         self._cond.wait(self.hold_retry_s)
 
     def _run_batch(self, batch: list[_Pending]) -> list["_Pending"]:
+        # Timed-out callers are gone: scheduling their items would charge
+        # assumed load with no served feedback to ever release it.
+        batch = [it for it in batch if not it.abandoned]
+        if not batch:
+            return []
         # Flow-control hold decision happens BEFORE any scheduling, so a
         # held request never touches device state (assumed load, prefix
         # inserts, tick) — it simply waits for capacity or its deadline.
@@ -309,11 +359,10 @@ class BatchingTPUPicker:
                     grpc.StatusCode.UNAVAILABLE, "no endpoints available"
                 )
             else:
-                picked = [
-                    by_slot[s].hostport
-                    for s in indices[i]
-                    if s >= 0 and s in by_slot
+                picked_slots = [
+                    int(s) for s in indices[i] if s >= 0 and s in by_slot
                 ]
+                picked = [by_slot[s].hostport for s in picked_slots]
                 if not picked:
                     own_metrics.PICKS.labels(outcome="unavailable").inc()
                     item.error = ExtProcError(
@@ -323,6 +372,10 @@ class BatchingTPUPicker:
                     own_metrics.PICKS.labels(outcome="ok").inc()
                     res = PickResult(endpoint=picked[0], fallbacks=picked[1:])
                     res.assumed_cost = request_cost_host(float(plen[i]))
+                    # The cycle charges the RAW primary (profile.py:214-218);
+                    # if that slot wasn't routable, picked[0] differs and the
+                    # observe_served guard will skip the release.
+                    res.charged_slot = int(indices[i][0])
                     if self.trainer is not None:
                         slot = int(indices[i][0])
                         res.feedback = (

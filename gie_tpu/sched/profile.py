@@ -268,7 +268,13 @@ class Scheduler:
         self._lock = threading.Lock()
         self._complete = jax.jit(_complete_update, donate_argnums=0)
         self._evict = jax.jit(
-            lambda st, slot: st.replace(prefix=prefix.clear_endpoint(st.prefix, slot)),
+            # Clear the slot's prefix columns AND its assumed load: the
+            # endpoint (and its queue) is gone, and a reused slot must not
+            # inherit the previous owner's charge.
+            lambda st, slot: st.replace(
+                prefix=prefix.clear_endpoint(st.prefix, slot),
+                assumed_load=st.assumed_load.at[slot].set(0.0),
+            ),
             donate_argnums=0,
         )
         self._jit = jax.jit(

@@ -28,6 +28,22 @@ assert jax.devices()[0].platform == "cpu", (
     "the virtual 8-device mesh tests would silently run on one TPU chip"
 )
 
+import subprocess  # noqa: E402
+
+# The native chunker is built on demand (the .so is untracked — a committed
+# prebuilt binary can drift from chunker.cc and silently change prefix-cache
+# keys). Run make unconditionally: it no-ops when fresh and rebuilds a stale
+# binary after chunker.cc edits, so test_native.py always sees the source.
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_make = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True)
+if _make.returncode != 0:
+    import warnings
+
+    warnings.warn(
+        "native chunker build failed (test_native will skip): "
+        + _make.stderr.decode(errors="replace")[-500:]
+    )
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

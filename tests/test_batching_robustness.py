@@ -1,0 +1,124 @@
+"""Collector-thread robustness + assumed-load release accounting.
+
+ADVICE r1 regressions: (1) a failure in the collector's pre-batch section
+(fair ordering / band resolution) must fail the waiting picks, not kill the
+collector and hang every future request; (2) pick() must not wait forever on
+a wedged collector; (3) served feedback must release the slot that was
+CHARGED (the primary pick), not the slot of the endpoint that happened to
+serve after data-plane failover.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import grpc
+import pytest
+
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool, Pod
+from gie_tpu.extproc.server import ExtProcError, PickRequest
+from gie_tpu.extproc import metadata as mdkeys
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.sched import ProfileConfig, Scheduler
+from gie_tpu.sched.batching import BatchingTPUPicker
+
+
+def _stack(n_pods=2, **picker_kw):
+    sched = Scheduler(ProfileConfig(load_decay=1.0))
+    ms = MetricsStore()
+    ds = Datastore(on_slot_reclaimed=lambda s: (sched.evict_endpoint(s),
+                                                ms.remove(s)))
+    ds.pool_set(EndpointPool({"app": "x"}, [8000], "default"))
+    for i in range(n_pods):
+        ds.pod_update_or_add(
+            Pod(name=f"p{i}", labels={"app": "x"}, ip=f"10.9.0.{i + 1}")
+        )
+    picker = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.02, **picker_kw)
+    return sched, ds, ms, picker
+
+
+def test_collector_survives_poisoned_prebatch_section():
+    """A request whose headers break _fair_order (value is None, not a list)
+    must fail with INTERNAL — and the collector must keep serving."""
+    sched, ds, ms, picker = _stack(max_batch=1)
+    try:
+        poison = PickRequest(headers={mdkeys.OBJECTIVE_KEY: None}, body=b"x")
+        results = []
+
+        def one_pick():
+            try:
+                results.append(picker.pick(poison, ds.endpoints()))
+            except ExtProcError as e:
+                results.append(e)
+
+        # Two concurrent poisoned picks force len(pending) > max_batch, which
+        # routes through _fair_order -> _band_for -> None[0] TypeError in the
+        # pre-batch section (outside _run_batch's own error handling).
+        threads = [threading.Thread(target=one_pick) for _ in range(2)]
+        [t.start() for t in threads]
+        [t.join(timeout=10) for t in threads]
+        assert len(results) == 2
+        assert all(isinstance(r, ExtProcError) for r in results)
+        # The collector is still alive: a well-formed pick succeeds.
+        ok = picker.pick(PickRequest(headers={}, body=b"good"), ds.endpoints())
+        assert ":" in ok.endpoint
+    finally:
+        picker.close()
+
+
+def test_pick_times_out_instead_of_hanging():
+    sched, ds, ms, picker = _stack(pick_timeout_s=0.3)
+    try:
+        picker._run_batch = lambda batch: time.sleep(2.0) or []
+        with pytest.raises(ExtProcError) as exc:
+            picker.pick(PickRequest(headers={}, body=b"x"), ds.endpoints())
+        assert exc.value.code == grpc.StatusCode.UNAVAILABLE
+    finally:
+        picker.close()
+
+
+def test_failover_releases_charged_primary_slot():
+    sched, ds, ms, picker = _stack()
+    try:
+        res = picker.pick(PickRequest(headers={}, body=b"hello"),
+                          ds.endpoints())
+        primary_slot = ds.endpoint_by_hostport(res.endpoint).slot
+        assert res.charged_slot == primary_slot
+        load = sched.snapshot_assumed_load()
+        assert load[primary_slot] > 0.0
+        # The data plane fails over: the FALLBACK serves, but the release
+        # must still land on the charged primary slot.
+        served = res.fallbacks[0] if res.fallbacks else res.endpoint
+        fallback_slot = ds.endpoint_by_hostport(served).slot
+        picker.observe_served(served, SimpleNamespace(pick_result=res))
+        after = sched.snapshot_assumed_load()
+        assert after[primary_slot] == pytest.approx(0.0, abs=1e-6)
+        if fallback_slot != primary_slot:
+            assert after[fallback_slot] == pytest.approx(
+                float(load[fallback_slot]), abs=1e-6)
+    finally:
+        picker.close()
+
+
+def test_release_skipped_when_primary_was_evicted():
+    """If the charged endpoint is gone (its eviction already cleared the
+    slot), the release must not subtract from a reused slot."""
+    sched, ds, ms, picker = _stack()
+    try:
+        res = picker.pick(PickRequest(headers={}, body=b"hello"),
+                          ds.endpoints())
+        primary = ds.endpoint_by_hostport(res.endpoint)
+        ds.pod_delete("default", primary.pod_name)  # evicts + clears load
+        # A new pod reuses the freed slot.
+        ds.pod_update_or_add(
+            Pod(name="fresh", labels={"app": "x"}, ip="10.9.0.99")
+        )
+        reused = {e.slot for e in ds.endpoints()}
+        assert primary.slot in reused
+        before = sched.snapshot_assumed_load().copy()
+        picker.observe_served(res.endpoint, SimpleNamespace(pick_result=res))
+        after = sched.snapshot_assumed_load()
+        assert list(after) == list(before)  # no spurious release anywhere
+    finally:
+        picker.close()

@@ -81,6 +81,54 @@ def test_slot_reclaim_callback_on_delete():
     assert ds.endpoints() == []
 
 
+def test_slot_reclaim_callback_runs_outside_lock():
+    """ADVICE r1: the reclaim callback may block (scraper join, device
+    dispatch); it must fire after the datastore lock is released so
+    concurrent readers never stall behind it."""
+    held_during_callback = []
+    ds = Datastore(
+        on_slot_reclaimed=lambda s: held_during_callback.append(
+            ds._lock._is_owned()
+        )
+    )
+    ds.pool_set(POOL)
+    ds.pod_update_or_add(make_pod())
+    ds.pod_delete("default", "p1")
+    # Resync-driven evictions (selector change) go through the same path.
+    ds.pod_update_or_add(make_pod())
+    ds.pool_set(
+        POOL.replace(selector={"app": "other"}) if hasattr(POOL, "replace")
+        else POOL.__class__(**{**POOL.__dict__, "selector": {"app": "other"}}),
+        pod_lister=lambda: [make_pod()],
+    )
+    ds.clear()
+    assert held_during_callback and not any(held_during_callback)
+
+
+def test_slot_not_reusable_until_reclaim_callback_ran():
+    """The callback contract is 'before the slot is reused': an allocation
+    racing the (deferred, lock-free) callback must NOT receive the slot, or
+    the callback would wipe the new owner's scheduler state."""
+    intruder_slots: list[set] = []
+
+    def reclaim(slot: int) -> None:
+        # Admit a pod DURING the callback — the freed slots must not be
+        # handed out yet.
+        ds.pod_update_or_add(make_pod(name="intruder", ip="10.0.0.50"))
+        intruder_slots.append(
+            {e.slot for e in ds.endpoints() if e.pod_name == "intruder"}
+        )
+
+    ds = Datastore(on_slot_reclaimed=reclaim)
+    ds.pool_set(POOL)
+    ds.pod_update_or_add(make_pod())
+    victim_slots = {e.slot for e in ds.endpoints()}
+    ds.pod_delete("default", "p1")
+    assert intruder_slots and all(
+        not (got & victim_slots) for got in intruder_slots
+    )
+
+
 def test_slot_reuse_is_lowest_first_and_stable():
     ds = Datastore()
     ds.pool_set(POOL)
