@@ -120,13 +120,21 @@ class EppInstance:
 
 
 class ConformanceEnv:
-    def __init__(self, seed: int = 0, picker_mode: str = "rr"):
+    def __init__(self, seed: int = 0, picker_mode: str = "rr",
+                 name: str = "local"):
+        self.name = name
         self.picker_mode = picker_mode
         self.cluster = FakeCluster()
         self.gateways: dict[str, Gateway] = {}
         self.routes: dict[tuple[str, str], HTTPRoute] = {}
         self.services: dict[tuple[str, str], Service] = {}
         self.epps: dict[tuple[str, str], EppInstance] = {}
+        # Multi-cluster surface (proposal 1374): controller-managed imports
+        # keyed by (namespace, name), and the router installed by
+        # conformance.multicluster.MultiClusterInferenceEnv that carries a
+        # request to an exporting cluster (Endpoint or Parent mode).
+        self.imports: dict[tuple[str, str], api.InferencePoolImport] = {}
+        self.remote_router = None
         self._ip_counter = 0
         self.rng = random.Random(seed)
 
@@ -162,6 +170,15 @@ class ConformanceEnv:
         epp = self.epps.pop((namespace, name), None)
         if epp is not None:
             epp.close()
+        self._reconcile_statuses()
+
+    def set_imports(
+        self, imports: dict[tuple[str, str], api.InferencePoolImport]
+    ) -> None:
+        """Install the controller-managed InferencePoolImport set (CRUD'd by
+        the export controller; users never author these,
+        reference 1374 README 'Distribution')."""
+        self.imports = dict(imports)
         self._reconcile_statuses()
 
     def apply_route(self, route: HTTPRoute) -> None:
@@ -209,6 +226,7 @@ class ConformanceEnv:
         implementation's bookkeeping the conformance tests assert)."""
         # Route conditions first (and collect pool parents on the way).
         pool_parents: dict[tuple[str, str], set[str]] = {}
+        import_parents: dict[tuple[str, str], set[str]] = {}
         for route in self.routes.values():
             for gw_name in route.parent_gateways:
                 ps = route.parent_status(gw_name)
@@ -222,28 +240,64 @@ class ConformanceEnv:
                 unresolved = []
                 for rule in route.rules:
                     for ref in rule.backend_refs:
+                        key = (route.namespace, ref.name)
+                        if ref.kind == "InferencePoolImport":
+                            # Resolvable iff the export controller has
+                            # materialized the import locally (1374 README
+                            # 'Importing Controller').
+                            if key not in self.imports:
+                                unresolved.append(
+                                    f"InferencePoolImport {ref.name}")
+                            else:
+                                import_parents.setdefault(key, set()).add(
+                                    gw_name)
+                            continue
                         if ref.kind != "InferencePool":
                             continue
-                        key = (route.namespace, ref.name)
                         if self.cluster.get_pool(*key) is None:
-                            unresolved.append(ref.name)
+                            unresolved.append(f"InferencePool {ref.name}")
                         else:
                             pool_parents.setdefault(key, set()).add(gw_name)
                 if unresolved:
                     ps.set_condition(api.Condition(
                         ROUTE_RESOLVED_REFS, "False",
                         ROUTE_REASON_BACKEND_NOT_FOUND,
-                        f"InferencePool not found: {unresolved}"))
+                        f"backendRefs not found: {unresolved}"))
                 else:
                     ps.set_condition(api.Condition(
                         ROUTE_RESOLVED_REFS, "True", "ResolvedRefs", "ok"))
+
+        # Import controllers[].parents maintenance (1374 README 'Import
+        # Controller': add an entry per managed parent, remove it when the
+        # import is no longer referenced by a managed HTTPRoute).
+        for key, imp in self.imports.items():
+            gws = sorted(import_parents.get(key, ()))
+            others = [c for c in imp.status.controllers
+                      if c.name != GATEWAY_CONTROLLER_NAME]
+            if gws:
+                entry = api.ImportController(name=GATEWAY_CONTROLLER_NAME)
+                for gw_name in gws:
+                    ps = api.ParentStatus(parentRef=api.ParentReference(
+                        name=gw_name, group="gateway.networking.k8s.io",
+                        kind="Gateway"))
+                    ps.set_condition(api.Condition(
+                        api.COND_ACCEPTED, "True", api.REASON_ACCEPTED,
+                        "referenced by managed HTTPRoute"))
+                    entry.parents.append(ps)
+                imp.status.controllers = others + [entry]
+            else:
+                imp.status.controllers = others
 
         # Pool per-parent conditions (reference api conditions, C1).
         for (ns, name), parents in pool_parents.items():
             pool = self.cluster.get_pool(ns, name)
             if pool is None:
                 continue
-            new_parents = []
+            # Preserve parent entries owned by other controllers (the
+            # multi-cluster export controller's InferencePoolImport
+            # parentRef entry, 1374 README 'InferencePool Status').
+            new_parents = [p for p in pool.status.parents
+                           if p.parentRef.kind == "InferencePoolImport"]
             for gw_name in sorted(parents):
                 parent = api.ParentStatus(
                     parentRef=api.ParentReference(name=gw_name)
@@ -271,12 +325,16 @@ class ConformanceEnv:
                 new_parents.append(parent)
             pool.status.parents = new_parents
 
-        # Pools no longer referenced by any route lose their parent status
-        # (InferencePoolResolvedRefsCondition clear-on-change semantics).
+        # Pools no longer referenced by any route lose their gateway parent
+        # status (InferencePoolResolvedRefsCondition clear-on-change
+        # semantics); export-controller entries survive.
         for (ns, name), _epp in self.epps.items():
             pool = self.cluster.get_pool(ns, name)
             if pool is not None and (ns, name) not in pool_parents:
-                pool.status.parents = []
+                pool.status.parents = [
+                    p for p in pool.status.parents
+                    if p.parentRef.kind == "InferencePoolImport"
+                ]
 
     # ---- data plane ------------------------------------------------------
 
@@ -295,6 +353,16 @@ class ConformanceEnv:
         if route is None or rule is None:
             return Response(404, {}, b"no matching route")
         ref = self._pick_backend(rule)
+        if ref.kind == "InferencePoolImport":
+            # Cross-cluster hop (1374 README 'Data Path'): the installed
+            # router carries the request to an exporting cluster in the
+            # configured routing mode (Endpoint or Parent).
+            imp = self.imports.get((route.namespace, ref.name))
+            if imp is None:
+                return Response(500, {}, b"backend not found")
+            if self.remote_router is None:
+                return Response(500, {}, b"no multi-cluster router installed")
+            return self.remote_router(self, imp, host, path, headers, body)
         if ref.kind != "InferencePool":
             return Response(500, {}, b"non-pool backends not modeled")
         pool = self.cluster.get_pool(route.namespace, ref.name)

@@ -23,6 +23,12 @@ class ConformanceReport:
     implementation_version: str = BUNDLE_VERSION
     gateway_api_inference_extension_version: str = BUNDLE_VERSION
     profile: str = "Gateway"
+    # Honesty marker: this suite runs against conformance/harness.py's
+    # in-process model of the gateway/Envoy data plane, not a real deployed
+    # gateway. The EPP under test is real (datastore, reconcilers,
+    # scheduler, wire-exact ext-proc protos); the proxy and cluster are
+    # simulated. A report from a real-gateway run would say "gateway".
+    mode: str = "in-process-harness"
     results: list[TestResult] = dataclasses.field(default_factory=list)
 
     def add(self, short_name: str, passed: bool) -> None:
@@ -40,6 +46,10 @@ class ConformanceReport:
                 "project": self.implementation,
                 "version": self.implementation_version,
             },
+            # The data plane these results were earned against: an
+            # in-process harness (simulated proxy + cluster, real EPP),
+            # NOT a really-deployed gateway. See conformance/harness.py.
+            "mode": self.mode,
             "gatewayAPIInferenceExtensionVersion": (
                 self.gateway_api_inference_extension_version
             ),

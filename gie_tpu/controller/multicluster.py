@@ -18,6 +18,16 @@ from gie_tpu.api import types as api
 
 CONTROLLER_NAME = "gie-tpu.inference.networking.k8s.io/multicluster"
 
+# Routing modes (reference 1374 README:48-53): an implementation must
+# support at least one; this one supports both.
+#   Endpoint: importing IG routes to endpoints selected by the EPP of the
+#       exported pool (pod/service connectivity between clusters).
+#   Parent: importing IG routes to a parent (Gateway) of the exported pool
+#       (parent connectivity between clusters); the remote gateway performs
+#       its own EPP exchange.
+ROUTING_MODE_ENDPOINT = "Endpoint"
+ROUTING_MODE_PARENT = "Parent"
+
 
 class ClusterSet:
     """A named set of member clusters, each holding pools and imports."""
@@ -72,17 +82,19 @@ class ClusterSet:
                     metadata=api.ObjectMeta(name=name, namespace=ns)
                 )
                 self.imports[key] = imp
-            imp.status = api.InferencePoolImportStatus(
-                controllers=[
-                    api.ImportController(
-                        name=CONTROLLER_NAME,
-                        exportingClusters=[
-                            api.ExportingCluster(name=c)
-                            for c in sorted(exporting)
-                        ],
-                    )
-                ]
+            # Update ONLY this controller's entry: controllers[] is shared
+            # with importing-side controllers (e.g. the gateway controller's
+            # parents entry), and each controller owns exactly its own
+            # entries (1374 README ControllerName contract).
+            entry = api.ImportController(
+                name=CONTROLLER_NAME,
+                exportingClusters=[
+                    api.ExportingCluster(name=c) for c in sorted(exporting)
+                ],
             )
+            others = [c for c in imp.status.controllers
+                      if c.name != CONTROLLER_NAME]
+            imp.status.controllers = [entry] + others
         # Prune imports whose export stopped.
         for key in [k for k in self.imports if k not in desired]:
             del self.imports[key]
@@ -91,6 +103,15 @@ class ClusterSet:
     def _set_exported_condition(
         pool: api.InferencePool, exported: bool, raw_scope
     ) -> None:
+        """Maintain the export-controller parent entry: a parentRef of kind
+        InferencePoolImport with the ns/name of the exported pool (1374
+        README 'InferencePool Status' MUST), carrying the Exported
+        condition (reasons Exported / NotRequested / NotSupported,
+        reference api/v1/inferencepool_types.go:352-379)."""
+        ours = [p for p in pool.status.parents
+                if p.parentRef.kind == "InferencePoolImport"]
+        others = [p for p in pool.status.parents
+                  if p.parentRef.kind != "InferencePoolImport"]
         if exported:
             cond = api.Condition(api.COND_EXPORTED, "True",
                                  api.REASON_EXPORTED,
@@ -103,16 +124,14 @@ class ClusterSet:
             cond = api.Condition(api.COND_EXPORTED, "False",
                                  api.REASON_NOT_REQUESTED,
                                  "no export annotation")
-        if not pool.status.parents:
-            pool.status.parents = [api.ParentStatus(
-                parentRef=api.ParentReference(name=CONTROLLER_NAME)
-            )]
-        for parent in pool.status.parents:
-            if parent.parentRef.name == CONTROLLER_NAME:
-                parent.set_condition(cond)
-                return
-        ps = api.ParentStatus(
-            parentRef=api.ParentReference(name=CONTROLLER_NAME)
-        )
+        if ours:
+            ps = ours[0]
+        else:
+            ps = api.ParentStatus(parentRef=api.ParentReference(
+                name=pool.metadata.name,
+                namespace=pool.metadata.namespace,
+                group=api.GROUP_X,
+                kind="InferencePoolImport",
+            ))
         ps.set_condition(cond)
-        pool.status.parents.append(ps)
+        pool.status.parents = others + [ps]

@@ -409,6 +409,78 @@ def test_routing_conformance_with_tpu_scheduler():
         env.close()
 
 
+@record("MultiClusterEndpointMode")
+def test_multicluster_endpoint_mode():
+    """Proposal 1374 Endpoint routing mode: an importing cluster's route
+    referencing an InferencePoolImport reaches the exported pool's EPP and
+    routes to the endpoint it selects (1374 README:48-53, 'Data Path')."""
+    from conformance.multicluster import (
+        MultiClusterInferenceEnv, ROUTING_MODE_ENDPOINT)
+
+    mc = MultiClusterInferenceEnv(["exporter", "importer"],
+                                  routing_mode=ROUTING_MODE_ENDPOINT)
+    try:
+        exp = mc.env("exporter")
+        exp.apply_service(Service("epp-svc"))
+        pods = exp.deploy_model_servers("remote-model-server", 3,
+                                        {"app": "remote"})
+        pool = make_pool("shared-pool", {"app": "remote"})
+        pool.metadata.annotations[api.EXPORT_ANNOTATION] = (
+            api.EXPORT_SCOPE_CLUSTERSET)
+        mc.apply_pool("exporter", pool)
+        imp_env = mc.env("importer")
+        imp_env.apply_gateway(Gateway("primary-gateway"))
+        imp_env.apply_route(HTTPRoute(
+            name="import-route", parent_gateways=["primary-gateway"],
+            rules=[RouteRule(backend_refs=[BackendRef(
+                name="shared-pool", kind="InferencePoolImport",
+                group=api.GROUP_X)])],
+        ))
+        names = {p.name for p in pods}
+        for _ in range(6):
+            resp = imp_env.send("primary-gateway", "x", "/", body=b"q")
+            assert resp.status == 200 and resp.backend_pod in names
+    finally:
+        mc.close()
+
+
+@record("MultiClusterParentMode")
+def test_multicluster_parent_mode():
+    """Proposal 1374 Parent routing mode: the importing IG forwards to a
+    parent Gateway of the exported pool; the exporting cluster performs its
+    own route matching and EPP exchange (1374 README:48-53)."""
+    from conformance.multicluster import (
+        MultiClusterInferenceEnv, ROUTING_MODE_PARENT)
+
+    mc = MultiClusterInferenceEnv(["exporter", "importer"],
+                                  routing_mode=ROUTING_MODE_PARENT)
+    try:
+        exp = mc.env("exporter")
+        exp.apply_service(Service("epp-svc"))
+        pods = exp.deploy_model_servers("remote-model-server", 3,
+                                        {"app": "remote"})
+        pool = make_pool("shared-pool", {"app": "remote"})
+        pool.metadata.annotations[api.EXPORT_ANNOTATION] = (
+            api.EXPORT_SCOPE_CLUSTERSET)
+        mc.apply_pool("exporter", pool)
+        exp.apply_gateway(Gateway("remote-gateway"))
+        exp.apply_route(simple_route("remote-route", "remote-gateway",
+                                     "shared-pool"))
+        imp_env = mc.env("importer")
+        imp_env.apply_gateway(Gateway("primary-gateway"))
+        imp_env.apply_route(HTTPRoute(
+            name="import-route", parent_gateways=["primary-gateway"],
+            rules=[RouteRule(backend_refs=[BackendRef(
+                name="shared-pool", kind="InferencePoolImport",
+                group=api.GROUP_X)])],
+        ))
+        names = {p.name for p in pods}
+        resp = imp_env.send("primary-gateway", "x", "/", body=b"q")
+        assert resp.status == 200 and resp.backend_pod in names
+    finally:
+        mc.close()
+
+
 def test_zzz_emit_report(tmp_path):
     """Write the versioned ConformanceReport (reference
     conformancereport.go:39-56). Runs last by name ordering."""

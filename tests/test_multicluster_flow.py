@@ -42,7 +42,7 @@ def test_exported_condition_on_pool():
     conds = [
         p.get_condition(api.COND_EXPORTED)
         for p in pool.status.parents
-        if p.parentRef.name == CONTROLLER_NAME
+        if p.parentRef.kind == "InferencePoolImport"
     ]
     assert conds[0].status == "True" and conds[0].reason == api.REASON_EXPORTED
 
@@ -51,7 +51,7 @@ def test_exported_condition_on_pool():
     conds = [
         p.get_condition(api.COND_EXPORTED)
         for p in unexported.status.parents
-        if p.parentRef.name == CONTROLLER_NAME
+        if p.parentRef.kind == "InferencePoolImport"
     ]
     assert conds[0].status == "False"
     assert conds[0].reason == api.REASON_NOT_REQUESTED
@@ -107,7 +107,7 @@ def test_unsupported_export_scope_not_supported_reason():
     conds = [
         p.get_condition(api.COND_EXPORTED)
         for p in pool.status.parents
-        if p.parentRef.name == CONTROLLER_NAME
+        if p.parentRef.kind == "InferencePoolImport"
     ]
     assert conds[0].status == "False"
     assert conds[0].reason == api.REASON_NOT_SUPPORTED
@@ -130,3 +130,230 @@ def test_fair_order_criticality_bands_before_fairness():
     items.append(pending("A", "critical", 0))  # arrived last
     ordered = _fair_order(items)
     assert ordered[0].req.headers[mdkeys.OBJECTIVE_KEY][0] == "critical"
+
+
+# --------------------------------------------------------------------- #
+# Routing-mode consumption (1374 README 'Routing Modes' + 'Data Path'):
+# requests on an importing cluster's route referencing an
+# InferencePoolImport land on an exporting cluster's endpoint.
+# --------------------------------------------------------------------- #
+
+from conformance.harness import ConformanceEnv  # noqa: E402
+from conformance.multicluster import (  # noqa: E402
+    MultiClusterInferenceEnv,
+    ROUTING_MODE_ENDPOINT,
+    ROUTING_MODE_PARENT,
+)
+from gie_tpu.api.gateway import (  # noqa: E402
+    BackendRef,
+    Gateway,
+    HTTPRoute,
+    RouteRule,
+    Service,
+    ROUTE_RESOLVED_REFS,
+)
+
+
+def harness_pool(name="pool", export=True):
+    pool = make_pool(name=name, export=export)
+    return pool
+
+
+def _exporting_cluster(mc, cluster, pool_name="pool", pods=3,
+                       with_gateway=False):
+    env = mc.env(cluster)
+    env.apply_service(Service(name="epp"))
+    pods = env.deploy_model_servers(
+        f"{cluster}-vllm", pods, labels={"app": "vllm"})
+    mc.apply_pool(cluster, harness_pool(name=pool_name))
+    if with_gateway:
+        env.apply_gateway(Gateway(name=f"{cluster}-gw"))
+        env.apply_route(HTTPRoute(
+            name=f"{cluster}-route",
+            parent_gateways=[f"{cluster}-gw"],
+            rules=[RouteRule(backend_refs=[BackendRef(name=pool_name)])],
+        ))
+    return [p.name for p in pods]
+
+
+def _importing_cluster(mc, cluster, import_name="pool"):
+    env = mc.env(cluster)
+    env.apply_gateway(Gateway(name=f"{cluster}-gw"))
+    env.apply_route(HTTPRoute(
+        name=f"{cluster}-route",
+        parent_gateways=[f"{cluster}-gw"],
+        rules=[RouteRule(backend_refs=[BackendRef(
+            name=import_name,
+            kind="InferencePoolImport",
+            group=api.GROUP_X,
+        )])],
+    ))
+    return env
+
+
+def test_endpoint_mode_routes_to_exporting_cluster():
+    mc = MultiClusterInferenceEnv(["east", "west"],
+                                  routing_mode=ROUTING_MODE_ENDPOINT)
+    try:
+        east_pods = _exporting_cluster(mc, "east")
+        west = _importing_cluster(mc, "west")
+        # The importing route resolves the import.
+        ps = west.routes[("default", "west-route")].parent_status("west-gw")
+        assert ps.get_condition(ROUTE_RESOLVED_REFS).status == "True"
+        for _ in range(6):
+            resp = west.send("west-gw", "any.host", "/v1/completions",
+                             body=b"hi")
+            assert resp.status == 200
+            assert resp.backend_pod in east_pods
+    finally:
+        mc.close()
+
+
+def test_parent_mode_routes_via_remote_gateway():
+    mc = MultiClusterInferenceEnv(["east", "west"],
+                                  routing_mode=ROUTING_MODE_PARENT)
+    try:
+        east_pods = _exporting_cluster(mc, "east", with_gateway=True)
+        west = _importing_cluster(mc, "west")
+        resp = west.send("west-gw", "any.host", "/v1/completions", body=b"hi")
+        assert resp.status == 200 and resp.backend_pod in east_pods
+        # Parent mode REQUIRES a remote parent: removing the exporting
+        # cluster's route must break the path (Endpoint mode would not).
+        mc.env("east").delete_route("default", "east-route")
+        resp = west.send("west-gw", "any.host", "/v1/completions", body=b"hi")
+        assert resp.status == 503 and b"no remote parent gateway" in resp.body
+    finally:
+        mc.close()
+
+
+def test_weighted_split_local_pool_and_import():
+    """50/50 weighted backendRefs across a local InferencePool and an
+    InferencePoolImport balance across clusters (1374 README example)."""
+    mc = MultiClusterInferenceEnv(["east", "west"])
+    try:
+        east_pods = _exporting_cluster(mc, "east")
+        west = mc.env("west")
+        west.apply_service(Service(name="epp"))
+        west_pods = [p.name for p in west.deploy_model_servers(
+            "west-vllm", 3, labels={"app": "vllm"})]
+        mc.apply_pool("west", harness_pool(name="local", export=False))
+        west.apply_gateway(Gateway(name="west-gw"))
+        west.apply_route(HTTPRoute(
+            name="west-route",
+            parent_gateways=["west-gw"],
+            rules=[RouteRule(backend_refs=[
+                BackendRef(name="local", weight=50),
+                BackendRef(name="pool", kind="InferencePoolImport",
+                           group=api.GROUP_X, weight=50),
+            ])],
+        ))
+        served = {"east": 0, "west": 0}
+        for _ in range(60):
+            resp = west.send("west-gw", "any.host", "/", body=b"x")
+            assert resp.status == 200
+            served["east" if resp.backend_pod in east_pods else "west"] += 1
+            assert resp.backend_pod in east_pods + west_pods
+        assert served["east"] >= 10 and served["west"] >= 10
+    finally:
+        mc.close()
+
+
+def test_active_passive_exporter_failover():
+    """Two exporters: EPP readiness picks the active one (1374 README
+    'InferencePool Selection', Active-Passive)."""
+    mc = MultiClusterInferenceEnv(["east", "south", "west"])
+    try:
+        east_pods = _exporting_cluster(mc, "east")
+        south_pods = _exporting_cluster(mc, "south")
+        west = _importing_cluster(mc, "west")
+        resp = west.send("west-gw", "h", "/", body=b"x")
+        assert resp.backend_pod in east_pods  # first in ClusterSet order
+        mc.env("east").scale_epp("default", "pool", 0)
+        resp = west.send("west-gw", "h", "/", body=b"x")
+        assert resp.backend_pod in south_pods  # failed over
+        mc.env("east").scale_epp("default", "pool", 1)
+        resp = west.send("west-gw", "h", "/", body=b"x")
+        assert resp.backend_pod in east_pods  # failed back
+    finally:
+        mc.close()
+
+
+def test_export_withdrawn_prunes_import_and_unresolves_route():
+    mc = MultiClusterInferenceEnv(["east", "west"])
+    try:
+        _exporting_cluster(mc, "east")
+        west = _importing_cluster(mc, "west")
+        assert west.imports  # materialized
+        # Withdraw the export (annotation removed -> reconcile).
+        unexported = harness_pool(export=False)
+        mc.apply_pool("east", unexported)
+        assert not west.imports
+        ps = west.routes[("default", "west-route")].parent_status("west-gw")
+        cond = ps.get_condition(ROUTE_RESOLVED_REFS)
+        assert cond.status == "False"
+        resp = west.send("west-gw", "h", "/", body=b"x")
+        assert resp.status == 500
+    finally:
+        mc.close()
+
+
+def test_import_controller_parent_status_maintained():
+    """The importing controller records the local Gateway in the import's
+    status.controllers[].parents, and removes it when the route goes away
+    (1374 README 'Import Controller' responsibilities)."""
+    from conformance.harness import GATEWAY_CONTROLLER_NAME
+
+    mc = MultiClusterInferenceEnv(["east", "west"])
+    try:
+        _exporting_cluster(mc, "east")
+        west = _importing_cluster(mc, "west")
+        imp = west.imports[("default", "pool")]
+        gw_entries = [c for c in imp.status.controllers
+                      if c.name == GATEWAY_CONTROLLER_NAME]
+        assert len(gw_entries) == 1
+        parent = gw_entries[0].parents[0]
+        assert parent.parentRef.name == "west-gw"
+        assert parent.parentRef.kind == "Gateway"
+        assert parent.get_condition(api.COND_ACCEPTED).status == "True"
+        # Export-controller entry still present alongside.
+        assert any(c.name == CONTROLLER_NAME
+                   for c in imp.status.controllers)
+        west.delete_route("default", "west-route")
+        imp = west.imports[("default", "pool")]
+        assert not [c for c in imp.status.controllers
+                    if c.name == GATEWAY_CONTROLLER_NAME]
+    finally:
+        mc.close()
+
+
+def test_mutual_import_loop_terminates():
+    """Two clusters whose routes weighted-split into each other's imports
+    must terminate with a response (possibly 508), never recurse without
+    bound (Parent mode re-enters send() on the remote cluster)."""
+    mc = MultiClusterInferenceEnv(["east", "west"],
+                                  routing_mode=ROUTING_MODE_PARENT)
+    try:
+        for c in ("east", "west"):
+            env = mc.env(c)
+            env.apply_service(Service(name="epp"))
+            env.deploy_model_servers(f"{c}-vllm", 2, labels={"app": "vllm"})
+            mc.apply_pool(c, harness_pool())
+        for c in ("east", "west"):
+            env = mc.env(c)
+            env.apply_gateway(Gateway(name=f"{c}-gw"))
+            env.apply_route(HTTPRoute(
+                name=f"{c}-route", parent_gateways=[f"{c}-gw"],
+                rules=[RouteRule(backend_refs=[
+                    # The 0-weighted local pool ref makes this route a
+                    # discoverable parent of the pool, but every pick goes
+                    # to the import of the OTHER cluster's pool: a pure
+                    # cross-cluster ping-pong.
+                    BackendRef(name="pool", weight=0),
+                    BackendRef(name="pool", kind="InferencePoolImport",
+                               group=api.GROUP_X, weight=1),
+                ])],
+            ))
+        resp = mc.env("west").send("west-gw", "h", "/", body=b"x")
+        assert resp.status == 508
+    finally:
+        mc.close()
