@@ -39,6 +39,10 @@ from gie_tpu.api.gateway import (
 )
 from gie_tpu.controller import FakeCluster, InferencePoolReconciler, PodReconciler
 from gie_tpu.controller.reconcilers import wire
+from gie_tpu.controller.status import (
+    desired_parent_statuses,
+    merge_parent_statuses,
+)
 from gie_tpu.datastore import Datastore, Pod
 from gie_tpu.extproc import StreamingServer, metadata as mdkeys, pb
 from gie_tpu.extproc.envoy import extract_metadata_values, get_header_value
@@ -288,42 +292,18 @@ class ConformanceEnv:
             else:
                 imp.status.controllers = others
 
-        # Pool per-parent conditions (reference api conditions, C1).
+        # Pool per-parent conditions (reference api conditions, C1) — the
+        # SAME computation PoolStatusController publishes to a real
+        # apiserver (gie_tpu/controller/status.py).
         for (ns, name), parents in pool_parents.items():
             pool = self.cluster.get_pool(ns, name)
             if pool is None:
                 continue
-            # Preserve parent entries owned by other controllers (the
-            # multi-cluster export controller's InferencePoolImport
-            # parentRef entry, 1374 README 'InferencePool Status').
-            new_parents = [p for p in pool.status.parents
-                           if p.parentRef.kind == "InferencePoolImport"]
-            for gw_name in sorted(parents):
-                parent = api.ParentStatus(
-                    parentRef=api.ParentReference(name=gw_name)
-                )
-                parent.set_condition(api.Condition(
-                    api.COND_ACCEPTED, "True", api.REASON_ACCEPTED,
-                    "supported by parent"))
-                epp = pool.spec.endpointPickerRef
-                if epp is None:
-                    # This implementation supports EPP-less pools (plain
-                    # round-robin), so Accepted stays True
-                    # (InferencePoolMissingEPPRef allows either semantic).
-                    parent.set_condition(api.Condition(
-                        api.COND_RESOLVED_REFS, "True",
-                        api.REASON_RESOLVED_REFS, "no endpointPickerRef"))
-                elif (ns, epp.name) not in self.services:
-                    parent.set_condition(api.Condition(
-                        api.COND_RESOLVED_REFS, "False",
-                        api.REASON_INVALID_EXTENSION_REF,
-                        f"BackendNotFound: Service {epp.name}"))
-                else:
-                    parent.set_condition(api.Condition(
-                        api.COND_RESOLVED_REFS, "True",
-                        api.REASON_RESOLVED_REFS, "ok"))
-                new_parents.append(parent)
-            pool.status.parents = new_parents
+            computed = desired_parent_statuses(
+                pool, parents,
+                lambda sns, sname: (sns, sname) in self.services)
+            pool.status.parents = merge_parent_statuses(
+                pool.status.parents, computed)
 
         # Pools no longer referenced by any route lose their gateway parent
         # status (InferencePoolResolvedRefsCondition clear-on-change

@@ -1,0 +1,464 @@
+"""Minimal CEL (Common Expression Language) evaluator.
+
+The reference proves its committed CRD validation rules by running them
+through a real apiserver (reference test/cel/main_test.go:38-95,
+inferencepool_test.go:31-136). This repo's equivalent executes the ACTUAL
+`x-kubernetes-validations` rule strings from config/crd/bases/*.yaml against
+k8s-shaped fixture objects, so the committed YAML and the Python validate()
+mirrors cannot drift silently.
+
+Supported CEL subset (everything the committed rules use, plus headroom):
+  literals        'str', "str", ints, floats, true/false/null, [list]
+  operators       || && == != < <= > >= + - (binary), ! - (unary), ( )
+  membership      `in`
+  member access   a.b, a['b'], a[0]
+  calls           size(x), has(a.b), x.contains(y), x.startsWith(y),
+                  x.endsWith(y), x.matches(re)
+  macros          list.all(v, p), list.exists(v, p), list.exists_one(v, p),
+                  list.filter(v, p), list.map(v, e)
+
+Semantics follow the CEL spec where it matters for CRD validation:
+`has(a.b)` is presence (false for absent map keys), plain access to a
+missing key is an evaluation error, and && / || use CEL's commutative
+error-absorbing logic (false && error == false, true || error == true).
+"""
+
+from __future__ import annotations
+
+import re as _re
+from typing import Any, Optional
+
+
+class CelError(Exception):
+    """Parse or evaluation failure (maps to an apiserver rule rejection)."""
+
+
+class _NoSuchKey(CelError):
+    pass
+
+
+# --------------------------------------------------------------------- #
+# Lexer
+# --------------------------------------------------------------------- #
+
+_TOKEN_RE = _re.compile(
+    r"""\s*(?:
+        (?P<num>\d+\.\d+|\d+)
+      | (?P<str>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
+      | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+      | (?P<op>\|\||&&|==|!=|<=|>=|[()\[\].,<>!+\-])
+    )""",
+    _re.VERBOSE,
+)
+
+
+def _tokenize(src: str) -> list[tuple[str, str]]:
+    out, i = [], 0
+    while i < len(src):
+        m = _TOKEN_RE.match(src, i)
+        if m is None:
+            if src[i:].strip():
+                raise CelError(f"unexpected character {src[i]!r} at {i}")
+            break
+        i = m.end()
+        for kind in ("num", "str", "ident", "op"):
+            text = m.group(kind)
+            if text is not None:
+                out.append((kind, text))
+                break
+    out.append(("eof", ""))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Parser -> tuple AST
+# --------------------------------------------------------------------- #
+
+_MACROS = {"all", "exists", "exists_one", "filter", "map"}
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.toks = tokens
+        self.pos = 0
+
+    def peek(self) -> tuple[str, str]:
+        return self.toks[self.pos]
+
+    def next(self) -> tuple[str, str]:
+        tok = self.toks[self.pos]
+        self.pos += 1
+        return tok
+
+    def expect(self, text: str) -> None:
+        kind, t = self.next()
+        if t != text:
+            raise CelError(f"expected {text!r}, got {t!r}")
+
+    def parse(self):
+        node = self.or_expr()
+        if self.peek()[0] != "eof":
+            raise CelError(f"trailing tokens at {self.peek()[1]!r}")
+        return node
+
+    def or_expr(self):
+        node = self.and_expr()
+        while self.peek()[1] == "||":
+            self.next()
+            node = ("or", node, self.and_expr())
+        return node
+
+    def and_expr(self):
+        node = self.rel_expr()
+        while self.peek()[1] == "&&":
+            self.next()
+            node = ("and", node, self.rel_expr())
+        return node
+
+    def rel_expr(self):
+        node = self.add_expr()
+        kind, t = self.peek()
+        if t in ("==", "!=", "<", "<=", ">", ">=") or (
+            kind == "ident" and t == "in"
+        ):
+            self.next()
+            node = ("bin", t, node, self.add_expr())
+        return node
+
+    def add_expr(self):
+        node = self.unary()
+        while self.peek()[1] in ("+", "-"):
+            op = self.next()[1]
+            node = ("bin", op, node, self.unary())
+        return node
+
+    def unary(self):
+        if self.peek()[1] == "!":
+            self.next()
+            return ("not", self.unary())
+        if self.peek()[1] == "-":
+            self.next()
+            return ("neg", self.unary())
+        return self.postfix()
+
+    def postfix(self):
+        node = self.primary()
+        while True:
+            kind, t = self.peek()
+            if t == ".":
+                self.next()
+                name = self.next()[1]
+                if self.peek()[1] == "(":
+                    self.next()
+                    args = self.args()
+                    if name in _MACROS:
+                        if (
+                            len(args) != 2
+                            or args[0][0] != "var"
+                        ):
+                            raise CelError(f"{name}(var, expr) expected")
+                        node = ("macro", name, node, args[0][1], args[1])
+                    else:
+                        node = ("method", name, node, args)
+                else:
+                    node = ("field", node, name)
+            elif t == "[":
+                self.next()
+                idx = self.or_expr()
+                self.expect("]")
+                node = ("index", node, idx)
+            else:
+                return node
+
+    def args(self):
+        out = []
+        if self.peek()[1] != ")":
+            while True:
+                out.append(self.or_expr())
+                if self.peek()[1] == ",":
+                    self.next()
+                    continue
+                break
+        self.expect(")")
+        return out
+
+    def primary(self):
+        kind, t = self.next()
+        if t == "(":
+            node = self.or_expr()
+            self.expect(")")
+            return node
+        if t == "[":
+            items = []
+            if self.peek()[1] != "]":
+                while True:
+                    items.append(self.or_expr())
+                    if self.peek()[1] == ",":
+                        self.next()
+                        continue
+                    break
+            self.expect("]")
+            return ("list", items)
+        if kind == "num":
+            return ("lit", float(t) if "." in t else int(t))
+        if kind == "str":
+            body = t[1:-1]
+            return ("lit", _re.sub(r"\\(.)", r"\1", body))
+        if kind == "ident":
+            if t == "true":
+                return ("lit", True)
+            if t == "false":
+                return ("lit", False)
+            if t == "null":
+                return ("lit", None)
+            if self.peek()[1] == "(":
+                self.next()
+                return ("call", t, self.args())
+            return ("var", t)
+        raise CelError(f"unexpected token {t!r}")
+
+
+# --------------------------------------------------------------------- #
+# Evaluator
+# --------------------------------------------------------------------- #
+
+
+def _truthy(v: Any) -> bool:
+    if not isinstance(v, bool):
+        raise CelError(f"non-bool in boolean context: {v!r}")
+    return v
+
+
+def _eval(node, env: dict) -> Any:
+    """Evaluate one node; ANY runtime failure surfaces as CelError so the
+    && / || absorption logic and validate_against_schema's rule-error
+    handling see a uniform error type (a type-mismatched comparison or a
+    malformed regex in a rule is a rule error, not a crash)."""
+    try:
+        return _eval_inner(node, env)
+    except CelError:
+        raise
+    except (TypeError, ValueError, AttributeError, KeyError,
+            IndexError, _re.error) as e:
+        raise CelError(f"evaluation error: {e}") from e
+
+
+def _eval_inner(node, env: dict) -> Any:
+    op = node[0]
+    if op == "lit":
+        return node[1]
+    if op == "var":
+        if node[1] not in env:
+            raise CelError(f"undeclared variable {node[1]!r}")
+        return env[node[1]]
+    if op == "list":
+        return [_eval(item, env) for item in node[1]]
+    if op == "or":
+        # CEL: commutative or — a true side absorbs the other side's error.
+        try:
+            left = _truthy(_eval(node[1], env))
+        except CelError:
+            if _truthy(_eval(node[2], env)):
+                return True
+            raise
+        return left or _truthy(_eval(node[2], env))
+    if op == "and":
+        try:
+            left = _truthy(_eval(node[1], env))
+        except CelError:
+            if not _truthy(_eval(node[2], env)):
+                return False
+            raise
+        return left and _truthy(_eval(node[2], env))
+    if op == "not":
+        return not _truthy(_eval(node[1], env))
+    if op == "neg":
+        return -_eval(node[1], env)
+    if op == "bin":
+        _, o, a, b = node
+        va, vb = _eval(a, env), _eval(b, env)
+        if o == "==":
+            return va == vb
+        if o == "!=":
+            return va != vb
+        if o == "<":
+            return va < vb
+        if o == "<=":
+            return va <= vb
+        if o == ">":
+            return va > vb
+        if o == ">=":
+            return va >= vb
+        if o == "+":
+            return va + vb
+        if o == "-":
+            return va - vb
+        if o == "in":
+            return va in vb
+        raise CelError(f"unknown operator {o!r}")
+    if op == "field":
+        obj = _eval(node[1], env)
+        if isinstance(obj, dict):
+            if node[2] not in obj:
+                raise _NoSuchKey(f"no such key: {node[2]!r}")
+            return obj[node[2]]
+        raise CelError(f"field access on non-object: {obj!r}")
+    if op == "index":
+        obj = _eval(node[1], env)
+        idx = _eval(node[2], env)
+        if isinstance(obj, dict):
+            if idx not in obj:
+                raise _NoSuchKey(f"no such key: {idx!r}")
+            return obj[idx]
+        if isinstance(obj, list):
+            if not isinstance(idx, int) or not 0 <= idx < len(obj):
+                raise CelError(f"index {idx!r} out of range")
+            return obj[idx]
+        raise CelError(f"index on non-container: {obj!r}")
+    if op == "call":
+        _, name, args = node
+        if name == "has":
+            # Presence test: argument must be a field selection.
+            if len(args) != 1 or args[0][0] != "field":
+                raise CelError("has() requires a field selection")
+            try:
+                _eval(args[0], env)
+                return True
+            except _NoSuchKey:
+                return False
+        if name == "size":
+            return len(_eval(args[0], env))
+        raise CelError(f"unknown function {name}()")
+    if op == "method":
+        _, name, recv, args = node
+        obj = _eval(recv, env)
+        vals = [_eval(a, env) for a in args]
+        if name == "size":
+            return len(obj)
+        if name == "contains":
+            return vals[0] in obj
+        if name == "startsWith":
+            return obj.startswith(vals[0])
+        if name == "endsWith":
+            return obj.endswith(vals[0])
+        if name == "matches":
+            return _re.search(vals[0], obj) is not None
+        raise CelError(f"unknown method .{name}()")
+    if op == "macro":
+        _, name, recv, var, body = node
+        obj = _eval(recv, env)
+        items = list(obj.keys()) if isinstance(obj, dict) else list(obj)
+        inner = dict(env)
+
+        def run(item):
+            inner[var] = item
+            return _truthy(_eval(body, inner))
+
+        if name == "all":
+            return all(run(item) for item in items)
+        if name == "exists":
+            return any(run(item) for item in items)
+        if name == "exists_one":
+            return sum(1 for item in items if run(item)) == 1
+        if name == "filter":
+            return [item for item in items if run(item)]
+        if name == "map":
+            out = []
+            for item in items:
+                inner[var] = item
+                out.append(_eval(body, inner))
+            return out
+        raise CelError(f"unknown macro {name}")
+    raise CelError(f"unknown node {op!r}")
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=512)
+def compile_rule(rule: str):
+    """Parse a CEL rule once (cached); returns a callable(self_value) ->
+    bool. The schema walker hits this for every rule on every object, so
+    repeated admissions reuse the parsed AST."""
+    ast = _Parser(_tokenize(rule)).parse()
+
+    def evaluate(self_value: Any, **extra: Any) -> bool:
+        env = {"self": self_value}
+        env.update(extra)
+        return _truthy(_eval(ast, env))
+
+    return evaluate
+
+
+def evaluate_rule(rule: str, self_value: Any, **extra: Any) -> bool:
+    """One-shot: evaluate `rule` with `self` bound to self_value.
+
+    Mirrors the apiserver contract: returns the rule's boolean verdict;
+    raises CelError on a malformed rule or a type error during evaluation
+    (an apiserver treats an erroring rule as a rejection)."""
+    return compile_rule(rule)(self_value, **extra)
+
+
+# --------------------------------------------------------------------- #
+# CRD-schema walker: execute every committed x-kubernetes-validations
+# rule that applies to a k8s-shaped object.
+# --------------------------------------------------------------------- #
+
+
+def apply_defaults(schema: dict, obj: Any) -> Any:
+    """Structural defaulting, as the apiserver performs at decode time —
+    BEFORE CEL rules run (so `self.kind != 'Service'` sees the defaulted
+    kind even when the author omitted it). Returns a defaulted copy."""
+    if isinstance(obj, dict):
+        out = dict(obj)
+        for key, sub in (schema.get("properties") or {}).items():
+            if key in out:
+                out[key] = apply_defaults(sub, out[key])
+            elif "default" in sub:
+                out[key] = sub["default"]
+        return out
+    if isinstance(obj, list) and "items" in schema:
+        return [apply_defaults(schema["items"], item) for item in obj]
+    return obj
+
+
+def validate_against_schema(schema: dict, obj: Any,
+                            path: str = "") -> list[str]:
+    """Walk an OpenAPI v3 schema (as committed in config/crd/bases) and
+    evaluate each x-kubernetes-validations rule at its attachment point
+    against the corresponding slice of `obj`. Returns rule `message`s (or
+    rule text) for every violated or erroring rule — empty means the
+    apiserver would have admitted the object."""
+    failures: list[str] = []
+    for entry in schema.get("x-kubernetes-validations", []) or []:
+        rule = entry.get("rule", "")
+        try:
+            ok = evaluate_rule(rule, obj)
+        except CelError as e:
+            ok = False
+            failures.append(
+                f"{path or '<root>'}: rule error ({e}): {rule}")
+            continue
+        if not ok:
+            failures.append(
+                f"{path or '<root>'}: {entry.get('message', rule)}")
+    if isinstance(obj, dict):
+        for key, sub in (schema.get("properties") or {}).items():
+            if key in obj:
+                failures.extend(
+                    validate_against_schema(sub, obj[key],
+                                            f"{path}.{key}".lstrip(".")))
+    if isinstance(obj, list) and "items" in schema:
+        for i, item in enumerate(obj):
+            failures.extend(
+                validate_against_schema(schema["items"], item,
+                                        f"{path}[{i}]"))
+    return failures
+
+
+def crd_schema(crd: dict, version: Optional[str] = None) -> dict:
+    """The openAPIV3Schema of a committed CRD manifest."""
+    versions = crd["spec"]["versions"]
+    if version is not None:
+        versions = [v for v in versions if v["name"] == version]
+    return versions[0]["schema"]["openAPIV3Schema"]

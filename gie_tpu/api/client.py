@@ -19,8 +19,9 @@ class InferencePoolClient:
     def __init__(self, store):
         # `store` is any object store with get_pool (reads) and, for write
         # support, apply_pool/delete_pool (FakeCluster has all three; the
-        # kube adapter is read-only today, so writes raise a clear
-        # NotImplementedError instead of an AttributeError).
+        # kube adapter supports status writes via patch_pool_status but not
+        # spec writes, so spec writes raise a clear NotImplementedError
+        # instead of an AttributeError).
         self._store = store
 
     def _write(self, method: str, *args) -> None:
@@ -51,6 +52,14 @@ class InferencePoolClient:
         and commits BEFORE mutating the caller's object, so a store-side
         rejection never leaves the local object diverged from the store."""
         status.validate()
+        # Stores with a dedicated status subresource (the kube adapter's
+        # patch_pool_status) take the narrow write; object stores without
+        # one (FakeCluster) re-apply the whole object.
+        if hasattr(self._store, "patch_pool_status"):
+            self._store.patch_pool_status(
+                pool.metadata.namespace, pool.metadata.name, status)
+            pool.status = status
+            return pool
         import copy
 
         committed = copy.deepcopy(pool)

@@ -321,12 +321,21 @@ class InferencePoolImport:
 # ---------------------------------------------------------------------------
 
 
-def _clean(d: Any) -> Any:
+def clean_manifest(d: Any) -> Any:
+    """Prune empties from a manifest-shaped dict tree (shared by every
+    serializer that emits k8s patch/apply bodies)."""
     if isinstance(d, dict):
-        return {k: _clean(v) for k, v in d.items() if v not in (None, "", [], {})}
+        return {
+            k: clean_manifest(v)
+            for k, v in d.items()
+            if v not in (None, "", [], {})
+        }
     if isinstance(d, list):
-        return [_clean(x) for x in d]
+        return [clean_manifest(x) for x in d]
     return d
+
+
+_clean = clean_manifest
 
 
 def pool_to_dict(pool: InferencePool) -> dict:

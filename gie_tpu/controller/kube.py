@@ -139,6 +139,20 @@ class KubeClusterClient:
                 return None
             raise
 
+    def patch_pool_status(self, namespace: str, name: str,
+                          status: api.InferencePoolStatus) -> None:
+        patch_pool_status(self._custom, namespace, name, status)
+
+    def service_exists(self, namespace: str, name: str) -> bool:
+        """EPP Service resolution for the ResolvedRefs condition."""
+        try:
+            self._core.read_namespaced_service(name, namespace)
+            return True
+        except Exception as e:
+            if getattr(e, "status", None) == 404:
+                return False
+            raise
+
     # -- watch fan-out (reconciler wiring seam) ----------------------------
 
     def subscribe(self, fn: Callable[[WatchEvent], None]) -> None:
@@ -184,6 +198,45 @@ class KubeClusterClient:
                         return
             except Exception:
                 self._stop.wait(1.0)
+
+
+def pool_status_to_dict(status: api.InferencePoolStatus) -> dict:
+    """InferencePoolStatus -> the status-subresource patch body's `status`
+    value (manifest-shaped, empties pruned like api.pool_to_dict).
+
+    metav1.Condition requires lastTransitionTime: conditions built without
+    one (the shared desired_parent_statuses computation leaves it empty)
+    are stamped here so the patch is admitted by clusters running the
+    upstream CRD, not just this repo's committed one."""
+    import dataclasses as _dc
+    import datetime as _dt
+
+    now = (
+        _dt.datetime.now(_dt.timezone.utc)
+        .replace(microsecond=0)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )
+    parents = []
+    for p in status.parents:
+        d = _dc.asdict(p)
+        for cond in d.get("conditions", []):
+            if not cond.get("lastTransitionTime"):
+                cond["lastTransitionTime"] = now
+        parents.append(d)
+    return api.clean_manifest({"parents": parents})
+
+
+def patch_pool_status(custom_api, namespace: str, name: str,
+                      status: api.InferencePoolStatus) -> None:
+    """Publish pool status through the status subresource (the write path
+    of the reference's per-parent condition choreography,
+    api/v1/inferencepool_types.go:192-379). `custom_api` is duck-typed
+    (kubernetes CustomObjectsApi or a test fake)."""
+    custom_api.patch_namespaced_custom_object_status(
+        api.GROUP, api.VERSION, namespace, "inferencepools", name,
+        {"status": pool_status_to_dict(status)},
+    )
 
 
 def watch_event_from_k8s(ev: dict, kind: str) -> WatchEvent:

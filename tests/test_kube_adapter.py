@@ -2,6 +2,7 @@
 
 import pytest
 
+from gie_tpu.api import types as api
 from gie_tpu.api.types import pool_from_dict
 from gie_tpu.controller.kube import (
     KubeClusterClient,
@@ -89,3 +90,136 @@ def test_client_requires_kubernetes_package():
         pytest.skip("kubernetes installed; ImportError branch unreachable")
     with pytest.raises(ImportError, match="kubernetes"):
         KubeClusterClient("default", "pool")
+
+
+# ---- status writes (VERDICT r1 #7: real-cluster parent conditions) --------
+
+
+class FakeCustomObjectsApi:
+    """Duck-typed stand-in for kubernetes CustomObjectsApi (the same
+    technique the watch-path tests use)."""
+
+    def __init__(self):
+        self.patches: list = []
+
+    def patch_namespaced_custom_object_status(
+            self, group, version, namespace, plural, name, body):
+        self.patches.append(
+            dict(group=group, version=version, namespace=namespace,
+                 plural=plural, name=name, body=body))
+        return body
+
+
+def _pool_with_epp(epp_name="epp-svc"):
+    return api.InferencePool(
+        metadata=api.ObjectMeta(name="pool", namespace="ns"),
+        spec=api.InferencePoolSpec(
+            selector=api.LabelSelector(matchLabels={"app": "m"}),
+            targetPorts=[api.Port(8000)],
+            endpointPickerRef=api.EndpointPickerRef(
+                name=epp_name, port=api.Port(9002)),
+        ),
+    )
+
+
+def test_patch_pool_status_subresource_shape():
+    from gie_tpu.controller.kube import patch_pool_status
+
+    fake = FakeCustomObjectsApi()
+    status = api.InferencePoolStatus(parents=[])
+    ps = api.ParentStatus(parentRef=api.ParentReference(name="gw"))
+    ps.set_condition(api.Condition(
+        api.COND_ACCEPTED, "True", api.REASON_ACCEPTED, "ok"))
+    status.parents.append(ps)
+    patch_pool_status(fake, "ns", "pool", status)
+    assert len(fake.patches) == 1
+    p = fake.patches[0]
+    assert (p["group"], p["version"], p["plural"], p["name"]) == (
+        api.GROUP, "v1", "inferencepools", "pool")
+    parent = p["body"]["status"]["parents"][0]
+    assert parent["parentRef"]["name"] == "gw"
+    cond = parent["conditions"][0]
+    assert cond["type"] == "Accepted" and cond["status"] == "True"
+    # metav1.Condition requires lastTransitionTime: stamped at the patch
+    # boundary when the computation left it empty.
+    assert cond["lastTransitionTime"].endswith("Z")
+    # Empties pruned like pool_to_dict (no namespace="" keys etc.).
+    assert "namespace" not in parent["parentRef"]
+
+
+def test_pool_status_controller_publishes_conditions():
+    from gie_tpu.controller.kube import patch_pool_status
+    from gie_tpu.controller.status import PoolStatusController
+
+    class FakeClient:
+        def __init__(self, pool, services):
+            self.pool = pool
+            self.services = services
+            self.custom = FakeCustomObjectsApi()
+
+        def get_pool(self, ns, name):
+            return self.pool
+
+        def patch_pool_status(self, ns, name, status):
+            patch_pool_status(self.custom, ns, name, status)
+
+    client = FakeClient(_pool_with_epp(), services={("ns", "epp-svc")})
+    ctrl = PoolStatusController(
+        client, "ns", "pool", parents=["gw-a", "gw-b"],
+        service_exists=lambda ns, name: (ns, name) in client.services)
+    assert ctrl.reconcile()
+    body = client.custom.patches[-1]["body"]["status"]
+    assert [p["parentRef"]["name"] for p in body["parents"]] == [
+        "gw-a", "gw-b"]
+    for parent in body["parents"]:
+        conds = {c["type"]: c for c in parent["conditions"]}
+        assert conds["Accepted"]["status"] == "True"
+        assert conds["ResolvedRefs"]["status"] == "True"
+
+    # EPP Service missing -> ResolvedRefs False / InvalidExtensionRef
+    # (reference inferencepool_types.go:321-347 reason set).
+    client.services.clear()
+    ctrl.reconcile()
+    body = client.custom.patches[-1]["body"]["status"]
+    conds = {c["type"]: c for c in body["parents"][0]["conditions"]}
+    assert conds["ResolvedRefs"]["status"] == "False"
+    assert conds["ResolvedRefs"]["reason"] == api.REASON_INVALID_EXTENSION_REF
+
+    # Pool gone -> no patch, returns False.
+    client.pool = None
+    n = len(client.custom.patches)
+    assert not ctrl.reconcile()
+    assert len(client.custom.patches) == n
+
+
+def test_status_controller_preserves_export_entry():
+    """The export controller's InferencePoolImport parent entry must
+    survive gateway-status reconciliation (shared merge semantics with the
+    harness)."""
+    from gie_tpu.controller.status import PoolStatusController
+
+    pool = _pool_with_epp()
+    exp = api.ParentStatus(parentRef=api.ParentReference(
+        name="pool", namespace="ns", group=api.GROUP_X,
+        kind="InferencePoolImport"))
+    exp.set_condition(api.Condition(
+        api.COND_EXPORTED, "True", api.REASON_EXPORTED, "exported"))
+    pool.status.parents.append(exp)
+
+    captured = {}
+
+    class FakeClient:
+        def get_pool(self, ns, name):
+            return pool
+
+        def patch_pool_status(self, ns, name, status):
+            captured["status"] = status
+
+    ctrl = PoolStatusController(
+        FakeClient(), "ns", "pool", parents=["gw"],
+        service_exists=lambda ns, name: True)
+    assert ctrl.reconcile()
+    kinds = [p.parentRef.kind for p in captured["status"].parents]
+    assert "InferencePoolImport" in kinds
+    names = [p.parentRef.name for p in captured["status"].parents]
+    assert "gw" in names
