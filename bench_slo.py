@@ -1,0 +1,103 @@
+"""SLO-aware admission benchmark: the latency predictor's payoff.
+
+A heterogeneous fleet (4 fast pods + 4 pods at ~1/5 prefill speed — mixed
+accelerator generations / degraded hardware) under a tight TTFT SLO. The
+metric-only heuristic blend cannot tell a fast pod's queue of 5 from a slow
+pod's (the scraped gauges describe LOAD, not SPEED), so it keeps feeding
+slow pods and produces late answers that count for nothing. The online
+latency predictor (per-endpoint embedding + load features, trained from
+served feedback) predicts each pick's TTFT; flow control sheds only the
+requests that already cannot meet their SLO, saving their prefill capacity
+for requests that can.
+
+predictor-off: tuned heuristic blend, no admission control.
+predictor-on:  same blend + predictive SLO admission (the EPP-side
+               equivalent is BatchingTPUPicker._slo_admission driven by the
+               x-gateway-inference-ttft-slo-ms header).
+
+Prints ONE JSON line; vs_baseline is the predictor-on/off goodput ratio at
+HIGHER SLO attainment (reference seam: docs/proposals/006-scheduler/
+README.md:27-36 SLO dimension + :156 assumed load).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _force_platform() -> None:
+    platform = os.environ.get("GIE_GOODPUT_PLATFORM", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    active = jax.default_backend()
+    if active != platform:
+        print(
+            f"WARNING: requested platform '{platform}' but backend is "
+            f"'{active}' (JAX initialized before this script ran)",
+            file=sys.stderr,
+        )
+
+
+def run_pair(duration_s: float = 30.0, seed: int = 0):
+    """(predictor-off stats, predictor-on stats) on the same workload."""
+    import jax.numpy as jnp
+
+    from gie_tpu.models.latency import LatencyPredictor, OnlineTrainer
+    from gie_tpu.sched import ProfileConfig, Scheduler, Weights
+    from gie_tpu.simulator import StubConfig
+    from gie_tpu.simulator.cluster import SimCluster, WorkloadConfig
+
+    fast = StubConfig(max_running=8, prefill_tokens_per_s=4000.0,
+                      decode_tokens_per_s=50.0, prefix_cache_chunks=2048)
+    slow = StubConfig(max_running=8, prefill_tokens_per_s=800.0,
+                      decode_tokens_per_s=20.0, prefix_cache_chunks=2048)
+    fleet = [fast] * 4 + [slow] * 4
+    wl = WorkloadConfig(arrival_qps=90.0, n_sessions=64,
+                        system_prompt_bytes=8192, user_suffix_bytes=128,
+                        decode_tokens_mean=32.0, ttft_slo_s=1.5)
+    cfg = ProfileConfig(picker="sinkhorn", load_decay=0.95, load_norm=8.0,
+                        queue_norm=16.0, sinkhorn_rounding_temp=0.05)
+    weights = Weights(queue=jnp.float32(2.0), kv_cache=jnp.float32(1.0),
+                      prefix=jnp.float32(4.0), lora=jnp.float32(1.0),
+                      assumed_load=jnp.float32(1.5),
+                      latency=jnp.float32(0.0), session=jnp.float32(8.0))
+
+    def leg(slo_admission: bool):
+        trainer = (OnlineTrainer(LatencyPredictor(), batch_size=64,
+                                 seed=seed)
+                   if slo_admission else None)
+        cluster = SimCluster(n_pods=8, stub_cfg=fleet, seed=seed)
+        return cluster.run(
+            "tpu", wl, duration_s=duration_s,
+            scheduler=Scheduler(cfg, weights=weights),
+            trainer=trainer, train_every_s=0.5,
+            slo_admission=slo_admission,
+        )
+
+    return leg(False), leg(True)
+
+
+def main() -> None:
+    _force_platform()
+    off, on = run_pair()
+    for label, s in (("predictor-off", off), ("predictor-on", on)):
+        print(
+            f"{label:14s} goodput={s.goodput_tokens_per_s:7.1f} tok/s "
+            f"slo={s.slo_attainment:.3f} shed={s.shed} "
+            f"p99={s.ttft_p99_s:.2f}s",
+            file=sys.stderr,
+        )
+    ratio = on.goodput_tokens_per_s / max(off.goodput_tokens_per_s, 1e-9)
+    print(json.dumps({
+        "metric": "slo_goodput_predictor_on_vs_off",
+        "value": round(on.goodput_tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(ratio, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -345,6 +345,31 @@ def pool_to_dict(pool: InferencePool) -> dict:
     return _clean(d)
 
 
+def _status_from_dict(status: dict) -> InferencePoolStatus:
+    """Parse status.parents (needed so controllers can carry forward
+    lastTransitionTime instead of re-stamping unchanged conditions)."""
+    parents = []
+    for p in status.get("parents", []) or []:
+        ref = p.get("parentRef", {}) or {}
+        ps = ParentStatus(parentRef=ParentReference(
+            name=ref.get("name", ""),
+            group=ref.get("group", DEFAULT_PARENT_GROUP),
+            kind=ref.get("kind", DEFAULT_PARENT_KIND),
+            namespace=ref.get("namespace", ""),
+        ))
+        for c in p.get("conditions", []) or []:
+            ps.conditions.append(Condition(
+                type=c.get("type", ""),
+                status=c.get("status", ""),
+                reason=c.get("reason", ""),
+                message=c.get("message", ""),
+                observedGeneration=c.get("observedGeneration", 0),
+                lastTransitionTime=c.get("lastTransitionTime", ""),
+            ))
+        parents.append(ps)
+    return InferencePoolStatus(parents=parents)
+
+
 def pool_from_dict(d: dict) -> InferencePool:
     meta = d.get("metadata", {})
     spec = d.get("spec", {})
@@ -382,4 +407,5 @@ def pool_from_dict(d: dict) -> InferencePool:
             ),
         ),
     )
+    pool.status = _status_from_dict(d.get("status", {}) or {})
     return pool

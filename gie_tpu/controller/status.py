@@ -97,15 +97,60 @@ class PoolStatusController:
         self.service_exists = service_exists
 
     def reconcile(self) -> bool:
-        """Compute + patch; returns False when the pool is absent."""
+        """Compute + patch; returns False when the pool is absent.
+
+        metav1.Condition contract: lastTransitionTime moves only when the
+        condition's status actually transitions — unchanged conditions
+        carry their previous timestamp forward, and a patch is skipped
+        entirely when nothing changed (no resourceVersion churn, no
+        spurious watcher wakeups)."""
         pool = self.client.get_pool(self.namespace, self.pool_name)
         if pool is None:
             return False
+        before = pool.status.parents
         computed = desired_parent_statuses(
             pool, self.parents, self.service_exists)
-        pool.status.parents = merge_parent_statuses(
-            pool.status.parents, computed)
+        _carry_transition_times(before, computed)
+        merged = merge_parent_statuses(before, computed)
+        if _conditions_equal(before, merged):
+            return True
+        pool.status.parents = merged
         pool.status.validate()
         self.client.patch_pool_status(
             self.namespace, self.pool_name, pool.status)
         return True
+
+
+def _carry_transition_times(
+    existing: list[api.ParentStatus],
+    computed: list[api.ParentStatus],
+) -> None:
+    """Copy lastTransitionTime from existing conditions whose (parentRef,
+    type) matches and whose status did not change."""
+    by_ref = {
+        (p.parentRef.kind, p.parentRef.name): p for p in existing
+    }
+    for parent in computed:
+        prev = by_ref.get((parent.parentRef.kind, parent.parentRef.name))
+        if prev is None:
+            continue
+        for cond in parent.conditions:
+            old = prev.get_condition(cond.type)
+            if old is not None and old.status == cond.status:
+                cond.lastTransitionTime = old.lastTransitionTime
+
+
+def _conditions_equal(
+    a: list[api.ParentStatus], b: list[api.ParentStatus]
+) -> bool:
+    def key(parents):
+        return [
+            (
+                p.parentRef.kind, p.parentRef.name, p.parentRef.namespace,
+                [(c.type, c.status, c.reason, c.message,
+                  c.lastTransitionTime) for c in p.conditions],
+            )
+            for p in parents
+        ]
+
+    return key(a) == key(b)

@@ -23,6 +23,12 @@ MODEL_NAME_HEADER = "X-Gateway-Model-Name"
 
 # Test-only steering header (reference request.go:84-97 + conformance
 # utils/headers/headers.go:19-22).
+# Per-request TTFT SLO in milliseconds (proposal 006's SLO dimension,
+# reference docs/proposals/006-scheduler/README.md:27-36): with the latency
+# predictor enabled, non-critical requests whose PREDICTED TTFT already
+# misses this bound are shed with 429 instead of wasting capacity.
+TTFT_SLO_MS_KEY = "x-gateway-inference-ttft-slo-ms"
+
 TEST_ENDPOINT_SELECTION_HEADER = "test-epp-endpoint-selection"
 
 # Debug header set on response headers (reference response.go:57-62).

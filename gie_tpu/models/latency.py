@@ -57,15 +57,30 @@ class LatencyPredictorConfig:
     weight_decay: float = 1e-4
 
 
+SLOT_EMBED_DIM = 8
+
+
 class LatencyMLP(nn.Module):
-    """[..., NUM_FEATURES] -> [..., 2] = (ttft_s, tpot_s_per_token)."""
+    """([..., NUM_FEATURES], slot i32[...]) -> [..., 2] = (ttft_s,
+    tpot_s_per_token).
+
+    The slot embedding is the per-endpoint identity signal: scraped gauges
+    (queue, kv) describe load but not SPEED, so on a heterogeneous fleet
+    (mixed accelerator generations / degraded pods) two endpoints with
+    identical metrics can differ severalfold in latency. The learned
+    embedding absorbs that per-pod bias — the reason the predictor can beat
+    the metric-only heuristic blend. Index C.M_MAX is the "unknown
+    endpoint" bucket (padded lanes)."""
 
     hidden: int = 128
     layers: int = 2
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
-        x = x.astype(jnp.bfloat16)
+    def __call__(self, x: jax.Array, slots: jax.Array) -> jax.Array:
+        emb = nn.Embed(C.M_MAX + 1, SLOT_EMBED_DIM, dtype=jnp.bfloat16)(
+            jnp.clip(slots, 0, C.M_MAX)
+        )
+        x = jnp.concatenate([x.astype(jnp.bfloat16), emb], axis=-1)
         for _ in range(self.layers):
             x = nn.Dense(self.hidden, dtype=jnp.bfloat16)(x)
             x = nn.gelu(x)
@@ -120,14 +135,17 @@ class LatencyPredictor:
 
     def init(self, key: jax.Array):
         dummy = jnp.zeros((1, NUM_FEATURES), jnp.float32)
-        return self.module.init(key, dummy)
+        dummy_slots = jnp.zeros((1,), jnp.int32)
+        return self.module.init(key, dummy, dummy_slots)
 
-    def predict(self, params, features: jax.Array) -> jax.Array:
-        return self.module.apply(params, features)
+    def predict(self, params, features: jax.Array,
+                slots: jax.Array) -> jax.Array:
+        return self.module.apply(params, features, slots)
 
-    def request_latency(self, params, features: jax.Array, decode_len: jax.Array):
+    def request_latency(self, params, features: jax.Array,
+                        slots: jax.Array, decode_len: jax.Array):
         """Predicted end-to-end seconds: TTFT + TPOT * decode_len."""
-        pred = self.predict(params, features)          # [..., 2]
+        pred = self.predict(params, features, slots)   # [..., 2]
         return pred[..., 0] + pred[..., 1] * decode_len[..., None]
 
 
@@ -174,7 +192,12 @@ def predictor_score_fn(predictor: LatencyPredictor):
         assumed_load: jax.Array,
     ) -> jax.Array:
         feats = build_features(reqs, eps, assumed_load)
-        latency = predictor.request_latency(params, feats, reqs.decode_len)
+        n = reqs.valid.shape[0]
+        slots = jnp.broadcast_to(
+            jnp.arange(C.M_MAX, dtype=jnp.int32)[None, :], (n, C.M_MAX)
+        )
+        latency = predictor.request_latency(
+            params, feats, slots, reqs.decode_len)
         return jnp.exp(-latency / predictor.cfg.norm_s)
 
     return fn
@@ -201,13 +224,14 @@ def make_train_step(
     in_shardings for the multi-chip path.
     """
 
-    def loss_fn(params, feats, targets, weights):
-        pred = predictor.predict(params, feats)
+    def loss_fn(params, feats, slots, targets, weights):
+        pred = predictor.predict(params, feats, slots)
         se = weights * (pred - targets) ** 2
         return jnp.sum(se) / jnp.maximum(jnp.sum(weights), 1.0)
 
-    def step(params, opt_state, feats, targets, weights):
-        loss, grads = jax.value_and_grad(loss_fn)(params, feats, targets, weights)
+    def step(params, opt_state, feats, slots, targets, weights):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, feats, slots, targets, weights)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -237,9 +261,11 @@ class OnlineTrainer:
         self.params = predictor.init(jax.random.PRNGKey(seed))
         self.opt_state = self.tx.init(self.params)
         self._step = make_train_step(predictor, self.tx)
+        self._predict_jit = jax.jit(predictor.predict)
         self.capacity = capacity
         self.batch_size = batch_size
         self._feats = np.zeros((capacity, NUM_FEATURES), np.float32)
+        self._slots = np.full((capacity,), C.M_MAX, np.int32)
         self._targets = np.zeros((capacity, 2), np.float32)
         self._weights = np.zeros((capacity, 2), np.float32)
         self._n = 0
@@ -253,16 +279,39 @@ class OnlineTrainer:
         features: np.ndarray,
         ttft_s: float,
         tpot_s: Optional[float] = None,
+        slot: int = C.M_MAX,
     ) -> None:
         """Record one observation. Pass tpot_s=None when only TTFT was
         measured — the TPOT head is masked out of the loss for that sample
-        instead of being dragged toward zero."""
+        instead of being dragged toward zero. `slot` is the served
+        endpoint's scheduler slot (feeds the per-endpoint embedding;
+        defaults to the unknown bucket)."""
         with self._lock:
             self._feats[self._head] = features
+            self._slots[self._head] = min(max(int(slot), 0), C.M_MAX)
             self._targets[self._head] = (ttft_s, tpot_s if tpot_s is not None else 0.0)
             self._weights[self._head] = (1.0, 0.0 if tpot_s is None else 1.0)
             self._head = (self._head + 1) % self.capacity
             self._n = min(self._n + 1, self.capacity)
+
+    # Pad host-side prediction batches to a multiple of this so the jitted
+    # forward compiles for a handful of shapes, not one per batch size.
+    PREDICT_PAD = 64
+
+    def predict_ttft(self, features: np.ndarray,
+                     slots: np.ndarray) -> np.ndarray:
+        """Predicted TTFT seconds for (feature row, slot) pairs — the
+        SLO-admission signal (flow control sheds only requests whose
+        predicted TTFT already misses their SLO)."""
+        b = int(features.shape[0])
+        if b == 0:
+            return np.zeros((0,), np.float32)
+        pad = (-b) % self.PREDICT_PAD
+        f = np.pad(np.asarray(features, np.float32), ((0, pad), (0, 0)))
+        s = np.pad(np.asarray(slots, np.int32), (0, pad),
+                   constant_values=C.M_MAX)
+        out = np.asarray(self._predict_jit(self.params, f, s))
+        return out[:b, 0]
 
     def train(self, steps: int = 1) -> Optional[float]:
         """Run up to `steps` SGD steps if enough observations accumulated."""
@@ -271,14 +320,15 @@ class OnlineTrainer:
             if n < self.batch_size:
                 return None
             feats = self._feats[:n].copy()
+            slots = self._slots[:n].copy()
             targets = self._targets[:n].copy()
             weights = self._weights[:n].copy()
         loss = None
         for _ in range(steps):
             idx = self._rng.integers(0, n, self.batch_size)
             self.params, self.opt_state, loss_arr = self._step(
-                self.params, self.opt_state, feats[idx], targets[idx],
-                weights[idx],
+                self.params, self.opt_state, feats[idx], slots[idx],
+                targets[idx], weights[idx],
             )
             loss = float(loss_arr)
         self.last_loss = loss

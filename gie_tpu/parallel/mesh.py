@@ -100,6 +100,7 @@ def sharded_train_step(mesh: Mesh, predictor, tx: optax.GradientTransformation):
     from gie_tpu.models.latency import make_train_step
 
     data = NamedSharding(mesh, P("dp", None))
+    slots = NamedSharding(mesh, P("dp"))
     return make_train_step(
-        predictor, tx, in_shardings=(None, None, data, data, data)
+        predictor, tx, in_shardings=(None, None, data, slots, data, data)
     )

@@ -82,16 +82,16 @@ class ExtProcServerRunner:
                                       dir=opts.predictor_checkpoint_dir)
                 predictor_fn = predictor_score_fn(predictor)
                 predictor_params = self.trainer.params
-                if weights is not None and float(weights.latency) == 0.0:
-                    # The learned column must actually participate in the
-                    # blend; a zero weight would train it for nothing.
-                    import jax.numpy as jnp_
-
-                    weights = weights.replace(latency=jnp_.float32(1.0))
-                    self.log.info(
-                        "predictor enabled: latency weight raised to 1.0 "
-                        "(set weights.latency in --scheduler-config to tune)"
-                    )
+                # The latency weight stays as configured (default 0): the
+                # heterogeneous-fleet benchmark showed the predictor's
+                # payoff is SLO-aware admission (requests carrying
+                # x-gateway-inference-ttft-slo-ms are shed when predicted
+                # TTFT misses the bound), while blending an early,
+                # still-untrained column into the score DILUTES the
+                # heuristics (docs/BENCH_NOTES.md round-2 ablation:
+                # column-only goodput 474 vs 635 baseline vs 1274 with
+                # admission). Opt into the column via weights.latency in
+                # --scheduler-config once trained/restored.
             self.scheduler = Scheduler(
                 cfg,
                 weights=weights,

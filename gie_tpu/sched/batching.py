@@ -202,7 +202,7 @@ class BatchingTPUPicker:
             )
         feedback = getattr(pick_result, "feedback", None)
         if self.trainer is not None and feedback is not None:
-            features, picked_at, picked_hostport = feedback
+            features, slot, picked_at, picked_hostport = feedback
             if served_hostport != picked_hostport:
                 # The data plane failed over to a fallback: the recorded
                 # features describe the PRIMARY endpoint, so training on
@@ -212,7 +212,8 @@ class BatchingTPUPicker:
             # Response headers arrive ~ first token: elapsed approximates
             # TTFT; TPOT is unobservable at this hop (no token counts), so
             # the sample trains the TTFT head only (tpot masked).
-            self.trainer.observe(features, ttft_s=elapsed, tpot_s=None)
+            self.trainer.observe(features, ttft_s=elapsed, tpot_s=None,
+                                 slot=slot)
 
     def close(self) -> None:
         with self._cond:
@@ -369,7 +370,6 @@ class BatchingTPUPicker:
                         grpc.StatusCode.UNAVAILABLE, "no endpoints available"
                     )
                 else:
-                    own_metrics.PICKS.labels(outcome="ok").inc()
                     res = PickResult(endpoint=picked[0], fallbacks=picked[1:])
                     res.assumed_cost = request_cost_host(float(plen[i]))
                     # The cycle charges the RAW primary (profile.py:214-218);
@@ -386,9 +386,69 @@ class BatchingTPUPicker:
                                 0.0,
                                 bool(lora[i] >= 0),
                             ),
+                            slot,  # feeds the per-endpoint embedding
                             time.monotonic(),
                             picked[0],  # primary hostport the features describe
                         )
                     item.result = res
+        # Admission runs BEFORE waiters wake: a shed decision must replace
+        # the result, never race the caller reading it. The "ok" outcome is
+        # counted here — after admission — so a shed pick increments only
+        # "shed", never both.
+        self._slo_admission(batch)
+        for item in batch:
+            if item.result is not None:
+                own_metrics.PICKS.labels(outcome="ok").inc()
             item.event.set()
         return held
+
+    def _slo_admission(self, batch: list[_Pending]) -> None:
+        """Predictive SLO shedding (006 README:27-36 SLO dimension): after
+        the cycle picked, non-critical requests carrying an
+        x-gateway-inference-ttft-slo-ms header whose PREDICTED TTFT on the
+        picked endpoint already misses the bound are shed with 429 — they
+        would only burn prefill capacity to produce a late answer. The
+        charge the cycle added for them is released immediately."""
+        if self.trainer is None:
+            return
+        if getattr(self.trainer, "last_loss", None) is None:
+            # Cold start: the predictor is still at random init (no train
+            # step has run). Shedding on noise would 429 valid traffic —
+            # and shed requests never serve, so an all-SLO workload would
+            # starve the trainer and never leave this state. Admit until
+            # the model has actually fit something.
+            return
+        rows, slots, slos, items = [], [], [], []
+        for i, item in enumerate(batch):
+            if item.result is None or item.result.feedback is None:
+                continue
+            raw = item.req.headers.get(mdkeys.TTFT_SLO_MS_KEY, [""])[0]
+            try:
+                slo_s = float(raw) / 1000.0
+            except (TypeError, ValueError):
+                continue
+            if slo_s <= 0:
+                continue
+            band = _band_for(item.req.headers, self.objective_registry)
+            if band == C.Criticality.CRITICAL:
+                continue
+            features, slot, _, _ = item.result.feedback
+            rows.append(features)
+            slots.append(slot)
+            slos.append(slo_s)
+            items.append(item)
+        if not items:
+            return
+        pred = self.trainer.predict_ttft(np.stack(rows), np.asarray(slots))
+        for j, item in enumerate(items):
+            if pred[j] > slos[j]:
+                res = item.result
+                item.result = None
+                item.error = ShedError()
+                # The cycle charged the pick; the request will not run.
+                if res.charged_slot is not None and res.charged_slot >= 0:
+                    self.scheduler.complete(
+                        np.asarray([res.charged_slot], np.int32),
+                        np.asarray([res.assumed_cost], np.float32),
+                    )
+                own_metrics.PICKS.labels(outcome="shed").inc()

@@ -34,6 +34,7 @@ _PLUGIN_FLAGS = {
     "prefix": "enable_prefix",
     "lora": "enable_lora",
     "saturation": "enable_saturation",
+    "session": "enable_session",
 }
 
 _WEIGHT_FIELDS = {f.name for f in dataclasses.fields(Weights)}
@@ -90,13 +91,16 @@ def load_scheduler_config_file(path: str) -> tuple[ProfileConfig, Weights]:
 
 
 def tuned_profile() -> tuple[ProfileConfig, Weights]:
-    """The round-1 swept profile (see config/scheduler/sinkhorn-tuned.yaml
-    and docs/BENCH_NOTES.md): Sinkhorn OT picker whose capacity constraint
-    lets prefix affinity run high without herding — 2.15x goodput vs the
-    least-kv baseline. The production default when no --scheduler-config
-    overrides it."""
+    """The swept profile (see config/scheduler/sinkhorn-tuned.yaml and
+    docs/BENCH_NOTES.md): Sinkhorn OT picker whose capacity constraint lets
+    prefix affinity run high without herding, plus the round-2
+    consistent-hash session-stickiness column (weight 8.0) that lifts the
+    sim prefix hit rate from 0.72 to ~0.91 — 4.3x mean / 3.8x min goodput
+    vs the least-kv baseline over 5 seeds at 100 qps. The production
+    default when no --scheduler-config overrides it."""
     cfg = ProfileConfig(
-        picker="sinkhorn", load_decay=0.95, load_norm=8.0, queue_norm=16.0
+        picker="sinkhorn", load_decay=0.95, load_norm=8.0, queue_norm=16.0,
+        sinkhorn_rounding_temp=0.05,
     )
     weights = Weights(
         queue=jnp.float32(2.0),
@@ -105,5 +109,6 @@ def tuned_profile() -> tuple[ProfileConfig, Weights]:
         lora=jnp.float32(1.0),
         assumed_load=jnp.float32(1.5),
         latency=jnp.float32(0.0),
+        session=jnp.float32(8.0),
     )
     return cfg, weights

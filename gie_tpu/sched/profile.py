@@ -63,6 +63,8 @@ class ProfileConfig:
     enable_saturation: bool = True
     enable_lora: bool = True
     enable_prefix: bool = True
+    enable_session: bool = True   # consistent-hash session stickiness column
+    session_key_chunks: int = 1   # prompt depth (in chunks) of the session key
     shed_sheddable: bool = True  # 429 sheddable traffic when saturated
     picker: str = "topk"         # "topk" | "random" | "sinkhorn"
     sample_temperature: float = 0.05
@@ -151,6 +153,9 @@ def build_stages(
     if cfg.enable_prefix:
         named["prefix"] = prefix.match_scores(
             state.prefix, reqs, state.tick, max_age=cfg.prefix_max_age)
+    if cfg.enable_session:
+        named["session"] = scorers.session_affinity_score(
+            reqs, eps, key_chunks=cfg.session_key_chunks)
     if cfg.enable_lora:
         named["lora"] = scorers.lora_affinity_score(reqs, eps, membership)
     if predictor_fn is not None:

@@ -60,6 +60,54 @@ def lora_affinity_score(
     )
 
 
+def session_affinity_score(
+    reqs: RequestBatch,
+    eps: EndpointBatch,
+    *,
+    key_chunks: int = 1,
+) -> jax.Array:
+    """Consistent-hash session stickiness -> f32[N, M_MAX].
+
+    The prefix column (an approximate device-resident index) loses affinity
+    to slot collisions, staleness, and same-batch splits; this column is
+    index-FREE stickiness: a rendezvous (highest-random-weight) hash of the
+    session key over the valid endpoints. Requests sharing a prompt prefix
+    always agree on the same preference chain, before any cache is warm and
+    regardless of index state — the deterministic half of the reference's
+    load-blended prefix matching (reference
+    docs/proposals/0602-prefix-cache/README.md:119-122, "session
+    stickiness" via consistent prefix->server mapping).
+
+    Key = the chunk-hash chain at depth `key_chunks` (chained CRC: chunk j
+    incorporates chunks 0..j), i.e. the identity of the first
+    key_chunks*CHUNK_BYTES bytes of the prompt — the session/system-prompt
+    fingerprint. Scores: 1.0 for the rendezvous winner, and a uniform
+    pseudo-random value in [0, 0.5) for the rest, so the failover ORDER is
+    also deterministic per session. Invalid endpoints score 0.
+    """
+    depth = jnp.clip(
+        jnp.minimum(jnp.int32(key_chunks), reqs.n_chunks) - 1,
+        0, C.MAX_CHUNKS - 1,
+    )                                                       # i32[N]
+    key = jnp.take_along_axis(
+        reqs.chunk_hashes, depth[:, None], axis=1
+    )[:, 0].astype(jnp.uint32)                              # u32[N]
+
+    slots = jnp.arange(C.M_MAX, dtype=jnp.uint32)
+    h = key[:, None] ^ (slots[None, :] * jnp.uint32(0x9E3779B1))
+    # splitmix32-style avalanche so slot order carries no structure.
+    h = (h ^ (h >> 16)) * jnp.uint32(0x7FEB352D)
+    h = (h ^ (h >> 15)) * jnp.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    h = jnp.where(eps.valid[None, :], h, jnp.uint32(0))
+    frac = h.astype(jnp.float32) / jnp.float32(2**32)       # [0, 1)
+    winner = h == jnp.max(h, axis=-1, keepdims=True)
+    score = jnp.where(winner, 1.0, 0.5 * frac)
+    no_session = (reqs.n_chunks <= 0) | (key == 0)
+    score = jnp.where(no_session[:, None], 1.0, score)
+    return jnp.where(eps.valid[None, :], score, 0.0)
+
+
 def assumed_load_score(assumed_load: jax.Array, *, load_norm: float) -> jax.Array:
     """Penalty column for in-flight assumed load (reference
     docs/proposals/006-scheduler/README.md:156 assumed-load accounting):

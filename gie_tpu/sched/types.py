@@ -164,6 +164,13 @@ class Weights:
     lora: jax.Array
     assumed_load: jax.Array  # penalty weight on in-flight assumed load
     latency: jax.Array       # learned TTFT/TPOT predictor column
+    # Consistent-hash session stickiness (index-free prefix affinity);
+    # defaulted so pre-existing explicit Weights(...) constructions keep
+    # their meaning (column off unless weighted in). numpy scalar, not jnp:
+    # import-time device constants are banned (they capture into dispatch).
+    session: jax.Array = flax.struct.field(
+        default_factory=lambda: np.float32(0.0)
+    )
 
     @staticmethod
     def default() -> "Weights":
@@ -174,6 +181,7 @@ class Weights:
             lora=jnp.float32(1.0),
             assumed_load=jnp.float32(1.0),
             latency=jnp.float32(0.0),
+            session=jnp.float32(0.0),
         )
 
 

@@ -66,16 +66,29 @@ class RunStats:
     slo_attainment: float
     prefix_hit_rate: float
     completed: int
+    shed: int = 0
 
 
 class SimCluster:
     def __init__(
         self,
         n_pods: int = 8,
-        stub_cfg: StubConfig = StubConfig(),
+        stub_cfg: StubConfig | list[StubConfig] = StubConfig(),
         seed: int = 0,
     ):
-        self.stubs = [VLLMStub(stub_cfg, name=f"pod-{i}") for i in range(n_pods)]
+        # A list of StubConfigs (one per pod) models a HETEROGENEOUS fleet
+        # (mixed accelerator generations / degraded pods) — the workload
+        # where the latency predictor's per-endpoint embedding earns its
+        # weight over metric-only heuristics.
+        if isinstance(stub_cfg, list):
+            if len(stub_cfg) != n_pods:
+                raise ValueError("need one StubConfig per pod")
+            cfgs = stub_cfg
+        else:
+            cfgs = [stub_cfg] * n_pods
+        self.stubs = [
+            VLLMStub(cfg, name=f"pod-{i}") for i, cfg in enumerate(cfgs)
+        ]
         self.n = n_pods
         self.rng = np.random.default_rng(seed)
         self.store = MetricsStore()
@@ -109,6 +122,7 @@ class SimCluster:
         scheduler: Optional[Scheduler] = None,
         trainer=None,
         train_every_s: float = 1.0,
+        slo_admission: bool = False,
     ) -> RunStats:
         wl = workload
         sessions = [
@@ -123,6 +137,7 @@ class SimCluster:
         next_scrape = 0.0
         next_train = train_every_s
         completions = []
+        shed = 0
         # (pod_slot, stub_rid) -> pick-time feature row for online training
         # (BASELINE configs[3]: the predictor learns from served timings).
         feature_log: dict[tuple[int, int], np.ndarray] = {}
@@ -160,20 +175,57 @@ class SimCluster:
                     # trains on a different feature space than it scores.
                     loads = (scheduler.snapshot_assumed_load()
                              if scheduler is not None else None)
-                for prompt, decode, lora, pod in zip(prompts, decodes, loras, picks):
-                    rid = self.stubs[pod].submit(
-                        prompt, decode_tokens=decode, lora=lora)
-                    if trainer is not None:
+
+                    def feats_for(pod, prompt, decode, lora):
                         row = self.store._metrics[pod].copy()
                         row[C.Metric.METRICS_AGE_S] = max(
                             clock - self.store._scraped_at[pod], 0.0)
-                        feature_log[(pod, rid)] = host_features(
+                        return host_features(
                             row,
                             float(loads[pod]) if loads is not None else 0.0,
                             float(len(prompt)),
                             float(decode),
                             lora is not None,
                         )
+
+                admitted = [True] * n_new
+                precomputed_rows = None
+                if slo_admission and trainer is not None:
+                    # Predictive SLO admission (006 README:27-36): shed
+                    # arrivals whose predicted TTFT on their picked pod
+                    # already misses the SLO — a late answer burns prefill
+                    # capacity for zero goodput. Released charges mirror
+                    # the EPP's _slo_admission path.
+                    precomputed_rows = [
+                        feats_for(pod, prompt, decode, lora)
+                        for prompt, decode, lora, pod in zip(
+                            prompts, decodes, loras, picks)
+                    ]
+                    pred = trainer.predict_ttft(
+                        np.stack(precomputed_rows),
+                        np.asarray(picks, np.int32))
+                    for i, pod in enumerate(picks):
+                        if pred[i] > wl.ttft_slo_s:
+                            admitted[i] = False
+                            shed += 1
+                            if scheduler is not None and policy == "tpu":
+                                scheduler.complete(
+                                    np.asarray([pod], np.int32),
+                                    np.asarray([request_cost_host(
+                                        float(len(prompts[i])),
+                                        decodes[i])], np.float32),
+                                )
+                for i, (prompt, decode, lora, pod) in enumerate(
+                        zip(prompts, decodes, loras, picks)):
+                    if not admitted[i]:
+                        continue
+                    rid = self.stubs[pod].submit(
+                        prompt, decode_tokens=decode, lora=lora)
+                    if trainer is not None:
+                        feature_log[(pod, rid)] = (
+                            precomputed_rows[i]
+                            if precomputed_rows is not None
+                            else feats_for(pod, prompt, decode, lora))
 
             # --- advance the fleet ----------------------------------------
             for slot, stub in enumerate(self.stubs):
@@ -183,7 +235,8 @@ class SimCluster:
                         feats = feature_log.pop((slot, comp.rid), None)
                         if feats is not None:
                             trainer.observe(
-                                feats, ttft_s=comp.ttft_s, tpot_s=comp.tpot_s)
+                                feats, ttft_s=comp.ttft_s,
+                                tpot_s=comp.tpot_s, slot=slot)
                     if scheduler is not None and policy == "tpu":
                         # Release exactly what pick time charged.
                         cost = request_cost_host(
@@ -218,6 +271,7 @@ class SimCluster:
                 np.mean([c.hit_fraction for c in completions])
             ),
             completed=len(completions),
+            shed=shed,
         )
 
     # ------------------------------------------------------------------ #

@@ -30,15 +30,17 @@ def main() -> None:
     per_host = 8
     rng = np.random.default_rng(pid)
     feats = rng.uniform(0, 1, (per_host, NUM_FEATURES)).astype(np.float32)
+    slots = rng.integers(0, 8, (per_host,)).astype(np.int32)
     targets = rng.uniform(0, 1, (per_host, 2)).astype(np.float32)
     weights = np.ones((per_host, 2), np.float32)
 
     g_feats = multihost.host_local_batch_to_global(mesh, feats)
+    g_slots = multihost.host_local_batch_to_global(mesh, slots)
     g_targets = multihost.host_local_batch_to_global(mesh, targets)
     g_weights = multihost.host_local_batch_to_global(mesh, weights)
 
-    params, opt_state, loss = step(params, opt_state, g_feats, g_targets,
-                                   g_weights)
+    params, opt_state, loss = step(params, opt_state, g_feats, g_slots,
+                                   g_targets, g_weights)
     jax.block_until_ready(loss)
     print(f"MULTIHOST_OK pid={pid} devices={len(jax.devices())} "
           f"loss={float(loss):.6f}", flush=True)

@@ -122,3 +122,54 @@ def test_release_skipped_when_primary_was_evicted():
         assert list(after) == list(before)  # no spurious release anywhere
     finally:
         picker.close()
+
+
+def test_slo_admission_sheds_and_releases_charge():
+    """EPP-side predictive SLO admission: a non-critical request carrying
+    x-gateway-inference-ttft-slo-ms whose predicted TTFT misses the bound
+    is shed with 429, and the charge the cycle added is released; critical
+    requests are never shed."""
+    import numpy as np
+    from gie_tpu.models.latency import LatencyPredictor, OnlineTrainer
+
+    sched = Scheduler(ProfileConfig(load_decay=1.0))
+    ms = MetricsStore()
+    ds = Datastore()
+    ds.pool_set(EndpointPool({"app": "x"}, [8000], "default"))
+    for i in range(2):
+        ds.pod_update_or_add(
+            Pod(name=f"p{i}", labels={"app": "x"}, ip=f"10.9.1.{i + 1}"))
+    trainer = OnlineTrainer(LatencyPredictor())
+    trainer.predict_ttft = lambda feats, slots: np.full(
+        (len(slots),), 9.9, np.float32)  # everything predicted hopeless
+    picker = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.02,
+                               trainer=trainer)
+    try:
+        slo_headers = {mdkeys.TTFT_SLO_MS_KEY: ["500"]}
+        # Cold start (no train step yet): admission must NOT engage —
+        # random-init predictions would 429 valid traffic.
+        cold = picker.pick(PickRequest(headers=slo_headers, body=b"x"),
+                           ds.endpoints())
+        assert ":" in cold.endpoint
+        picker.observe_served(
+            cold.endpoint, SimpleNamespace(pick_result=cold))
+        trainer.last_loss = 0.01  # model has fit something
+        with pytest.raises(Exception) as exc:
+            picker.pick(PickRequest(headers=slo_headers, body=b"x"),
+                        ds.endpoints())
+        assert type(exc.value).__name__ == "ShedError"
+        # The shed request's charge was released.
+        assert float(sched.snapshot_assumed_load().sum()) == pytest.approx(
+            0.0, abs=1e-6)
+        # No SLO header -> served normally despite hopeless predictions.
+        ok = picker.pick(PickRequest(headers={}, body=b"x"), ds.endpoints())
+        assert ":" in ok.endpoint
+        # CRITICAL requests bypass admission.
+        crit = picker.pick(
+            PickRequest(headers={**slo_headers,
+                                 mdkeys.OBJECTIVE_KEY: ["critical"]},
+                        body=b"x"),
+            ds.endpoints())
+        assert ":" in crit.endpoint
+    finally:
+        picker.close()

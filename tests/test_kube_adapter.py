@@ -176,6 +176,13 @@ def test_pool_status_controller_publishes_conditions():
         assert conds["Accepted"]["status"] == "True"
         assert conds["ResolvedRefs"]["status"] == "True"
 
+    # No transition -> no patch (metav1.Condition lastTransitionTime moves
+    # only on status change; unchanged reconciles must not churn
+    # resourceVersion).
+    n_before = len(client.custom.patches)
+    assert ctrl.reconcile()
+    assert len(client.custom.patches) == n_before
+
     # EPP Service missing -> ResolvedRefs False / InvalidExtensionRef
     # (reference inferencepool_types.go:321-347 reason set).
     client.services.clear()

@@ -21,7 +21,8 @@ def test_predictor_forward_shapes_positive():
     p = LatencyPredictor()
     params = p.init(jax.random.PRNGKey(0))
     feats = jnp.zeros((4, 7, NUM_FEATURES))
-    out = p.predict(params, feats)
+    slots = jnp.zeros((4, 7), jnp.int32)
+    out = p.predict(params, feats, slots)
     assert out.shape == (4, 7, 2)
     assert (np.asarray(out) >= 0).all()  # softplus output
 
@@ -156,8 +157,9 @@ def test_picker_feedback_trains_predictor():
                 ds.endpoints(),
             )
             assert res.feedback is not None
-            feats, _, hostport = res.feedback
+            feats, slot, _, hostport = res.feedback
             assert hostport == res.endpoint
+            assert slot == res.charged_slot
             assert feats.shape == (NUM_FEATURES,)
 
             class Ctx:
@@ -182,12 +184,15 @@ def test_tpot_head_masked_when_unobserved():
     for _ in range(40):
         trainer.train(steps=5)
     feats = rng.uniform(0, 1, (16, NUM_FEATURES)).astype(np.float32)
-    tpot_before = float(np.mean(np.asarray(p.predict(trainer.params, feats))[:, 1]))
+    eval_slots = np.zeros((16,), np.int32)
+    tpot_before = float(np.mean(np.asarray(
+        p.predict(trainer.params, feats, eval_slots))[:, 1]))
     # Now flood with TTFT-only samples (tpot unobserved).
     for _ in range(512):
         f = rng.uniform(0, 1, NUM_FEATURES).astype(np.float32)
         trainer.observe(f, ttft_s=0.5, tpot_s=None)
     for _ in range(40):
         trainer.train(steps=5)
-    tpot_after = float(np.mean(np.asarray(p.predict(trainer.params, feats))[:, 1]))
+    tpot_after = float(np.mean(np.asarray(
+        p.predict(trainer.params, feats, eval_slots))[:, 1]))
     assert tpot_after > tpot_before * 0.5  # head not collapsed toward zero
