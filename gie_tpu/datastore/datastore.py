@@ -82,6 +82,8 @@ class Datastore:
         self._max_slots = max_slots
         # Slots freed under the lock, awaiting callback delivery outside it.
         self._pending_reclaims: list[int] = []
+        # Admissions refused because every slot was taken (degrade mode).
+        self._overflow = 0
 
     # ---- pool ------------------------------------------------------------
 
@@ -93,6 +95,7 @@ class Datastore:
         """Install/replace the pool spec. If the selector or targetPorts
         changed, resync all endpoints from `pod_lister` (reference
         datastore.go:119-150 + podResyncAll :267-304)."""
+        admit: list[Pod] = []
         with self._lock:
             old = self._pool
             self._pool = pool
@@ -100,9 +103,19 @@ class Datastore:
                 old.selector != pool.selector
                 or old.target_ports != pool.target_ports
             )
-            if (old is None or changed) and pod_lister is not None:
-                self._resync_all(pod_lister())
+            need_resync = (old is None or changed) and pod_lister is not None
+            if need_resync:
+                admit = self._resync_evictions(pod_lister())
+        # Two-phase resync: evictions' reclaim callbacks must run (outside
+        # the lock) BEFORE admissions, or at capacity the freed slots are
+        # still unallocatable and the admitted pods would be skipped with
+        # no later event to retry them.
         self._drain_reclaims()
+        if admit:
+            with self._lock:
+                for pod in admit:
+                    self._pod_update_or_add_locked(pod)
+            self._drain_reclaims()
 
     def pool_get(self) -> EndpointPool:
         with self._lock:
@@ -142,6 +155,8 @@ class Datastore:
             if port in active:
                 if existing is None:
                     slot = self._alloc_slot()
+                    if slot is None:
+                        continue  # capacity degrade: skip, keep reconciling
                     ep = Endpoint(
                         name=f"{pod.name}-rank-{idx}",
                         namespace=pod.namespace,
@@ -213,12 +228,23 @@ class Datastore:
     def _key(namespace: str, pod_name: str, rank: int) -> str:
         return f"{namespace}/{pod_name}-rank-{rank}"
 
-    def _alloc_slot(self) -> int:
+    def _alloc_slot(self) -> Optional[int]:
+        """Pop the lowest free slot, or None when capacity is exhausted.
+        Exhaustion is a DEGRADE, not a crash: the reconciler keeps running,
+        the overflowed endpoint is simply not admitted until churn frees a
+        slot (it re-enters via the next watch event / resync), and
+        overflow_count() surfaces the condition for alerting."""
         if not self._free_slots:
-            raise RuntimeError(
-                f"endpoint count exceeds scheduler capacity M_MAX={self._max_slots}"
-            )
+            self._overflow += 1
+            return None
         return heapq.heappop(self._free_slots)
+
+    def overflow_count(self) -> int:
+        """How many endpoint admissions were refused for lack of slots
+        since startup (monotonic; nonzero means the pool outgrew
+        max_slots and needs a bigger M_MAX build or fewer ranks)."""
+        with self._lock:
+            return self._overflow
 
     def _remove_endpoint(self, key: str) -> None:
         ep = self._endpoints.pop(key)
@@ -255,21 +281,28 @@ class Datastore:
             with self._lock:
                 heapq.heappush(self._free_slots, slot)
 
-    def _resync_all(self, pods: Iterable[Pod]) -> None:
-        """Full diff against the lister (reference podResyncAll,
-        datastore.go:267-304): admit matching+ready pods, evict the rest."""
+    def _resync_evictions(self, pods: Iterable[Pod]) -> list[Pod]:
+        """Eviction phase of the full resync (reference podResyncAll,
+        datastore.go:267-304): evict endpoints of non-matching pods and
+        return the matching+ready pods for the caller's admission phase.
+        Split in two because at capacity the evicted slots only become
+        allocatable after their reclaim callbacks run (outside the lock) —
+        admitting in the same locked pass would skip endpoints that no
+        later watch event would retry."""
         assert self._pool is not None
-        matching: set[str] = set()
         from gie_tpu.utils.podutil import is_pod_ready
 
+        admit: list[Pod] = []
+        matching: set[str] = set()
         for pod in pods:
             labels_match = all(
                 pod.labels.get(k) == v for k, v in self._pool.selector.items()
             )
             if labels_match and is_pod_ready(pod):
                 matching.add(f"{pod.namespace}/{pod.name}")
-                self._pod_update_or_add_locked(pod)
+                admit.append(pod)
         for key in list(self._endpoints):
             ep = self._endpoints[key]
             if f"{ep.namespace}/{ep.pod_name}" not in matching:
                 self._remove_endpoint(key)
+        return admit

@@ -111,6 +111,12 @@ def main(argv=None) -> int:
              "--kubeconfig); requires the kubernetes package",
     )
     parser.add_argument("--kubeconfig", default=None)
+    parser.add_argument(
+        "--publish-status-parents", default=None,
+        help="comma-separated Gateway names to publish InferencePool "
+             "parent status for (Accepted/ResolvedRefs conditions through "
+             "the status subresource); kube mode only",
+    )
     args = parser.parse_args(argv)
     opts = Options.from_args(args)
     opts.validate()
@@ -140,6 +146,30 @@ def main(argv=None) -> int:
         kube_client.start()  # watches begin after reconcilers subscribe
     runner.start()
 
+    status_stop = None
+    if kube_client is not None and args.publish_status_parents:
+        # Periodic parent-condition publication (controller/status.py):
+        # unchanged cycles skip the patch, so the loop is churn-free.
+        from gie_tpu.controller.status import PoolStatusController
+
+        status_ctrl = PoolStatusController(
+            kube_client, opts.pool_namespace, opts.pool_name,
+            parents=[p.strip()
+                     for p in args.publish_status_parents.split(",")
+                     if p.strip()],
+            service_exists=kube_client.service_exists,
+        )
+        status_stop = threading.Event()
+
+        def status_loop():
+            while not status_stop.wait(10.0):
+                try:
+                    status_ctrl.reconcile()
+                except Exception as e:  # status must never take us down
+                    log.error("pool status publication failed", err=e)
+
+        threading.Thread(target=status_loop, daemon=True).start()
+
     stop = threading.Event()
 
     def on_signal(signum, frame):
@@ -150,6 +180,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, on_signal)
     log.info("serving", pool=opts.pool_name)
     stop.wait()
+    if status_stop is not None:
+        status_stop.set()
     if kube_client is not None:
         kube_client.stop()
     runner.stop()

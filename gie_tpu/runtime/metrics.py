@@ -29,6 +29,12 @@ BATCH_SIZE = prom.Histogram(
 STREAMS = prom.Gauge(
     "gie_active_streams", "Open ext-proc streams", registry=REGISTRY
 )
+SLOT_OVERFLOW = prom.Gauge(
+    "gie_endpoint_slot_overflow_total",
+    "Endpoint admissions refused because every scheduler slot (M_MAX) was "
+    "taken — the pool outgrew the compiled capacity",
+    registry=REGISTRY,
+)
 
 
 def start_metrics_server(port: int) -> None:

@@ -23,6 +23,7 @@ from gie_tpu.controller.reconcilers import (
     wire,
 )
 from gie_tpu.datastore import Datastore
+from gie_tpu.sched import constants as C
 from gie_tpu.extproc.server import StreamingServer
 from gie_tpu.extproc.service import add_extproc_service
 from gie_tpu.metricsio import MetricsStore
@@ -107,6 +108,7 @@ class ExtProcServerRunner:
         )
         self.datastore = Datastore(on_slot_reclaimed=self._slot_reclaimed)
         self._attach_lock = threading.Lock()
+        self._overflow_logged = 0
         self.picker = BatchingTPUPicker(
             self.scheduler,
             self.datastore,
@@ -167,6 +169,16 @@ class ExtProcServerRunner:
             self.scraper.attach(
                 ep.slot, f"http://{ep.hostport}/metrics", self.mapping
             )
+        overflow = self.datastore.overflow_count()
+        own_metrics.SLOT_OVERFLOW.set(overflow)
+        if overflow > self._overflow_logged:
+            # Capacity exhaustion must be operator-visible: some pods are
+            # receiving no traffic until churn frees slots or M_MAX grows.
+            self.log.error(
+                "endpoint capacity exhausted: admissions refused",
+                refused=overflow, m_max=C.M_MAX,
+            )
+            self._overflow_logged = overflow
 
     # ---------------------------------------------------------------------
 

@@ -1,0 +1,114 @@
+"""Informer/lister cached-client layer (SURVEY C3, reference client-go/)."""
+
+from gie_tpu.api import types as api
+from gie_tpu.api.informers import SharedInformerFactory
+from gie_tpu.controller.cluster import FakeCluster
+from gie_tpu.datastore.objects import Pod
+
+
+def make_pool(name="pool"):
+    return api.InferencePool(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.InferencePoolSpec(
+            selector=api.LabelSelector(matchLabels={"app": "m"}),
+            targetPorts=[api.Port(8000)],
+            endpointPickerRef=api.EndpointPickerRef(
+                name="epp", port=api.Port(9002)),
+        ),
+    )
+
+
+def setup():
+    cluster = FakeCluster()
+    cluster.apply_pool(make_pool())
+    cluster.apply_pod(Pod(name="p0", labels={"app": "m"}, ip="10.0.0.1"))
+    factory = SharedInformerFactory(cluster, "default",
+                                    pool_names=["pool"])
+    return cluster, factory
+
+
+def test_cache_sync_and_listers():
+    cluster, factory = setup()
+    assert not factory.wait_for_cache_sync()
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    pods = factory.pods().lister()
+    pools = factory.pools().lister()
+    assert [p.name for p in pods.list("default")] == ["p0"]
+    assert pools.get("default", "pool").metadata.name == "pool"
+    # Listers read the CACHE: a direct cluster write without an event is
+    # invisible until its watch event lands (cached-read semantics).
+    assert pods.get("default", "p0").ip == "10.0.0.1"
+
+
+def test_watch_events_update_cache_and_fire_handlers():
+    cluster, factory = setup()
+    events = []
+    factory.pods().add_event_handler(
+        lambda t, key, obj: events.append((t, key[1])))
+    factory.start()
+    assert ("ADDED", "p0") in events
+
+    cluster.apply_pod(Pod(name="p1", labels={"app": "m"}, ip="10.0.0.2"))
+    assert ("ADDED", "p1") in events
+    assert factory.pods().lister().get("default", "p1").ip == "10.0.0.2"
+
+    cluster.apply_pod(Pod(name="p1", labels={"app": "m"}, ip="10.0.0.9"))
+    assert ("MODIFIED", "p1") in events
+    assert factory.pods().lister().get("default", "p1").ip == "10.0.0.9"
+
+    cluster.delete_pod("default", "p1")
+    assert ("DELETED", "p1") in events
+    assert factory.pods().lister().get("default", "p1") is None
+
+
+def test_pool_informer_follows_events():
+    cluster, factory = setup()
+    factory.start()
+    pool = make_pool()
+    pool.metadata.labels["tier"] = "gold"
+    cluster.apply_pool(pool)
+    assert factory.pools().lister().get(
+        "default", "pool").metadata.labels["tier"] == "gold"
+    cluster.delete_pool("default", "pool")
+    assert factory.pools().lister().get("default", "pool") is None
+    assert factory.pools().lister().list() == []
+
+
+def test_late_handler_gets_replay():
+    """client-go semantics: a handler added after sync receives synthetic
+    ADDED events for the existing cache contents."""
+    cluster, factory = setup()
+    factory.start()
+    seen = []
+    factory.pods().add_event_handler(
+        lambda t, key, obj: seen.append((t, key[1])))
+    assert seen == [("ADDED", "p0")]
+
+
+def test_start_skips_keys_cached_by_racing_events():
+    """An event landing between subscribe() and start() must not produce a
+    duplicate ADDED or regress the cache to the stale list snapshot."""
+    cluster, factory = setup()
+    events = []
+    factory.pods().add_event_handler(
+        lambda t, key, obj: events.append((t, key[1])))
+    # Simulate the race: the watch delivers a MODIFIED pod before start().
+    cluster.subscribe(factory.pods().on_event)
+    cluster.apply_pod(Pod(name="p0", labels={"app": "m"}, ip="10.0.0.77"))
+    factory.pods().start()
+    assert events.count(("ADDED", "p0")) == 1
+    # Cache kept the fresher watch object, not the list snapshot.
+    assert factory.pods().lister().get("default", "p0").ip == "10.0.0.77"
+
+
+def test_namespace_scoping():
+    """Events outside the factory's namespace are dropped (cache scoped to
+    the pool namespace, reference controller_manager.go:45-68)."""
+    cluster, factory = setup()
+    factory.start()
+    cluster.apply_pod(Pod(name="alien", namespace="other",
+                          labels={"app": "m"}, ip="10.0.9.9"))
+    assert factory.pods().lister().get("other", "alien") is None
+    assert all(p.namespace == "default"
+               for p in factory.pods().lister().list())
