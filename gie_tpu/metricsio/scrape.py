@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 import time
 import urllib.request
-from typing import Callable, Optional
+from typing import Callable, Optional, Union
 
 from prometheus_client.parser import text_string_to_metric_families
 
@@ -31,21 +31,12 @@ def _match(sample, gauge: LabeledGauge) -> bool:
 _DEFAULT_REGISTRY = LoraRegistry()
 
 
-def parse_scrape(
-    text: str, mapping: ServerMapping, lora: Optional[LoraRegistry] = None
-) -> tuple[dict[int, float], list[int], list[int]]:
-    """Prometheus exposition text -> (metric columns, active/waiting LoRA ids).
-
-    LoRA residency follows the vllm:lora_requests_info contract (proposal
-    003:43-57): gauge VALUE is a last-updated timestamp — when several series
-    exist, the freshest wins — and the adapter lists ride in the
-    running_lora_adapters / waiting_lora_adapters labels.
-    """
-    out: dict[int, float] = {}
-    lora_active: list[int] = []
-    lora_waiting: list[int] = []
-    best_lora_ts = float("-inf")
-
+def wanted_columns(
+    mapping: ServerMapping,
+) -> list[tuple[int, LabeledGauge]]:
+    """The (Metric column, gauge) table one server mapping scrapes —
+    shared by the pure-Python loop and the native scanner's query spec so
+    the two paths cannot desynchronize."""
     wanted: list[tuple[int, LabeledGauge]] = [
         (Metric.QUEUE_DEPTH, mapping.queued),
         (Metric.RUNNING_REQUESTS, mapping.running),
@@ -55,7 +46,50 @@ def parse_scrape(
         wanted.append((Metric.BLOCK_SIZE, mapping.block_size))
     if mapping.num_blocks is not None:
         wanted.append((Metric.NUM_BLOCKS, mapping.num_blocks))
+    return wanted
 
+
+def parse_scrape(
+    text: Union[str, bytes],
+    mapping: ServerMapping,
+    lora: Optional[LoraRegistry] = None,
+    use_native: bool = True,
+) -> tuple[dict[int, float], list[int], list[int]]:
+    """Prometheus exposition text -> (metric columns, active/waiting LoRA ids).
+
+    LoRA residency follows the vllm:lora_requests_info contract (proposal
+    003:43-57): gauge VALUE is a last-updated timestamp — when several series
+    exist, the freshest wins — and the adapter lists ride in the
+    running_lora_adapters / waiting_lora_adapters labels.
+
+    When native/libgiepromparse.so is built, a one-pass C++ scanner pulls
+    the mapped gauges and the LoRA-info sample lines (this loop is the
+    metrics-in hot path: one scrape per endpoint per 50 ms, tens of KB of
+    irrelevant families each); only those few lines go through the Python
+    parser. Semantics are identical for well-formed expositions — parity is
+    pinned in tests/test_promparse_native.py; pass use_native=False to
+    force the pure-Python path. Accepts bytes (the fetcher's raw payload)
+    or str.
+    """
+    if use_native:
+        from gie_tpu.metricsio import native
+
+        extracted = native.extract(text, mapping)
+        if extracted is not None:
+            out, lora_lines = extracted
+            lora_active, lora_waiting = _apply_lora_lines(
+                "\n".join(lora_lines), lora, out)
+            return out, lora_active, lora_waiting
+
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", "replace")
+    out: dict[int, float] = {}
+    wanted = wanted_columns(mapping)
+    lora_samples = []
+    lora_names = (
+        {mapping.lora_info, mapping.lora_info.replace(":", "_")}
+        if mapping.lora_info else set()
+    )
     for family in text_string_to_metric_families(text):
         for sample in family.samples:
             for col, gauge in wanted:
@@ -70,32 +104,59 @@ def parse_scrape(
                             pass
                 else:
                     out[col] = float(sample.value)
-            if mapping.lora_info and sample.name in (
-                mapping.lora_info,
-                mapping.lora_info.replace(":", "_"),
-            ):
-                if sample.value >= best_lora_ts:
-                    best_lora_ts = sample.value
-                    out[Metric.MAX_LORA] = float(
-                        sample.labels.get("max_lora", "0") or 0
-                    )
-                    reg = lora if lora is not None else _DEFAULT_REGISTRY
-                    lora_active = reg.ids_for(
-                        sample.labels.get("running_lora_adapters", "").split(",")
-                    )
-                    lora_waiting = reg.ids_for(
-                        sample.labels.get("waiting_lora_adapters", "").split(",")
-                    )
-                    out[Metric.WAITING_LORA] = float(len(lora_waiting))
+            if sample.name in lora_names:
+                lora_samples.append(sample)
+    lora_active, lora_waiting = _apply_lora_samples(lora_samples, lora, out)
     return out, lora_active, lora_waiting
 
 
-Fetcher = Callable[[str], str]
+def _apply_lora_samples(
+    samples, lora: Optional[LoraRegistry], out: dict[int, float]
+) -> tuple[list[int], list[int]]:
+    """Freshest-series LoRA rule (003:43-57) — ONE implementation shared by
+    both parse paths."""
+    lora_active: list[int] = []
+    lora_waiting: list[int] = []
+    best_lora_ts = float("-inf")
+    for sample in samples:
+        if sample.value < best_lora_ts:
+            continue
+        best_lora_ts = sample.value
+        out[Metric.MAX_LORA] = float(sample.labels.get("max_lora", "0") or 0)
+        reg = lora if lora is not None else _DEFAULT_REGISTRY
+        lora_active = reg.ids_for(
+            sample.labels.get("running_lora_adapters", "").split(","))
+        lora_waiting = reg.ids_for(
+            sample.labels.get("waiting_lora_adapters", "").split(","))
+        out[Metric.WAITING_LORA] = float(len(lora_waiting))
+    return lora_active, lora_waiting
 
 
-def _http_fetch(url: str) -> str:
+def _apply_lora_lines(
+    lora_text: str,
+    lora: Optional[LoraRegistry],
+    out: dict[int, float],
+) -> tuple[list[int], list[int]]:
+    """Native fast path: parse just the lora-info sample lines, then run
+    the same shared rule."""
+    if not lora_text.strip():
+        return [], []
+    samples = [
+        s
+        for family in text_string_to_metric_families(lora_text)
+        for s in family.samples
+    ]
+    return _apply_lora_samples(samples, lora, out)
+
+
+# Fetchers may return bytes (preferred: the native scanner consumes the
+# raw payload without a decode/encode round-trip) or str.
+Fetcher = Callable[[str], Union[str, bytes]]
+
+
+def _http_fetch(url: str) -> bytes:
     with urllib.request.urlopen(url, timeout=2.0) as resp:  # noqa: S310
-        return resp.read().decode("utf-8", "replace")
+        return resp.read()
 
 
 class Scraper:

@@ -1,0 +1,266 @@
+// Prometheus exposition-format fast path for the metrics-in scrape loop.
+//
+// The EPP polls every endpoint's /metrics at a 50 ms cadence (reference
+// data-layer proposal 1023 README:59-60, goroutine-per-endpoint fast poll);
+// a real vLLM exposition is 50-200 KB of mostly-irrelevant families, and
+// the Python parser materializes every sample of every family. This
+// one-pass scanner extracts ONLY the queried gauges (name + exact label
+// matchers, optional numeric value-label) and locates the sample lines of
+// one extra family (vllm:lora_requests_info) for the caller to parse — the
+// Python side keeps the freshest-series LoRA rule and everything else.
+//
+// Exposition subtleties handled: comment/HELP/TYPE lines, escaped label
+// values (\" \\ \n), samples with timestamps, +Inf/NaN values, arbitrary
+// label order, and names with or without a label set.
+//
+// Build: make -C native   (libgiepromparse.so)
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Query {
+  std::string name;
+  // exact-match label pairs
+  std::vector<std::pair<std::string, std::string>> labels;
+  std::string value_label;  // when set, parse this label's value as double
+};
+
+// Parse one label block "{k="v",k2="v2"}" starting at text[i] == '{'.
+// Returns position after '}' or npos on malformed input. Appends unescaped
+// (key, value) pairs.
+size_t parse_labels(const char* text, size_t n, size_t i,
+                    std::vector<std::pair<std::string, std::string>>* out) {
+  ++i;  // consume '{'
+  while (i < n && text[i] != '}') {
+    while (i < n && (text[i] == ',' || text[i] == ' ')) ++i;
+    if (i < n && text[i] == '}') break;
+    size_t kstart = i;
+    while (i < n && text[i] != '=') ++i;
+    if (i >= n) return std::string::npos;
+    std::string key(text + kstart, i - kstart);
+    ++i;  // '='
+    if (i >= n || text[i] != '"') return std::string::npos;
+    ++i;  // '"'
+    std::string val;
+    while (i < n && text[i] != '"') {
+      if (text[i] == '\\' && i + 1 < n) {
+        char c = text[i + 1];
+        val.push_back(c == 'n' ? '\n' : c);
+        i += 2;
+      } else {
+        val.push_back(text[i++]);
+      }
+    }
+    if (i >= n) return std::string::npos;
+    ++i;  // closing '"'
+    out->emplace_back(std::move(key), std::move(val));
+  }
+  if (i >= n) return std::string::npos;
+  return i + 1;  // consume '}'
+}
+
+bool labels_match(
+    const std::vector<std::pair<std::string, std::string>>& have,
+    const Query& q) {
+  for (const auto& want : q.labels) {
+    bool ok = false;
+    for (const auto& h : have) {
+      if (h.first == want.first) {
+        ok = h.second == want.second;
+        break;
+      }
+    }
+    if (!ok) return false;
+  }
+  return true;
+}
+
+// Queries arrive as one '\n'-separated string of
+//   name|k1=v1;k2=v2|value_label
+// ('|' and ';' never appear in prometheus metric/label names).
+std::vector<Query> parse_queries(const char* spec) {
+  std::vector<Query> out;
+  const char* p = spec;
+  while (*p) {
+    const char* end = strchr(p, '\n');
+    std::string line = end ? std::string(p, end - p) : std::string(p);
+    p = end ? end + 1 : p + line.size();
+    if (line.empty()) continue;
+    Query q;
+    size_t b1 = line.find('|');
+    size_t b2 = b1 == std::string::npos ? std::string::npos
+                                        : line.find('|', b1 + 1);
+    q.name = line.substr(0, b1);
+    if (b1 != std::string::npos) {
+      std::string labels = line.substr(b1 + 1, b2 - b1 - 1);
+      size_t i = 0;
+      while (i < labels.size()) {
+        size_t semi = labels.find(';', i);
+        std::string pair = labels.substr(i, semi - i);
+        i = semi == std::string::npos ? labels.size() : semi + 1;
+        if (pair.empty()) continue;
+        size_t eq = pair.find('=');
+        if (eq != std::string::npos) {
+          q.labels.emplace_back(pair.substr(0, eq), pair.substr(eq + 1));
+        }
+      }
+    }
+    if (b2 != std::string::npos) q.value_label = line.substr(b2 + 1);
+    out.push_back(std::move(q));
+  }
+  return out;
+}
+
+// Python-float-compatible full-token parse: rejects hex (stod accepts
+// 0x10, Python float() does not) and trailing garbage (stod
+// prefix-parses "16 tokens" to 16).
+bool parse_double(const std::string& tok, double* out) {
+  if (tok.empty()) return false;
+  for (char c : tok)
+    if (c == 'x' || c == 'X') return false;
+  try {
+    size_t pos = 0;
+    double v = std::stod(tok, &pos);
+    if (pos != tok.size()) return false;
+    *out = v;
+    return true;
+  } catch (...) {
+    return false;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Single pass over `text`: for each query, out_values[i]/out_found[i]
+// receive the LAST matching sample's value (exposition order; matches the
+// Python parser's overwrite-on-iteration semantics) — out_found
+// distinguishes "absent" from a genuine NaN sample value. Additionally
+// collects the byte offsets/lengths of sample lines whose metric name
+// equals ANY of the '\n'-separated `extra_families` (NULL to skip) into
+// out_off/out_len (cap entries); returns the number of such lines found
+// (may exceed cap; only cap are written). Returns -1 on malformed queries.
+long gie_prom_extract(const char* text, long n, const char* query_spec,
+                      double* out_values, unsigned char* out_found,
+                      long n_queries, const char* extra_families,
+                      long* out_off, long* out_len, long cap) {
+  std::vector<Query> queries = parse_queries(query_spec);
+  if ((long)queries.size() != n_queries) return -1;
+  for (long i = 0; i < n_queries; ++i) {
+    out_values[i] = NAN;
+    out_found[i] = 0;
+  }
+  std::vector<std::string> extras;
+  if (extra_families) {
+    const char* p = extra_families;
+    while (*p) {
+      const char* end = strchr(p, '\n');
+      std::string fam = end ? std::string(p, end - p) : std::string(p);
+      p = end ? end + 1 : p + fam.size();
+      if (!fam.empty()) extras.push_back(std::move(fam));
+    }
+  }
+  long extra_found = 0;
+
+  size_t i = 0;
+  std::vector<std::pair<std::string, std::string>> labels;
+  while (i < (size_t)n) {
+    size_t line_start = i;
+    size_t eol = i;
+    while (eol < (size_t)n && text[eol] != '\n') ++eol;
+    // Skip blank and comment lines.
+    size_t j = i;
+    while (j < eol && (text[j] == ' ' || text[j] == '\t')) ++j;
+    if (j >= eol || text[j] == '#') {
+      i = eol + 1;
+      continue;
+    }
+    // Metric name: up to '{', ' ', or tab.
+    size_t name_start = j;
+    while (j < eol && text[j] != '{' && text[j] != ' ' && text[j] != '\t')
+      ++j;
+    size_t name_len = j - name_start;
+
+    for (const auto& fam : extras) {
+      if (fam.size() == name_len &&
+          memcmp(text + name_start, fam.data(), name_len) == 0) {
+        if (extra_found < cap) {
+          out_off[extra_found] = (long)line_start;
+          out_len[extra_found] = (long)(eol - line_start);
+        }
+        ++extra_found;
+        break;
+      }
+    }
+
+    // Any query interested in this name?
+    bool interested = false;
+    for (const auto& q : queries) {
+      if (q.name.size() == name_len &&
+          memcmp(q.name.data(), text + name_start, name_len) == 0) {
+        interested = true;
+        break;
+      }
+    }
+    if (!interested) {
+      i = eol + 1;
+      continue;
+    }
+
+    labels.clear();
+    if (j < eol && text[j] == '{') {
+      size_t after = parse_labels(text, eol, j, &labels);
+      if (after == std::string::npos) {  // malformed: skip line
+        i = eol + 1;
+        continue;
+      }
+      j = after;
+    }
+    // Value: first token after whitespace.
+    while (j < eol && (text[j] == ' ' || text[j] == '\t')) ++j;
+    double value = NAN;
+    bool value_ok = false;
+    if (j < eol) {
+      std::string tok;
+      size_t v = j;
+      while (v < eol && text[v] != ' ' && text[v] != '\t') ++v;
+      tok.assign(text + j, v - j);
+      if (tok == "+Inf") { value = HUGE_VAL; value_ok = true; }
+      else if (tok == "-Inf") { value = -HUGE_VAL; value_ok = true; }
+      else value_ok = parse_double(tok, &value);
+    }
+
+    for (long qi = 0; qi < n_queries; ++qi) {
+      const Query& q = queries[qi];
+      if (q.name.size() != name_len ||
+          memcmp(q.name.data(), text + name_start, name_len) != 0)
+        continue;
+      if (!labels_match(labels, q)) continue;
+      if (!q.value_label.empty()) {
+        for (const auto& h : labels) {
+          if (h.first == q.value_label) {
+            double lv;
+            if (parse_double(h.second, &lv)) {
+              out_values[qi] = lv;
+              out_found[qi] = 1;
+            }
+            break;
+          }
+        }
+      } else if (value_ok) {
+        out_values[qi] = value;
+        out_found[qi] = 1;
+      }
+    }
+    i = eol + 1;
+  }
+  return extra_found;
+}
+
+}  // extern "C"

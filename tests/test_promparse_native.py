@@ -1,0 +1,176 @@
+"""C++ exposition parser (native/promparse.cc) parity vs the Python path.
+
+Same policy as the chunker (tests/test_native.py): the library is built on
+demand by conftest; when present, the native fast path must be
+bit-identical to the pure-Python parser on every mapped-server format and
+the exposition format's edge cases (escaped label values, +Inf,
+timestamps, freshest-LoRA-series rule, value-label info gauges).
+"""
+
+import pytest
+
+from gie_tpu.metricsio import native
+from gie_tpu.metricsio.mappings import BY_NAME, VLLM
+from gie_tpu.metricsio.scrape import parse_scrape
+from gie_tpu.utils.lora import LoraRegistry
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native/libgiepromparse.so not built"
+)
+
+
+def both(text, mapping=VLLM):
+    py = parse_scrape(text, mapping, LoraRegistry(), use_native=False)
+    nat = parse_scrape(text, mapping, LoraRegistry(), use_native=True)
+    assert py == nat, f"\npython: {py}\nnative: {nat}"
+    return nat
+
+
+def test_basic_gauges_and_comments():
+    out, _, _ = both(
+        "# HELP vllm:num_requests_waiting x\n"
+        "# TYPE vllm:num_requests_waiting gauge\n"
+        "vllm:num_requests_waiting 7\n"
+        "vllm:num_requests_running 3 1700000000000\n"
+        "vllm:kv_cache_usage_perc 0.42\n"
+        "unrelated_metric{a=\"b\"} 9\n"
+    )
+    assert out and len(out) >= 3
+
+
+def test_value_label_info_gauge():
+    out, _, _ = both(
+        'vllm:cache_config_info{block_size="16",num_gpu_blocks="2048"} 1\n'
+        "vllm:num_requests_waiting 0\n"
+        "vllm:num_requests_running 0\n"
+        "vllm:kv_cache_usage_perc 0\n"
+    )
+    from gie_tpu.sched.constants import Metric
+
+    assert out[Metric.BLOCK_SIZE] == 16.0
+    assert out[Metric.NUM_BLOCKS] == 2048.0
+
+
+def test_escaped_label_values_and_label_order():
+    both(
+        'vllm:num_requests_waiting{engine="a\\"b\\\\c",zone="x"} 5\n'
+        "vllm:num_requests_running 1\n"
+        "vllm:kv_cache_usage_perc 0.5\n"
+    )
+
+
+def test_inf_values():
+    both(
+        "vllm:num_requests_waiting +Inf\n"
+        "vllm:num_requests_running -Inf\n"
+        "vllm:kv_cache_usage_perc 0.1\n"
+    )
+
+
+def test_lora_freshest_series_wins():
+    text = (
+        "vllm:num_requests_waiting 1\n"
+        "vllm:num_requests_running 1\n"
+        "vllm:kv_cache_usage_perc 0.2\n"
+        'vllm:lora_requests_info{max_lora="4",running_lora_adapters='
+        '"old-a,old-b",waiting_lora_adapters=""} 100\n'
+        'vllm:lora_requests_info{max_lora="4",running_lora_adapters='
+        '"new-a",waiting_lora_adapters="new-w"} 200\n'
+    )
+    reg_py, reg_nat = LoraRegistry(), LoraRegistry()
+    py = parse_scrape(text, VLLM, reg_py, use_native=False)
+    nat = parse_scrape(text, VLLM, reg_nat, use_native=True)
+    assert py == nat
+    # The fresher (ts=200) series won: one active, one waiting.
+    assert len(nat[1]) == 1 and len(nat[2]) == 1
+
+
+def test_lora_underscore_spelling():
+    both(
+        "vllm:num_requests_waiting 1\n"
+        "vllm:num_requests_running 1\n"
+        "vllm:kv_cache_usage_perc 0.2\n"
+        'vllm_lora_requests_info{max_lora="2",running_lora_adapters="a",'
+        'waiting_lora_adapters=""} 5\n'
+    )
+
+
+def test_absent_metrics_identical():
+    both("totally_unrelated 1\n")
+
+
+def test_every_mapped_server_format():
+    for name, mapping in BY_NAME.items():
+        text = (
+            f"{mapping.queued.name}"
+            + (
+                "{"
+                + ",".join(
+                    f'{k}="{v}"' for k, v in mapping.queued.labels.items()
+                )
+                + "}"
+                if mapping.queued.labels
+                else ""
+            )
+            + " 4\n"
+            f"{mapping.running.name} 2\n"
+            f"{mapping.kv_util.name} 0.3\n"
+        )
+        both(text, mapping)
+
+
+def test_stub_fleet_parity_under_load():
+    from gie_tpu.simulator.vllm_stub import StubConfig, VLLMStub
+
+    stub = VLLMStub(StubConfig(max_lora=4), name="p")
+    for i in range(30):
+        stub.submit(b"y" * 1500, decode_tokens=20, lora=f"ad-{i % 5}")
+    stub.step(0.05)
+    both(stub.metrics_text())
+
+
+def test_lora_freshest_across_both_spellings():
+    """A fresher '_'-spelled series must beat a staler ':' series in BOTH
+    paths (the native scanner collects both spellings in one pass)."""
+    text = (
+        "vllm:num_requests_waiting 1\n"
+        "vllm:num_requests_running 1\n"
+        "vllm:kv_cache_usage_perc 0.2\n"
+        'vllm:lora_requests_info{max_lora="4",running_lora_adapters='
+        '"stale",waiting_lora_adapters=""} 100\n'
+        'vllm_lora_requests_info{max_lora="4",running_lora_adapters='
+        '"fresh",waiting_lora_adapters=""} 200\n'
+    )
+    reg_py, reg_nat = LoraRegistry(), LoraRegistry()
+    py = parse_scrape(text, VLLM, reg_py, use_native=False)
+    nat = parse_scrape(text, VLLM, reg_nat, use_native=True)
+    assert py == nat
+    assert nat[1] == [reg_nat.id_for("fresh")]
+
+
+def test_malformed_value_label_rejected_by_both():
+    """stod prefix-parsing must not diverge from Python float(): a
+    non-numeric value label is dropped by both paths."""
+    out, _, _ = both(
+        'vllm:cache_config_info{block_size="16 tokens",num_gpu_blocks='
+        '"0x800"} 1\n'
+        "vllm:num_requests_waiting 2\n"
+        "vllm:num_requests_running 0\n"
+        "vllm:kv_cache_usage_perc 0\n"
+    )
+    from gie_tpu.sched.constants import Metric
+
+    assert Metric.BLOCK_SIZE not in out
+    assert Metric.NUM_BLOCKS not in out
+
+
+def test_bytes_input_parity():
+    text = (
+        "vllm:num_requests_waiting 5\n"
+        "vllm:num_requests_running 2\n"
+        "vllm:kv_cache_usage_perc 0.7\n"
+    )
+    s = parse_scrape(text, VLLM, LoraRegistry(), use_native=True)
+    b = parse_scrape(text.encode(), VLLM, LoraRegistry(), use_native=True)
+    p = parse_scrape(text.encode(), VLLM, LoraRegistry(), use_native=False)
+    assert s == b == p
