@@ -1,0 +1,123 @@
+"""Multi-chip scheduling-cycle benchmark: dp-sharded pick latency.
+
+Measures the SAME north-star shape as bench.py (1024 requests x 256
+endpoints) through the production multi-chip path — Scheduler(mesh=...) /
+the --mesh-devices flag — at every dp width the available devices allow
+(1, 2, 4, 8 chips). On a real TPU pod slice this is the scaling curve of
+the scheduling cycle over ICI; on a host with one chip (or CPU) it falls
+back to a virtual device mesh, which validates the sharded program
+end-to-end but measures host threads, not ICI — the JSON line says which.
+
+Prints ONE JSON line:
+  metric       sharded_pick_p50_us_1024x256_dp<N> at the widest mesh
+  vs_baseline  single-device p50 / widest-mesh p50 (speedup; >= 1.0 means
+               sharding pays at this shape)
+
+Reference seam: the reference's EPP is single-process CPU (SURVEY.md
+section 2.10 — replica-parallel only); a dp-sharded cycle has no analogue
+there. This harness exists so a multi-chip deployment can verify the
+sharding pays before enabling --mesh-devices.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+
+def _ensure_devices(min_devices: int) -> str:
+    """Pick the fabric BEFORE the JAX backend initializes (a post-init
+    platform switch cannot grow the device count — round-1 lesson).
+
+    Default: a virtual CPU mesh of `min_devices` (functional validation;
+    deterministic in any container). On a real TPU pod slice run with
+    GIE_MESH_FABRIC=ici to measure the actual ICI scaling curve."""
+    import jax
+
+    if os.environ.get("GIE_MESH_FABRIC", "").lower() == "ici":
+        return "ici"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={min_devices}"
+    ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    return "virtual-cpu"
+
+
+def main() -> None:
+    fabric = _ensure_devices(8)
+    import jax
+    import numpy as np
+
+    from gie_tpu.parallel.mesh import make_mesh
+    from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
+    from gie_tpu.sched.types import SchedState, Weights
+    from gie_tpu.utils.testing import make_endpoints, make_requests
+
+    n, m = 1024, 256
+    rng = np.random.default_rng(0)
+    eps = make_endpoints(
+        m,
+        queue=rng.integers(0, 50, m).tolist(),
+        kv=rng.uniform(0, 0.95, m).tolist(),
+        max_lora=8,
+    )
+    base = b"SYSTEM: You are a helpful assistant specialised in task %d. "
+    prompts = [(base % (i % 16)) * 6 + b"user question %d" % i
+               for i in range(n)]
+    reqs = make_requests(n, prompts=prompts,
+                         lora_id=(rng.integers(-1, 12, n)).tolist())
+    cfg = ProfileConfig()
+    weights = Weights.default()
+    key = jax.random.PRNGKey(0)
+
+    n_dev = len(jax.devices())
+    widths = [w for w in (1, 2, 4, 8) if w <= n_dev]
+    results = {}
+    for width in widths:
+        if width == 1:
+            fn = jax.jit(
+                functools.partial(scheduling_cycle, cfg=cfg,
+                                  predictor_fn=None),
+                donate_argnums=0,
+            )
+        else:
+            # The exact production recipe the --mesh-devices flag runs
+            # (same helper, same donation) — the bench must measure the
+            # program it claims to validate.
+            from gie_tpu.parallel.mesh import sharded_cycle
+
+            fn = sharded_cycle(make_mesh(width, tp=1), cfg, None,
+                               donate_state=True)
+        state = SchedState.init()
+        result, state = fn(state, reqs, eps, weights, key, None)
+        jax.block_until_ready(result.indices)
+        # Same statistic as bench.py: p50 over pipelined-window means.
+        windows, per_window = 10, 10
+        window_us = []
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(per_window):
+                result, state = fn(state, reqs, eps, weights, key, None)
+            jax.block_until_ready(result.indices)
+            window_us.append((time.perf_counter() - t0) / per_window * 1e6)
+        p50 = float(np.percentile(window_us, 50))
+        results[width] = p50
+        print(f"dp={width}: {p50:9.1f} us/batch  [{fabric}]",
+              file=sys.stderr)
+
+    widest = max(results)
+    speedup = results[1] / results[widest]
+    print(json.dumps({
+        "metric": f"sharded_pick_p50_us_1024x256_dp{widest}_{fabric}",
+        "value": round(results[widest], 1),
+        "unit": "us",
+        "vs_baseline": round(speedup, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,8 +41,9 @@ def _force_platform() -> None:
         )
 
 
-def run_pair(duration_s: float = 30.0, seed: int = 0):
-    """(predictor-off stats, predictor-on stats) on the same workload."""
+def make_leg(duration_s: float = 30.0, seed: int = 0):
+    """Build the leg runner: leg(slo_admission, column_ceiling) -> RunStats
+    on the fixed heterogeneous-fleet workload."""
     import jax.numpy as jnp
 
     from gie_tpu.models.latency import LatencyPredictor, OnlineTrainer
@@ -65,27 +66,53 @@ def run_pair(duration_s: float = 30.0, seed: int = 0):
                       assumed_load=jnp.float32(1.5),
                       latency=jnp.float32(0.0), session=jnp.float32(8.0))
 
-    def leg(slo_admission: bool):
-        trainer = (OnlineTrainer(LatencyPredictor(), batch_size=64,
-                                 seed=seed)
-                   if slo_admission else None)
+    def leg(slo_admission: bool, column_ceiling: float = 0.0):
+        from gie_tpu.models.latency import predictor_score_fn
+
+        use_predictor = slo_admission or column_ceiling > 0.0
+        predictor = LatencyPredictor()
+        trainer = (OnlineTrainer(predictor, batch_size=64, seed=seed)
+                   if use_predictor else None)
+        predictor_fn = params = None
+        if column_ceiling > 0.0:
+            # Confidence-gated score column: the Scheduler zeroes the live
+            # weight at startup and the sim's train loop phases it in via
+            # gate_latency_column as the trainer converges.
+            predictor_fn = predictor_score_fn(predictor)
+            params = trainer.params
         cluster = SimCluster(n_pods=8, stub_cfg=fleet, seed=seed)
         return cluster.run(
             "tpu", wl, duration_s=duration_s,
-            scheduler=Scheduler(cfg, weights=weights),
+            scheduler=Scheduler(
+                cfg,
+                weights=weights.replace(latency=jnp.float32(column_ceiling)),
+                predictor_fn=predictor_fn, predictor_params=params,
+            ),
             trainer=trainer, train_every_s=0.5,
             slo_admission=slo_admission,
         )
 
+    return leg
+
+
+def run_pair(duration_s: float = 30.0, seed: int = 0):
+    """(predictor-off stats, predictor-on stats) on the same workload."""
+    leg = make_leg(duration_s, seed)
     return leg(False), leg(True)
 
 
 def main() -> None:
     _force_platform()
-    off, on = run_pair()
-    for label, s in (("predictor-off", off), ("predictor-on", on)):
+    ablation = "--ablation" in sys.argv
+    leg = make_leg()
+    legs = [("predictor-off", leg(False)), ("predictor-on", leg(True))]
+    if ablation:
+        legs.append(("gated-column", leg(False, column_ceiling=1.0)))
+        legs.append(("gated+admission", leg(True, column_ceiling=1.0)))
+    off, on = legs[0][1], legs[1][1]
+    for label, s in legs:
         print(
-            f"{label:14s} goodput={s.goodput_tokens_per_s:7.1f} tok/s "
+            f"{label:15s} goodput={s.goodput_tokens_per_s:7.1f} tok/s "
             f"slo={s.slo_attainment:.3f} shed={s.shed} "
             f"p99={s.ttft_p99_s:.2f}s",
             file=sys.stderr,

@@ -253,8 +253,12 @@ class OnlineTrainer:
         seed: int = 0,
         capacity: int = 8192,
         batch_size: int = 256,
+        confidence_min_samples: int = 1024,
+        confidence_loss_ok: float = 0.05,
     ):
         self.predictor = predictor
+        self.confidence_min_samples = confidence_min_samples
+        self.confidence_loss_ok = confidence_loss_ok
         self.tx = optax.adamw(
             predictor.cfg.learning_rate, weight_decay=predictor.cfg.weight_decay
         )
@@ -270,9 +274,11 @@ class OnlineTrainer:
         self._weights = np.zeros((capacity, 2), np.float32)
         self._n = 0
         self._head = 0
+        self._observed_total = 0
         self._lock = threading.Lock()
         self._rng = np.random.default_rng(seed)
         self.last_loss: Optional[float] = None
+        self._loss_ema: Optional[float] = None
 
     def observe(
         self,
@@ -293,6 +299,7 @@ class OnlineTrainer:
             self._weights[self._head] = (1.0, 0.0 if tpot_s is None else 1.0)
             self._head = (self._head + 1) % self.capacity
             self._n = min(self._n + 1, self.capacity)
+            self._observed_total += 1
 
     # Pad host-side prediction batches to a multiple of this so the jitted
     # forward compiles for a handful of shapes, not one per batch size.
@@ -332,26 +339,93 @@ class OnlineTrainer:
             )
             loss = float(loss_arr)
         self.last_loss = loss
+        if loss is not None:
+            self._loss_ema = (
+                loss if self._loss_ema is None
+                else 0.9 * self._loss_ema + 0.1 * loss
+            )
         return loss
+
+    def confidence(self) -> float:
+        """How much the live score blend should trust the latency column,
+        in [0, 1] — the phase-in gate for Scheduler.gate_latency_column.
+
+        The round-1 heterogeneous-fleet ablation showed WHY this exists: a
+        fully-weighted but under-trained column scores noise and dilutes the
+        proven heuristics (474 vs 635 tok/s goodput). Confidence is the
+        product of a sample ramp (how much of the latency surface the buffer
+        has actually seen) and a loss factor (how well the model fits it),
+        so the column phases in only as the predictor converges and drops
+        back automatically if drift raises the loss EMA."""
+        if self._loss_ema is None:
+            return 0.0
+        with self._lock:
+            observed = self._observed_total
+        ramp = min(1.0, observed / max(self.confidence_min_samples, 1))
+        factor = min(1.0, self.confidence_loss_ok / max(self._loss_ema, 1e-9))
+        return ramp * factor
 
     # -- durability (the system's ONLY durable state, SURVEY.md 5.4) -------
 
     def save(self, directory: str) -> None:
-        """Checkpoint params via orbax (reference analogue: none — all EPP
-        state is soft cache; the learned policy's weights are the exception
-        the BASELINE north star introduces)."""
+        """Checkpoint params + confidence state via orbax (reference
+        analogue: none — all EPP state is soft cache; the learned policy's
+        weights are the exception the BASELINE north star introduces).
+
+        Confidence state rides along so a restarted EPP's phase-in gate
+        resumes where training left off instead of re-zeroing a converged
+        column for ~confidence_min_samples fresh observations."""
         from gie_tpu.utils.checkpoint import save_pytree
 
-        save_pytree(directory, self.params)
+        with self._lock:
+            meta = {
+                "loss_ema": np.float32(
+                    np.nan if self._loss_ema is None else self._loss_ema
+                ),
+                "observed_total": np.int64(self._observed_total),
+            }
+        save_pytree(directory, {"params": self.params, "meta": meta})
 
     def restore(self, directory: str) -> bool:
-        """Restore params if a checkpoint exists; returns success. The
-        optimizer state restarts fresh (acceptable for online fine-tuning)."""
+        """Restore params (and confidence state) if a checkpoint exists;
+        returns success. The optimizer state restarts fresh (acceptable for
+        online fine-tuning). Params-only checkpoints from before the
+        confidence gate restore with zero confidence."""
         from gie_tpu.utils.checkpoint import restore_pytree
 
-        restored = restore_pytree(directory, self.params)
-        if restored is None:
-            return False
-        self.params = restored
+        template = {
+            "params": self.params,
+            "meta": {
+                "loss_ema": np.float32(np.nan),
+                "observed_total": np.int64(0),
+            },
+        }
+        restored = restore_pytree(directory, template)
+        if restored is not None:
+            self.params = restored["params"]
+            ema = float(restored["meta"]["loss_ema"])
+            with self._lock:
+                self._loss_ema = None if np.isnan(ema) else ema
+                self._observed_total = int(restored["meta"]["observed_total"])
+        else:
+            # Pre-gate checkpoint layout: bare params pytree. Seed FULL
+            # confidence: the release that wrote it applied the configured
+            # weight unconditionally, so restoring that behavior (rather
+            # than pinning the column to 0 until ~min_samples fresh
+            # observations under possibly low traffic) is the upgrade-safe
+            # choice — the loss EMA re-adjusts from the first train tick.
+            restored = restore_pytree(directory, self.params)
+            if restored is None:
+                return False
+            self.params = restored
+            with self._lock:
+                self._loss_ema = self.confidence_loss_ok
+                self._observed_total = self.confidence_min_samples
+            from gie_tpu.runtime.logging import get_logger
+
+            get_logger("predictor").info(
+                "legacy params-only checkpoint restored; seeding full "
+                "column confidence (pre-gate behavior)", dir=directory,
+            )
         self.opt_state = self.tx.init(self.params)
         return True

@@ -38,6 +38,11 @@ def make_mesh(n_devices: Optional[int] = None, tp: Optional[int] = None) -> Mesh
     when the device count allows, else 1."""
     devices = jax.devices()
     n = len(devices) if n_devices is None else n_devices
+    if n > len(devices):
+        raise ValueError(
+            f"requested a {n}-device mesh but only {len(devices)} "
+            f"device(s) are available"
+        )
     devices = devices[:n]
     if tp is None:
         tp = 2 if n % 2 == 0 and n >= 2 else 1
@@ -65,13 +70,16 @@ def cycle_shardings(mesh: Mesh):
     )
 
 
-def sharded_cycle(mesh: Mesh, cfg, predictor_fn=None):
+def sharded_cycle(mesh: Mesh, cfg, predictor_fn=None, donate_state: bool = False):
     """Jit the scheduling cycle with dp-sharded requests over `mesh`.
-    Predictor params (the trailing argument) are replicated."""
+    Predictor params (the trailing argument) are replicated. The Scheduler
+    facade passes donate_state=True (its state buffers update in place);
+    equivalence tests keep the default so inputs stay readable."""
     fn = functools.partial(scheduling_cycle, cfg=cfg, predictor_fn=predictor_fn)
     repl = NamedSharding(mesh, P())
     in_sh = cycle_shardings(mesh) + (repl,)
-    return jax.jit(fn, in_shardings=in_sh)
+    donate = (0,) if donate_state else ()
+    return jax.jit(fn, in_shardings=in_sh, donate_argnums=donate)
 
 
 def predictor_param_shardings(mesh: Mesh, params):

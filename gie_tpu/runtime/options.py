@@ -41,6 +41,10 @@ class Options:
     objectives: list = dataclasses.field(default_factory=list)
     # Declarative scheduler profile (YAML: picker/thresholds/plugins/weights).
     scheduler_config: Optional[str] = None
+    # Multi-chip serving: dp-shard the scheduling cycle over the first N
+    # local devices (0 = single-device). Results are bit-identical to
+    # single-device (tests/test_distributed_equivalence.py).
+    mesh_devices: int = 0
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -86,6 +90,9 @@ class Options:
         parser.add_argument("--scheduler-config", default=d.scheduler_config,
                             help="YAML scheduler profile "
                                  "(picker/thresholds/plugins/weights)")
+        parser.add_argument("--mesh-devices", type=int, default=d.mesh_devices,
+                            help="dp-shard the scheduling cycle over the "
+                                 "first N local devices (0 = single-device)")
         parser.add_argument("--objective", action="append", default=[],
                             dest="objectives", metavar="NAME=CRITICALITY",
                             help="register an InferenceObjective "
@@ -113,6 +120,7 @@ class Options:
             leader_lease_path=args.leader_lease_path,
             objectives=list(args.objectives),
             scheduler_config=args.scheduler_config,
+            mesh_devices=args.mesh_devices,
         )
 
     def validate(self) -> None:
@@ -128,6 +136,12 @@ class Options:
                 raise ValueError(f"--{name} {port} out of range")
         if self.verbosity < 0 or self.verbosity > 5:
             raise ValueError("-v must be 0..5")
+        if self.mesh_devices < 0:
+            raise ValueError("--mesh-devices must be >= 0")
+        # With tp=1 the dp axis equals the device count, and dp must be a
+        # power of two to divide the request buckets (sched/profile.py).
+        if self.mesh_devices > 1 and self.mesh_devices & (self.mesh_devices - 1):
+            raise ValueError("--mesh-devices must be a power of two")
         for spec in self.objectives:
             name, sep, crit = spec.partition("=")
             if not sep or not name:

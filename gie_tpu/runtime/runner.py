@@ -83,22 +83,38 @@ class ExtProcServerRunner:
                                       dir=opts.predictor_checkpoint_dir)
                 predictor_fn = predictor_score_fn(predictor)
                 predictor_params = self.trainer.params
-                # The latency weight stays as configured (default 0): the
-                # heterogeneous-fleet benchmark showed the predictor's
-                # payoff is SLO-aware admission (requests carrying
-                # x-gateway-inference-ttft-slo-ms are shed when predicted
-                # TTFT misses the bound), while blending an early,
-                # still-untrained column into the score DILUTES the
-                # heuristics (docs/BENCH_NOTES.md round-2 ablation:
-                # column-only goodput 474 vs 635 baseline vs 1274 with
-                # admission). Opt into the column via weights.latency in
-                # --scheduler-config once trained/restored.
+                # The configured latency weight is a CEILING, not a live
+                # weight: the Scheduler zeroes the column at startup and
+                # _train_loop phases it in via gate_latency_column as
+                # OnlineTrainer.confidence converges. The round-2 ablation
+                # (docs/BENCH_NOTES.md) is why — an under-trained column at
+                # full weight scored noise (goodput 474 vs 635), while
+                # SLO-aware admission (x-gateway-inference-ttft-slo-ms) pays
+                # from the first converged model. Opt into the column via
+                # weights.latency in --scheduler-config.
+            mesh = None
+            if opts.mesh_devices > 1:
+                from gie_tpu.parallel.mesh import make_mesh
+
+                # tp=1: the serving path replicates predictor params (the
+                # tp axis only pays in the training step), so every
+                # requested device goes to the dp request axis.
+                mesh = make_mesh(opts.mesh_devices, tp=1)
+                self.log.info("multi-chip scheduling mesh",
+                              shape=dict(mesh.shape))
             self.scheduler = Scheduler(
                 cfg,
                 weights=weights,
                 predictor_fn=predictor_fn,
                 predictor_params=predictor_params,
+                mesh=mesh,
             )
+            if self.trainer is not None:
+                # A restored checkpoint carries its confidence state: apply
+                # it now, or a converged opted-in column would sit at weight
+                # 0 until ~batch_size fresh observations trigger the first
+                # train tick (indefinitely under low traffic).
+                self.scheduler.gate_latency_column(self.trainer.confidence())
         self.metrics_store = MetricsStore()
         self.mapping = BY_NAME[opts.model_server_type]
         self.scraper = Scraper(
@@ -264,7 +280,10 @@ class ExtProcServerRunner:
                 if loss is None:
                     continue
                 self.scheduler.set_predictor_params(self.trainer.params)
-                self.log.v(3).info("predictor trained", loss=loss)
+                live_w = self.scheduler.gate_latency_column(
+                    self.trainer.confidence())
+                self.log.v(3).info("predictor trained", loss=loss,
+                                   latency_weight=live_w)
                 if self.opts.predictor_checkpoint_dir:
                     self.trainer.save(self.opts.predictor_checkpoint_dir)
             except Exception as e:  # training must never take the EPP down

@@ -263,9 +263,17 @@ class Scheduler:
         predictor_fn: Optional[PredictorFn] = None,
         predictor_params=None,
         seed: int = 0,
+        mesh=None,
     ):
         self.cfg = cfg
         self.weights = weights if weights is not None else Weights.default()
+        # The configured latency weight is the CEILING the phase-in gate
+        # scales toward (gate_latency_column); the live blend starts at 0
+        # when a predictor column is present so an untrained model never
+        # dilutes the heuristics.
+        self.base_latency_weight = float(self.weights.latency)
+        if predictor_fn is not None and self.base_latency_weight > 0.0:
+            self.weights = self.weights.replace(latency=jnp.float32(0.0))
         self.predictor_fn = predictor_fn
         self.predictor_params = predictor_params
         self.state = SchedState.init()
@@ -282,12 +290,38 @@ class Scheduler:
             ),
             donate_argnums=0,
         )
-        self._jit = jax.jit(
-            functools.partial(
-                scheduling_cycle, cfg=self.cfg, predictor_fn=self.predictor_fn
-            ),
-            donate_argnums=0,
-        )
+        if mesh is not None:
+            # Multi-chip serving: dp-shard the request axis of the cycle
+            # over the mesh (ICI collectives inserted by GSPMD; identical
+            # results to single-device — tests/test_distributed_equivalence).
+            # Deferred import: parallel.mesh imports this module.
+            from gie_tpu.parallel.mesh import sharded_cycle
+
+            dp = int(mesh.shape["dp"])
+            # Every padded batch must split evenly over the dp axis; the
+            # N buckets are powers of two, so dp must be one too (a dp of
+            # e.g. 3 would pass startup and crash the first pick inside
+            # jit with an indivisible-axis error).
+            if dp & (dp - 1) or dp > C.N_BUCKETS[-1]:
+                raise ValueError(
+                    f"mesh dp axis must be a power of two <= "
+                    f"{C.N_BUCKETS[-1]} to divide the request buckets "
+                    f"{C.N_BUCKETS}; got dp={dp}"
+                )
+            self._jit = sharded_cycle(
+                mesh, self.cfg, self.predictor_fn, donate_state=True
+            )
+            self._min_bucket = dp
+        else:
+            self._jit = jax.jit(
+                functools.partial(
+                    scheduling_cycle, cfg=self.cfg,
+                    predictor_fn=self.predictor_fn,
+                ),
+                donate_argnums=0,
+            )
+            self._min_bucket = 1
+        self.mesh = mesh
         self._warm_buckets: set[int] = set()
         self._warm_lock = threading.Lock()
 
@@ -305,7 +339,7 @@ class Scheduler:
         """Schedule a micro-batch; returns host-side PickResult rows for the
         original (pre-padding) batch."""
         n = int(np.asarray(reqs.valid).shape[0])
-        bucket = bucket_for(n)
+        bucket = bucket_for(max(n, self._min_bucket))
         reqs = pad_requests(reqs, bucket)
         if bucket not in self._warm_buckets:
             with self._warm_lock:
@@ -332,6 +366,16 @@ class Scheduler:
         Swapped under the lock so in-flight cycles see a consistent tree."""
         with self._lock:
             self.predictor_params = params
+
+    def gate_latency_column(self, confidence: float) -> float:
+        """Phase the latency column into the blend as the predictor earns
+        trust: live weight = configured weight x confidence in [0, 1]
+        (OnlineTrainer.confidence). Weights are a dynamic argument of the
+        jitted cycle, so this never recompiles. Returns the live weight."""
+        w = self.base_latency_weight * float(np.clip(confidence, 0.0, 1.0))
+        with self._lock:
+            self.weights = self.weights.replace(latency=jnp.float32(w))
+        return w
 
     def explain(
         self, reqs: RequestBatch, eps: EndpointBatch

@@ -253,6 +253,7 @@ class SimCluster:
             if trainer is not None and clock >= next_train:
                 if trainer.train(steps=5) is not None and scheduler is not None:
                     scheduler.set_predictor_params(trainer.params)
+                    scheduler.gate_latency_column(trainer.confidence())
                 next_train = clock + train_every_s
 
         # --- stats ---------------------------------------------------------

@@ -72,3 +72,58 @@ def test_sharded_cycle_with_predictor_column():
     sharded = sharded_cycle(mesh, cfg, fn)
     r2, _ = sharded(SchedState.init(), reqs, eps, weights, key, params)
     np.testing.assert_array_equal(np.asarray(r1.indices), np.asarray(r2.indices))
+
+
+def test_scheduler_facade_with_mesh_matches_single_device():
+    """The production path: Scheduler(mesh=...) — the --mesh-devices flag —
+    must return the same picks as the unsharded facade, including across
+    state-carrying successive batches and the small-batch bucket floor
+    (batches pad up to a dp-divisible bucket)."""
+    from gie_tpu.sched import Scheduler
+
+    assert len(jax.devices()) >= 8
+    cfg = ProfileConfig()
+    rng = np.random.default_rng(3)
+    m = 16
+    eps = make_endpoints(
+        m,
+        queue=rng.integers(0, 30, m).tolist(),
+        kv=rng.uniform(0, 0.9, m).tolist(),
+    )
+    plain = Scheduler(cfg, seed=5)
+    meshed = Scheduler(cfg, seed=5, mesh=make_mesh(8))
+    assert meshed._min_bucket == 4  # dp axis of the (4, 2) mesh
+
+    for wave in range(3):
+        prompts = [b"S%d " % (i % 4) * 30 + b"w%d q%d" % (wave, i)
+                   for i in range(24)]
+        reqs = make_requests(24, prompts=prompts)
+        r1 = plain.pick(reqs, eps)
+        r2 = meshed.pick(reqs, eps)
+        np.testing.assert_array_equal(
+            np.asarray(r1.indices), np.asarray(r2.indices))
+        np.testing.assert_array_equal(
+            np.asarray(r1.status), np.asarray(r2.status))
+    np.testing.assert_allclose(
+        plain.snapshot_assumed_load(), meshed.snapshot_assumed_load(),
+        atol=1e-5)
+    # A 3-request batch pads to the bucket floor and still round-trips.
+    small = meshed.pick(make_requests(3), eps)
+    assert np.asarray(small.indices).shape[0] == 3
+
+
+def test_mesh_guardrails():
+    """Clear startup errors instead of cryptic jit crashes: non-power-of-two
+    dp axes are rejected by the Scheduler, over-requested meshes by
+    make_mesh, and --mesh-devices validation catches both early."""
+    from gie_tpu.runtime.options import Options
+    from gie_tpu.sched import Scheduler
+
+    with pytest.raises(ValueError, match="power of two"):
+        Scheduler(ProfileConfig(), mesh=make_mesh(6, tp=2))  # dp=3
+    with pytest.raises(ValueError, match="available"):
+        make_mesh(len(jax.devices()) + 1)
+    opts = Options(pool_name="p", mesh_devices=6)
+    with pytest.raises(ValueError, match="power of two"):
+        opts.validate()
+    Options(pool_name="p", mesh_devices=8).validate()

@@ -67,7 +67,56 @@ def test_predictor_column_in_scheduler():
         predictor_fn=predictor_score_fn(p),
         predictor_params=trainer.params,
     )
+    # The phase-in gate zeroes the live column until confidence arrives.
+    assert float(sched.weights.latency) == 0.0
+    assert sched.base_latency_weight == 2.0
+    sched.gate_latency_column(1.0)
+    assert float(sched.weights.latency) == 2.0
     # Untrained net: still must run end to end and return valid picks.
+    eps = make_endpoints(4, queue=[0, 10, 20, 30])
+    res = sched.pick(make_requests(8), eps)
+    assert (np.asarray(res.indices[:, 0]) >= 0).all()
+
+
+def test_confidence_phase_in():
+    """OnlineTrainer.confidence ramps 0 -> 1 with samples and converged
+    loss, and gate_latency_column scales the live weight by it (the
+    round-2 ablation's fix: an under-trained column must not dilute the
+    heuristic blend)."""
+    p = LatencyPredictor()
+    trainer = OnlineTrainer(p, batch_size=64, confidence_min_samples=256,
+                            confidence_loss_ok=0.05)
+    # Never trained: zero confidence regardless of buffered samples.
+    assert trainer.confidence() == 0.0
+    rng = np.random.default_rng(1)
+    for _ in range(128):
+        f = rng.uniform(0, 1, NUM_FEATURES).astype(np.float32)
+        trainer.observe(f, ttft_s=0.1 + 2.0 * f[3], tpot_s=0.02)
+    trainer.train(steps=5)
+    half = trainer.confidence()
+    # Sample ramp caps confidence at 128/256 even if loss converged.
+    assert 0.0 < half <= 0.5
+    for _ in range(384):
+        f = rng.uniform(0, 1, NUM_FEATURES).astype(np.float32)
+        trainer.observe(f, ttft_s=0.1 + 2.0 * f[3], tpot_s=0.02)
+    for _ in range(40):
+        trainer.train(steps=5)
+    full = trainer.confidence()
+    assert full > half
+    assert trainer._loss_ema is not None
+
+    sched = Scheduler(
+        ProfileConfig(enable_prefix=False),
+        weights=Weights.default().replace(latency=jnp.float32(3.0)),
+        predictor_fn=predictor_score_fn(p),
+        predictor_params=trainer.params,
+    )
+    assert sched.gate_latency_column(0.0) == 0.0
+    assert sched.gate_latency_column(0.5) == 1.5
+    # Confidence is clipped to [0, 1]: the ceiling is the configured weight.
+    assert sched.gate_latency_column(7.0) == 3.0
+    assert float(sched.weights.latency) == 3.0
+    # Gating never recompiles and picks stay valid across weight changes.
     eps = make_endpoints(4, queue=[0, 10, 20, 30])
     res = sched.pick(make_requests(8), eps)
     assert (np.asarray(res.indices[:, 0]) >= 0).all()
@@ -196,3 +245,33 @@ def test_tpot_head_masked_when_unobserved():
     tpot_after = float(np.mean(np.asarray(
         p.predict(trainer.params, feats, eval_slots))[:, 1]))
     assert tpot_after > tpot_before * 0.5  # head not collapsed toward zero
+
+
+def test_checkpoint_preserves_confidence(tmp_path):
+    """A restarted EPP must not re-zero a converged gated column: the
+    checkpoint carries the confidence state (loss EMA + observed count),
+    and pre-gate params-only checkpoints restore with zero confidence."""
+    from gie_tpu.utils.checkpoint import save_pytree
+
+    p = LatencyPredictor()
+    t1 = OnlineTrainer(p, batch_size=64, confidence_min_samples=128)
+    rng = np.random.default_rng(5)
+    for _ in range(256):
+        f = rng.uniform(0, 1, NUM_FEATURES).astype(np.float32)
+        t1.observe(f, ttft_s=0.1 + 2.0 * f[3], tpot_s=0.02)
+    for _ in range(30):
+        t1.train(steps=5)
+    assert t1.confidence() > 0.0
+    t1.save(str(tmp_path / "ck"))
+
+    t2 = OnlineTrainer(LatencyPredictor(), confidence_min_samples=128)
+    assert t2.restore(str(tmp_path / "ck"))
+    assert t2.confidence() == pytest.approx(t1.confidence(), rel=1e-5)
+
+    # Legacy layout (bare params pytree) still restores, seeding FULL
+    # confidence: the release that wrote it applied the configured weight
+    # unconditionally, and an upgrade must not silently zero the column.
+    save_pytree(str(tmp_path / "old"), t1.params)
+    t3 = OnlineTrainer(LatencyPredictor(), confidence_min_samples=128)
+    assert t3.restore(str(tmp_path / "old"))
+    assert t3.confidence() == 1.0

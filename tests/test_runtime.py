@@ -118,3 +118,52 @@ def test_health_gated_on_pool_sync():
         channel.close()
     finally:
         server.stop(0)
+
+
+def test_restored_confidence_applies_at_startup(tmp_path):
+    """A restarted EPP with a converged predictor checkpoint must apply the
+    gated latency weight at construction, not after the first train tick
+    (which needs ~batch_size fresh observations — indefinitely long under
+    low traffic)."""
+    import numpy as np
+
+    from gie_tpu.controller.cluster import FakeCluster
+    from gie_tpu.models.latency import (
+        NUM_FEATURES, LatencyPredictor, OnlineTrainer,
+    )
+    from gie_tpu.runtime.options import Options
+    from gie_tpu.runtime.runner import ExtProcServerRunner
+    from gie_tpu.sched.config import tuned_profile
+
+    # Converge a trainer and checkpoint it (confidence state rides along).
+    t1 = OnlineTrainer(LatencyPredictor(), batch_size=64,
+                       confidence_min_samples=128)
+    rng = np.random.default_rng(7)
+    for _ in range(256):
+        f = rng.uniform(0, 1, NUM_FEATURES).astype(np.float32)
+        t1.observe(f, ttft_s=0.1 + 2.0 * f[3], tpot_s=0.02)
+    for _ in range(30):
+        t1.train(steps=5)
+    assert t1.confidence() > 0.0
+    ckpt = str(tmp_path / "predictor")
+    t1.save(ckpt)
+
+    # Scheduler-config ceiling: latency weight 2.0.
+    cfg_yaml = tmp_path / "sched.yaml"
+    cfg_yaml.write_text("weights:\n  latency: 2.0\n")
+    opts = Options(pool_name="p", enable_predictor=True,
+                   predictor_checkpoint_dir=ckpt,
+                   scheduler_config=str(cfg_yaml))
+    runner = ExtProcServerRunner(opts, FakeCluster())
+    # Freshly-restarted runner: restored confidence gates the column NOW.
+    # (The runner's trainer has its own confidence_min_samples, so compare
+    # against ITS view of the restored state, not t1's.)
+    live = float(runner.scheduler.weights.latency)
+    assert live == pytest.approx(2.0 * runner.trainer.confidence(), rel=1e-5)
+    assert live > 0.0
+
+    # Without a checkpoint the column starts at zero (untrained predictor).
+    opts2 = Options(pool_name="p", enable_predictor=True,
+                    scheduler_config=str(cfg_yaml))
+    runner2 = ExtProcServerRunner(opts2, FakeCluster())
+    assert float(runner2.scheduler.weights.latency) == 0.0
