@@ -16,10 +16,14 @@ semantics) — pure VPU reductions, no sort.
 Used behind ProfileConfig(use_pallas_topk=True); parity with the reference
 jnp implementation is tested in interpret mode on CPU.
 
-NOTE (round 1, axon backend): pallas_call compilation through this
-container's remote-compile tunnel hangs indefinitely (even for a trivial
-out[:] = in[:] * 2 kernel), so the flag stays off by default here; on a
-standard TPU VM the kernel compiles with the normal Mosaic pipeline.
+NOTE (history): in rounds 1-2 pallas_call compilation through this
+container's axon remote-compile tunnel hung indefinitely; re-tested later
+in round 2 it compiles in <1 s and the kernel runs on the real chip with
+EXACT pick parity against the XLA path at the north-star shape
+(1024x256, k=4). Measured cycle time is at par with XLA (~40 us — XLA
+already fuses this pattern well), so the flag stays off by default on
+merit, not environment: enable it where profiling on the target backend
+shows the single-HBM-pass layout winning (larger S, wider M).
 """
 
 from __future__ import annotations
