@@ -81,22 +81,35 @@ class EppInstance:
     """One EPP per pool: the real server components, plus a replica count so
     the suite can take it down (EppUnAvailableFailOpen).
 
-    picker_mode: "rr" (the lwepp-parity round-robin) or "tpu" (the full
+    picker_mode: "rr" (the lwepp-parity round-robin), "tpu" (the full
     batched scheduler through BatchingTPUPicker — proving conformance holds
-    for the real scheduling path, not just the trivial picker).
+    for the real scheduling path, not just the trivial picker), or
+    "tpu-mesh" (the same scheduler dp-sharded over every available device —
+    the --mesh-devices production path).
     """
 
     def __init__(self, env: "ConformanceEnv", pool_ns: str, pool_name: str,
                  picker_mode: str = "rr"):
         self.datastore = Datastore()
         self._closers = []
-        if picker_mode == "tpu":
+        if picker_mode in ("tpu", "tpu-mesh"):
             from gie_tpu.metricsio import MetricsStore
             from gie_tpu.sched.batching import BatchingTPUPicker
             from gie_tpu.sched.profile import Scheduler
 
+            mesh = None
+            if picker_mode == "tpu-mesh":
+                # The --mesh-devices production path: dp-shard the cycle
+                # over every available device (conformance must hold for
+                # the distributed pick path bit-for-bit).
+                import jax
+
+                from gie_tpu.parallel.mesh import make_mesh
+
+                mesh = make_mesh(len(jax.devices()), tp=1)
             picker = BatchingTPUPicker(
-                Scheduler(), self.datastore, MetricsStore(), max_wait_s=0.002
+                Scheduler(mesh=mesh), self.datastore, MetricsStore(),
+                max_wait_s=0.002,
             )
             self._closers.append(picker.close)
         elif picker_mode == "rr":

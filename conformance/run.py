@@ -15,8 +15,16 @@ import traceback
 
 def _force_cpu() -> None:
     """Conformance is protocol-level; it must not depend on (or hang on)
-    accelerator availability. Mirrors tests/conftest.py."""
+    accelerator availability. Mirrors tests/conftest.py, INCLUDING the
+    8-device virtual mesh — without it the meshed-scheduler routing test
+    would degenerate to dp=1 and the report would record a pass that never
+    exercised sharding."""
     os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     try:
         import jax
 

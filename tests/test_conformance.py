@@ -373,17 +373,17 @@ def test_grpc_model_server_transcoding(env):
     assert resp.backend_received == body
 
 
-@record("GatewayFollowingEPPRoutingTPUScheduler")
-def test_routing_conformance_with_tpu_scheduler():
-    """The strictest routing test, run against the REAL batched TPU
-    scheduler (BatchingTPUPicker) instead of round-robin: 100 steered
-    requests per subset size, zero misroutes tolerated."""
-    env = ConformanceEnv(picker_mode="tpu")
+def _run_routing_conformance(picker_mode: str, pool_name: str,
+                             route_name: str) -> None:
+    """Zero-misroute routing contract shared by the TPU-scheduler and
+    meshed-scheduler conformance tests: 100 steered requests per subset
+    size (1, 2, 3), then unsteered traffic, zero misroutes tolerated."""
+    env = ConformanceEnv(picker_mode=picker_mode)
     env.apply_gateway(Gateway("primary-gateway"))
     env.apply_service(Service("epp-svc"))
     env.deploy_model_servers("primary-model-server", 3, {"app": "primary"})
-    env.apply_pool(make_pool("pool-tpu", {"app": "primary"}))
-    env.apply_route(simple_route("route-tpu", "primary-gateway", "pool-tpu"))
+    env.apply_pool(make_pool(pool_name, {"app": "primary"}))
+    env.apply_route(simple_route(route_name, "primary-gateway", pool_name))
     pods = [p for p in env.cluster.list_pods("default")
             if p.labels.get("app") == "primary"]
     try:
@@ -407,6 +407,24 @@ def test_routing_conformance_with_tpu_scheduler():
             assert resp.backend_pod.startswith("primary-")
     finally:
         env.close()
+
+
+@record("GatewayFollowingEPPRoutingTPUScheduler")
+def test_routing_conformance_with_tpu_scheduler():
+    """The strictest routing test, run against the REAL batched TPU
+    scheduler (BatchingTPUPicker) instead of round-robin."""
+    _run_routing_conformance("tpu", "pool-tpu", "route-tpu")
+
+
+@record("GatewayFollowingEPPRoutingMeshedScheduler")
+def test_routing_conformance_with_meshed_scheduler():
+    """The same zero-misroute routing contract, with the EPP's scheduling
+    cycle dp-sharded over the full device mesh (--mesh-devices production
+    path): distributing the pick must never change where traffic lands."""
+    import jax
+
+    assert len(jax.devices()) >= 8  # must actually exercise sharding
+    _run_routing_conformance("tpu-mesh", "pool-mesh", "route-mesh")
 
 
 @record("MultiClusterEndpointMode")
