@@ -68,16 +68,30 @@ def main() -> None:
     )
     duration = 20.0
     results = {}
-    for policy in ("least-kv", "tpu"):
+    for policy in ("least-kv", "tpu", "tpu+slo-admission"):
         cluster = SimCluster(n_pods=8, stub_cfg=stub, seed=0)
-        sched = tuned_scheduler() if policy == "tpu" else None
-        stats = cluster.run(policy, wl, duration_s=duration, scheduler=sched)
+        trainer = None
+        run_kwargs = {}
+        if policy == "tpu+slo-admission":
+            # Evidence leg (stderr only; the official metric stays the
+            # shipped default): predictive SLO admission on top of the
+            # tuned scheduler — sheds the few requests whose predicted
+            # TTFT already misses the 2.5 s SLO, lifting goodput AND
+            # attainment at this capacity-limited operating point.
+            from gie_tpu.models.latency import LatencyPredictor, OnlineTrainer
+
+            trainer = OnlineTrainer(LatencyPredictor(), batch_size=64)
+            run_kwargs = dict(trainer=trainer, train_every_s=0.5,
+                              slo_admission=True)
+        sched = tuned_scheduler() if policy.startswith("tpu") else None
+        stats = cluster.run(policy.split("+")[0], wl, duration_s=duration,
+                            scheduler=sched, **run_kwargs)
         results[policy] = stats
         print(
-            f"{policy:9s} goodput={stats.goodput_tokens_per_s:7.1f} tok/s "
+            f"{policy:17s} goodput={stats.goodput_tokens_per_s:7.1f} tok/s "
             f"ttft_p50={stats.ttft_p50_s:5.2f}s p99={stats.ttft_p99_s:5.2f}s "
             f"slo={stats.slo_attainment:.2f} hit={stats.prefix_hit_rate:.2f} "
-            f"completed={stats.completed}",
+            f"completed={stats.completed} shed={stats.shed}",
             file=sys.stderr,
         )
 

@@ -81,8 +81,14 @@ class ExtProcServerRunner:
                     if self.trainer.restore(opts.predictor_checkpoint_dir):
                         self.log.info("predictor checkpoint restored",
                                       dir=opts.predictor_checkpoint_dir)
-                predictor_fn = predictor_score_fn(predictor)
-                predictor_params = self.trainer.params
+                # Bind the scorer column into the jitted cycle ONLY when a
+                # weight ceiling is configured: SLO admission runs its own
+                # host-side forward (OnlineTrainer.predict_ttft), so with
+                # ceiling 0 the cycle would pay the [N, M] MLP forward
+                # every pick for a column multiplied by zero.
+                if float(weights.latency) > 0.0:
+                    predictor_fn = predictor_score_fn(predictor)
+                    predictor_params = self.trainer.params
                 # The configured latency weight is a CEILING, not a live
                 # weight: the Scheduler zeroes the column at startup and
                 # _train_loop phases it in via gate_latency_column as
@@ -279,9 +285,17 @@ class ExtProcServerRunner:
                 loss = self.trainer.train(steps=10)
                 if loss is None:
                     continue
-                self.scheduler.set_predictor_params(self.trainer.params)
-                live_w = self.scheduler.gate_latency_column(
-                    self.trainer.confidence())
+                if self.scheduler.predictor_fn is not None:
+                    # Only hand off params when the cycle actually binds
+                    # the column: installing a params tree into a cycle
+                    # compiled with predictor_params=None flips the jit
+                    # argument's pytree structure and recompiles every
+                    # warmed bucket inside the pick lock.
+                    self.scheduler.set_predictor_params(self.trainer.params)
+                    live_w = self.scheduler.gate_latency_column(
+                        self.trainer.confidence())
+                else:
+                    live_w = 0.0
                 self.log.v(3).info("predictor trained", loss=loss,
                                    latency_weight=live_w)
                 if self.opts.predictor_checkpoint_dir:

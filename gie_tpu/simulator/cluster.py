@@ -251,7 +251,12 @@ class SimCluster:
                 self._scrape_all(clock)
                 next_scrape = clock + scrape_interval_s
             if trainer is not None and clock >= next_train:
-                if trainer.train(steps=5) is not None and scheduler is not None:
+                if (trainer.train(steps=5) is not None
+                        and scheduler is not None
+                        and scheduler.predictor_fn is not None):
+                    # Same guard as the runner's train loop: a params
+                    # handoff into a cycle compiled without the column
+                    # flips the jit argument structure and recompiles.
                     scheduler.set_predictor_params(trainer.params)
                     scheduler.gate_latency_column(trainer.confidence())
                 next_train = clock + train_every_s

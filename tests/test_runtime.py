@@ -167,3 +167,18 @@ def test_restored_confidence_applies_at_startup(tmp_path):
                     scheduler_config=str(cfg_yaml))
     runner2 = ExtProcServerRunner(opts2, FakeCluster())
     assert float(runner2.scheduler.weights.latency) == 0.0
+
+
+def test_predictor_without_ceiling_skips_cycle_column():
+    """With --enable-predictor but no weights.latency ceiling, the trainer
+    (and SLO admission) run but the jitted cycle must NOT pay the [N, M]
+    MLP forward for a column multiplied by zero."""
+    from gie_tpu.controller.cluster import FakeCluster
+    from gie_tpu.runtime.options import Options
+    from gie_tpu.runtime.runner import ExtProcServerRunner
+
+    opts = Options(pool_name="p", enable_predictor=True)
+    runner = ExtProcServerRunner(opts, FakeCluster())
+    assert runner.trainer is not None          # admission path available
+    assert runner.scheduler.predictor_fn is None   # no cycle cost
+    assert runner.scheduler.base_latency_weight == 0.0
