@@ -25,6 +25,10 @@ VERSION_X = "v1alpha1"
 # Annotation enabling per-pod DP-rank port filtering
 # (reference pkg/lwepp/datastore/datastore.go:59-64).
 ACTIVE_PORTS_ANNOTATION = f"{GROUP}/active-ports"
+# Pod label declaring the serving role for disaggregated prefill/decode
+# ("prefill" | "decode" | "both"/absent). Reference analogue: none — the
+# reference lists disaggregated serving as roadmap (README.md:115).
+ROLE_LABEL = f"{GROUP}/role"
 # Annotation requesting multi-cluster export
 # (reference apix/v1alpha1/shared_types.go:19-24).
 EXPORT_ANNOTATION = f"{GROUP_X}/export"

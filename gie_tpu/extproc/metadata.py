@@ -10,6 +10,12 @@ DESTINATION_ENDPOINT_NAMESPACE = "envoy.lb"
 DESTINATION_ENDPOINT_KEY = "x-gateway-destination-endpoint"
 # Response-phase metadata key reporting which endpoint actually served.
 DESTINATION_ENDPOINT_SERVED_KEY = "x-gateway-destination-endpoint-served"
+# Disaggregated prefill/decode (beyond-reference; the reference lists
+# disaggregated serving as roadmap README.md:115): with
+# ProfileConfig.pd_disaggregation the destination endpoint is the DECODE
+# worker and this header names the prefill worker the data plane should
+# run prefill on (e.g. for a llm-d-style disaggregation sidecar).
+PREFILL_ENDPOINT_KEY = "x-gateway-prefill-endpoint"
 # Conformance echo header (reference Appendix B test affordances).
 CONFORMANCE_TEST_RESULT_HEADER = "x-conformance-test-served-endpoint"
 # Flow-control fairness ID header (proposal 1199 / flow control).

@@ -71,6 +71,11 @@ class PickResult:
     # the data plane failed over to — otherwise the primary's charge leaks
     # and the fallback gets a spurious release.
     charged_slot: Optional[int] = None
+    # Disaggregated prefill/decode: every (slot, cost, hostport) the cycle
+    # charged — both workers — released together on served feedback (the
+    # hostport re-resolves to guard against slot reuse). When set it
+    # supersedes charged_slot/assumed_cost for release bookkeeping.
+    charged: Optional[list] = None
     # Optional (feature_row, picked_at) recorded for online latency training.
     feedback: Optional[tuple] = None
 

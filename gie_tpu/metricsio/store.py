@@ -16,9 +16,17 @@ from typing import Iterable, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from gie_tpu.api.types import ROLE_LABEL
 from gie_tpu.datastore.objects import Endpoint
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.types import EndpointBatch
+
+# Pod-label value -> Role column value (unknown/absent -> BOTH).
+_ROLE_BY_LABEL = {
+    "prefill": int(C.Role.PREFILL),
+    "decode": int(C.Role.DECODE),
+    "both": int(C.Role.BOTH),
+}
 
 
 class MetricsStore:
@@ -84,11 +92,16 @@ class MetricsStore:
             ).astype(np.float32)
         metrics[:, C.Metric.METRICS_AGE_S] = age
         valid = np.zeros((C.M_MAX,), bool)
+        role = np.zeros((C.M_MAX,), np.int32)
         for ep in endpoints:
             valid[ep.slot] = True
+            labels = getattr(ep, "labels", None) or {}
+            role[ep.slot] = _ROLE_BY_LABEL.get(
+                labels.get(ROLE_LABEL, ""), C.Role.BOTH)
         return EndpointBatch(
             metrics=jnp.asarray(metrics),
             valid=jnp.asarray(valid),
             lora_active=jnp.asarray(active),
             lora_waiting=jnp.asarray(waiting),
+            role=jnp.asarray(role),
         )

@@ -32,7 +32,7 @@ from gie_tpu.extproc import metadata as mdkeys
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.hashing import batch_chunk_hashes
 from gie_tpu.models.latency import host_features
-from gie_tpu.sched.profile import Scheduler, request_cost_host
+from gie_tpu.sched.profile import Scheduler, pd_costs_host, request_cost_host
 from gie_tpu.sched.types import RequestBatch
 from gie_tpu.utils.lora import LoraRegistry
 
@@ -184,22 +184,38 @@ class BatchingTPUPicker:
         # primary's charge would leak and the fallback would get a spurious
         # release. Guard against slot reuse — if the primary was evicted, its
         # eviction already cleared the slot's load, so skip the release.
-        release_slot = None
-        charged_slot = getattr(pick_result, "charged_slot", None)
-        primary = getattr(pick_result, "endpoint", None)
-        if charged_slot is not None and primary is not None:
-            ep = self.datastore.endpoint_by_hostport(primary)
-            if ep is not None and ep.slot == charged_slot:
-                release_slot = charged_slot
-        else:  # legacy pick results without charge bookkeeping
-            ep = self.datastore.endpoint_by_hostport(served_hostport)
-            if ep is not None:
-                release_slot = ep.slot
-        if release_slot is not None:
-            self.scheduler.complete(
-                np.asarray([release_slot], np.int32),
-                np.asarray([cost], np.float32),
-            )
+        charged = getattr(pick_result, "charged", None)
+        if charged:
+            # Disaggregated mode: release every charged worker whose slot
+            # still belongs to the charged hostport (slot-reuse guard).
+            slots, costs = [], []
+            for slot, slot_cost, hostport in charged:
+                ep = self.datastore.endpoint_by_hostport(hostport)
+                if ep is not None and ep.slot == slot:
+                    slots.append(slot)
+                    costs.append(slot_cost)
+            if slots:
+                self.scheduler.complete(
+                    np.asarray(slots, np.int32),
+                    np.asarray(costs, np.float32),
+                )
+        else:
+            release_slot = None
+            charged_slot = getattr(pick_result, "charged_slot", None)
+            primary = getattr(pick_result, "endpoint", None)
+            if charged_slot is not None and primary is not None:
+                ep = self.datastore.endpoint_by_hostport(primary)
+                if ep is not None and ep.slot == charged_slot:
+                    release_slot = charged_slot
+            else:  # legacy pick results without charge bookkeeping
+                ep = self.datastore.endpoint_by_hostport(served_hostport)
+                if ep is not None:
+                    release_slot = ep.slot
+            if release_slot is not None:
+                self.scheduler.complete(
+                    np.asarray([release_slot], np.int32),
+                    np.asarray([cost], np.float32),
+                )
         feedback = getattr(pick_result, "feedback", None)
         if self.trainer is not None and feedback is not None:
             features, slot, picked_at, picked_hostport = feedback
@@ -349,6 +365,11 @@ class BatchingTPUPicker:
         by_slot = {ep.slot: ep for ep in endpoints}
         indices = np.asarray(result.indices)
         status = np.asarray(result.status)
+        # Disaggregated prefill/decode: the cycle's prefill picks (None in
+        # classic mode — the pytree field is absent from the result).
+        prefill_np = (
+            np.asarray(result.prefill) if result.prefill is not None else None
+        )
         for i, item in enumerate(batch):
             own_metrics.PICK_LATENCY.observe(time.monotonic() - item.enqueued_at)
             if status[i] == C.Status.SHED:
@@ -376,6 +397,25 @@ class BatchingTPUPicker:
                     # if that slot wasn't routable, picked[0] differs and the
                     # observe_served guard will skip the release.
                     res.charged_slot = int(indices[i][0])
+                    if prefill_np is not None:
+                        p_slot = int(prefill_np[i])
+                        p_ep = by_slot.get(p_slot)
+                        p_cost, d_cost = pd_costs_host(float(plen[i]), 0.0)
+                        # pd charge bookkeeping is ALWAYS a charged list:
+                        # falling back to the legacy single-slot path would
+                        # release the full request cost from a slot the
+                        # cycle only charged d_cost.
+                        res.charged = [(res.charged_slot, d_cost, picked[0])]
+                        if p_ep is not None:
+                            res.extra_headers = {
+                                **res.extra_headers,
+                                mdkeys.PREFILL_ENDPOINT_KEY: p_ep.hostport,
+                            }
+                            res.charged.append(
+                                (p_slot, p_cost, p_ep.hostport))
+                        # else: the prefill pod vanished between the cycle
+                        # and this wave — its eviction already cleared the
+                        # slot's load, so there is nothing to release.
                     if self.trainer is not None:
                         slot = int(indices[i][0])
                         res.feedback = (
@@ -446,7 +486,12 @@ class BatchingTPUPicker:
                 item.result = None
                 item.error = ShedError()
                 # The cycle charged the pick; the request will not run.
-                if res.charged_slot is not None and res.charged_slot >= 0:
+                if res.charged:
+                    self.scheduler.complete(
+                        np.asarray([s for s, _, _ in res.charged], np.int32),
+                        np.asarray([c for _, c, _ in res.charged], np.float32),
+                    )
+                elif res.charged_slot is not None and res.charged_slot >= 0:
                     self.scheduler.complete(
                         np.asarray([res.charged_slot], np.int32),
                         np.asarray([res.assumed_cost], np.float32),

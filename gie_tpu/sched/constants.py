@@ -98,3 +98,20 @@ class Criticality(enum.IntEnum):
     CRITICAL = 0
     STANDARD = 1
     SHEDDABLE = 2
+
+
+class Role(enum.IntEnum):
+    """Endpoint serving role for disaggregated prefill/decode.
+
+    The reference names disaggregated serving as roadmap item 8
+    (README.md:115) and anticipates role-partitioned candidate sets in the
+    scheduler's assignment informer (docs/proposals/006-scheduler/
+    README.md:158 'heterogeneous server roles (prefill-heavy,
+    prefill/decode split)'); neither is implemented there. Here roles are
+    a first-class column of the endpoint tensor: BOTH serves the classic
+    co-located path, PREFILL/DECODE partition the candidate masks of the
+    dual pick (profile.scheduling_cycle with pd_disaggregation=True)."""
+
+    BOTH = 0
+    PREFILL = 1
+    DECODE = 2

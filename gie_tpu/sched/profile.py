@@ -56,6 +56,14 @@ class ProfileConfig:
 
     queue_limit: float = 128.0   # saturation filter: max queue depth
     kv_limit: float = 0.95       # saturation filter: max KV-cache utilization
+    # Disaggregated prefill/decode (reference roadmap README.md:115; role-
+    # partitioned candidates anticipated by 006 README:158). When on, the
+    # cycle runs a DUAL pick: prefill over PREFILL/BOTH-role endpoints with
+    # the full blend (prefix/session locality lives on prefill workers),
+    # decode over DECODE/BOTH-role endpoints with the locality columns
+    # dropped plus a co-location bonus (same endpoint = no KV transfer).
+    pd_disaggregation: bool = False
+    pd_colocation_bonus: float = 0.25
     queue_norm: float = 64.0     # queue scorer normalization
     load_norm: float = 32.0      # assumed-load scorer normalization
     load_decay: float = 0.95     # per-cycle exponential decay of assumed load
@@ -95,6 +103,23 @@ def request_cost_host(prompt_len: float, decode_len: float = 0.0) -> float:
     """Host-side twin of request_cost — completion feedback MUST release
     exactly what pick time charged, so both paths share these constants."""
     return float(np.clip((prompt_len + decode_len) / 2048.0, 0.25, 8.0))
+
+
+def pd_costs(reqs: RequestBatch) -> tuple[jax.Array, jax.Array]:
+    """Split assumed costs for the dual pick: the prefill worker carries
+    the prompt, the decode worker the generation."""
+    prefill = jnp.clip(reqs.prompt_len / 2048.0, 0.125, 8.0)
+    decode = jnp.clip(reqs.decode_len / 2048.0, 0.125, 8.0)
+    return prefill, decode
+
+
+def pd_costs_host(prompt_len: float, decode_len: float) -> tuple[float, float]:
+    """Host-side twin of pd_costs (same release-what-you-charged contract
+    as request_cost_host)."""
+    return (
+        float(np.clip(prompt_len / 2048.0, 0.125, 8.0)),
+        float(np.clip(decode_len / 2048.0, 0.125, 8.0)),
+    )
 
 
 def build_stages(
@@ -170,6 +195,47 @@ def build_stages(
     return mask, shed, named, stacked, wvec, total
 
 
+def _pick_stage(
+    total: jax.Array,
+    stacked: jax.Array,
+    wvec: jax.Array,
+    mask: jax.Array,
+    shed: jax.Array,
+    reqs: RequestBatch,
+    eps: EndpointBatch,
+    state: SchedState,
+    key: jax.Array,
+    cfg: ProfileConfig,
+) -> PickResult:
+    """The configured picker over one (total, mask) pair — shared by the
+    classic single pick and the dual prefill/decode picks."""
+    if cfg.picker == "topk" and cfg.use_pallas_topk:
+        from gie_tpu.ops import interpret_default
+        from gie_tpu.ops.fused_topk import fused_blend_topk
+
+        vals, idxs = fused_blend_topk(
+            stacked, wvec, mask, k=C.FALLBACKS, interpret=interpret_default()
+        )
+        return pickers.finalize_from_topk(vals, idxs, mask, shed, reqs.valid)
+    if cfg.picker == "random":
+        return pickers.weighted_random_picker(
+            total, mask, shed, reqs.valid, key,
+            temperature=cfg.sample_temperature,
+        )
+    if cfg.picker == "sinkhorn":
+        from gie_tpu.sched.sinkhorn import sinkhorn_picker
+
+        return sinkhorn_picker(
+            total, mask, shed, reqs.valid, eps, key,
+            queue_limit=cfg.queue_limit,
+            tau=cfg.sinkhorn_tau,
+            iters=cfg.sinkhorn_iters,
+            rounding_temp=cfg.sinkhorn_rounding_temp,
+            use_pallas=cfg.use_pallas_sinkhorn,
+        )
+    return pickers.topk_picker(total, mask, shed, reqs.valid, state.rr)
+
+
 def scheduling_cycle(
     state: SchedState,
     reqs: RequestBatch,
@@ -182,38 +248,21 @@ def scheduling_cycle(
     predictor_fn: Optional[PredictorFn],
 ) -> tuple[PickResult, SchedState]:
     """One full scheduling cycle. Pure; jit-compiled per (N-bucket, cfg)."""
-    mask, shed, _named, stacked, wvec, total = build_stages(
+    mask, shed, named, stacked, wvec, total = build_stages(
         state, reqs, eps, weights,
         cfg=cfg, predictor_fn=predictor_fn, predictor_params=predictor_params,
     )
 
+    if cfg.pd_disaggregation:
+        return _pd_cycle(
+            state, reqs, eps, key, cfg,
+            mask=mask, shed=shed, named=named, stacked=stacked, wvec=wvec,
+            total=total,
+        )
+
     # ---- Pick stage ------------------------------------------------------
-    if cfg.picker == "topk" and cfg.use_pallas_topk:
-        from gie_tpu.ops import interpret_default
-        from gie_tpu.ops.fused_topk import fused_blend_topk
-
-        vals, idxs = fused_blend_topk(
-            stacked, wvec, mask, k=C.FALLBACKS, interpret=interpret_default()
-        )
-        result = pickers.finalize_from_topk(vals, idxs, mask, shed, reqs.valid)
-    elif cfg.picker == "random":
-        result = pickers.weighted_random_picker(
-            total, mask, shed, reqs.valid, key,
-            temperature=cfg.sample_temperature,
-        )
-    elif cfg.picker == "sinkhorn":
-        from gie_tpu.sched.sinkhorn import sinkhorn_picker
-
-        result = sinkhorn_picker(
-            total, mask, shed, reqs.valid, eps, key,
-            queue_limit=cfg.queue_limit,
-            tau=cfg.sinkhorn_tau,
-            iters=cfg.sinkhorn_iters,
-            rounding_temp=cfg.sinkhorn_rounding_temp,
-            use_pallas=cfg.use_pallas_sinkhorn,
-        )
-    else:
-        result = pickers.topk_picker(total, mask, shed, reqs.valid, state.rr)
+    result = _pick_stage(
+        total, stacked, wvec, mask, shed, reqs, eps, state, key, cfg)
 
     # ---- State update ----------------------------------------------------
     primary = result.indices[:, 0]                  # i32[N], -1 on non-OK
@@ -233,6 +282,112 @@ def scheduling_cycle(
         assumed_load=new_load,
         rr=state.rr + jnp.uint32(1),
         tick=state.tick + jnp.uint32(1),
+    )
+    return result, new_state
+
+
+# Locality columns that only describe the PREFILL side (the prefix cache
+# and session affinity live where prefill runs); the decode blend drops
+# them and uses load/queue/kv signals plus the co-location bonus.
+_PREFILL_ONLY_COLUMNS = ("prefix", "session")
+
+
+def _pd_cycle(
+    state: SchedState,
+    reqs: RequestBatch,
+    eps: EndpointBatch,
+    key: jax.Array,
+    cfg: ProfileConfig,
+    *,
+    mask: jax.Array,
+    shed: jax.Array,
+    named: dict,
+    stacked: jax.Array,
+    wvec: jax.Array,
+    total: jax.Array,
+) -> tuple[PickResult, SchedState]:
+    """Dual pick for disaggregated serving: prefill endpoint (full blend
+    over PREFILL/BOTH roles) then decode endpoint (locality columns
+    dropped, co-location bonus, over DECODE/BOTH roles). `indices` is the
+    decode pick — the destination that owns the response stream — and
+    `prefill` names the prefill worker (x-gateway-prefill-endpoint)."""
+    prefill_ok = mask & (eps.role != C.Role.DECODE)[None, :]
+    decode_ok = mask & (eps.role != C.Role.PREFILL)[None, :]
+    key_p, key_d = jax.random.split(key)
+
+    p_res = _pick_stage(
+        total, stacked, wvec, prefill_ok, shed, reqs, eps, state, key_p, cfg)
+    p_primary = p_res.indices[:, 0]
+
+    keep = jnp.asarray(
+        [0.0 if k in _PREFILL_ONLY_COLUMNS else 1.0 for k in named],
+        jnp.float32,
+    )
+    d_wvec = wvec * keep
+    d_total = jnp.einsum("s,snm->nm", d_wvec, stacked) / jnp.maximum(
+        jnp.sum(d_wvec), jnp.float32(1e-6)
+    )
+    # Same endpoint as the prefill pick = no KV transfer: bonus on that
+    # column (only BOTH-role endpoints can win both picks).
+    m = d_total.shape[1]
+    colocated = (
+        jax.lax.broadcasted_iota(jnp.int32, (1, m), 1) == p_primary[:, None]
+    )
+    d_total = d_total + jnp.float32(cfg.pd_colocation_bonus) * colocated
+
+    # The fused pallas topk recomputes the blend from (stacked, wvec) and
+    # would silently drop the co-location bonus carried by d_total — the
+    # decode pick always takes the XLA path (the kernel stays available
+    # for the prefill pick, whose total IS the plain blend).
+    d_cfg = (
+        dataclasses.replace(cfg, use_pallas_topk=False)
+        if cfg.use_pallas_topk else cfg
+    )
+    d_res = _pick_stage(
+        d_total, stacked, d_wvec, decode_ok, shed, reqs, eps, state, key_d,
+        d_cfg)
+    d_primary = d_res.indices[:, 0]
+
+    ok = (p_primary >= 0) & (d_primary >= 0)
+    # SHED (from either pick) wins over NO_CAPACITY; OK requires both.
+    status = jnp.maximum(p_res.status, d_res.status)
+    status = jnp.where(ok & (status == C.Status.OK), C.Status.OK, status)
+    status = jnp.where(
+        ~ok & (status == C.Status.OK), C.Status.NO_CAPACITY, status)
+
+    # ---- State update: charge each side's cost to its own worker --------
+    p_cost_all, d_cost_all = pd_costs(reqs)
+    p_cost = jnp.where(ok, p_cost_all, 0.0)
+    d_cost = jnp.where(ok, d_cost_all, 0.0)
+    p_slot = jnp.where(ok, p_primary, C.M_MAX - 1)
+    d_slot = jnp.where(ok, d_primary, C.M_MAX - 1)
+    added = (
+        jnp.zeros((C.M_MAX,), jnp.float32)
+        .at[p_slot].add(p_cost)
+        .at[d_slot].add(d_cost)
+    )
+    new_load = state.assumed_load * cfg.load_decay + added
+
+    new_prefix = (
+        # Only OK requests run: a rejected request must not record its
+        # chunks as cached on the prefill worker (classic path gets this
+        # for free via primary=-1 on non-OK rows).
+        prefix.insert(
+            state.prefix, reqs, jnp.where(ok, p_primary, -1), state.tick)
+        if cfg.enable_prefix
+        else state.prefix
+    )
+    new_state = SchedState(
+        prefix=new_prefix,
+        assumed_load=new_load,
+        rr=state.rr + jnp.uint32(1),
+        tick=state.tick + jnp.uint32(1),
+    )
+    result = PickResult(
+        indices=d_res.indices,
+        status=status,
+        scores=d_res.scores,
+        prefill=jnp.where(ok, p_primary, -1),
     )
     return result, new_state
 

@@ -37,6 +37,13 @@ class EndpointBatch:
     valid: jax.Array         # bool[M_MAX]
     lora_active: jax.Array   # i32[M_MAX, LORA_SLOTS], adapter ids, -1 = empty
     lora_waiting: jax.Array  # i32[M_MAX, LORA_SLOTS]
+    # Serving role per slot (constants.Role; BOTH=0 default) for
+    # disaggregated prefill/decode. Defaulted so pre-existing explicit
+    # EndpointBatch(...) constructions keep their meaning (co-located
+    # serving). numpy, not jnp: import-time device constants are banned.
+    role: jax.Array = flax.struct.field(
+        default_factory=lambda: np.zeros((C.M_MAX,), np.int32)
+    )
 
     @staticmethod
     def empty(m: int = C.M_MAX) -> "EndpointBatch":
@@ -45,6 +52,7 @@ class EndpointBatch:
             valid=jnp.zeros((m,), bool),
             lora_active=jnp.full((m, C.LORA_SLOTS), -1, jnp.int32),
             lora_waiting=jnp.full((m, C.LORA_SLOTS), -1, jnp.int32),
+            role=jnp.zeros((m,), jnp.int32),
         )
 
 
@@ -149,6 +157,13 @@ class PickResult:
     indices: jax.Array  # i32[N, FALLBACKS]
     status: jax.Array   # i32[N]
     scores: jax.Array   # f32[N, FALLBACKS] total score of each chosen endpoint
+    # Disaggregated prefill/decode (ProfileConfig.pd_disaggregation): the
+    # prefill endpoint slot per request (-1 when not applicable). In pd
+    # mode `indices` holds the DECODE pick (the destination that owns the
+    # response stream) and `prefill` names the worker the data plane should
+    # run prefill on (x-gateway-prefill-endpoint). None in classic mode so
+    # the pytree structure — and every compiled cycle — is unchanged.
+    prefill: object = None  # i32[N] | None
 
 
 @flax.struct.dataclass

@@ -90,6 +90,7 @@ class SimCluster:
             VLLMStub(cfg, name=f"pod-{i}") for i, cfg in enumerate(cfgs)
         ]
         self.n = n_pods
+        self.roles = [cfg.role for cfg in cfgs]
         self.rng = np.random.default_rng(seed)
         self.store = MetricsStore()
         self.lora_reg = LoraRegistry()
@@ -104,13 +105,17 @@ class SimCluster:
             )
 
     def _endpoint_batch(self, now: float):
+        from gie_tpu.api.types import ROLE_LABEL
+
         class _Ep:
-            __slots__ = ("slot",)
+            __slots__ = ("slot", "labels")
 
-            def __init__(self, slot):
+            def __init__(self, slot, role):
                 self.slot = slot
+                self.labels = {ROLE_LABEL: role}
 
-        return self.store.endpoint_batch([_Ep(i) for i in range(self.n)], now=now)
+        return self.store.endpoint_batch(
+            [_Ep(i, self.roles[i]) for i in range(self.n)], now=now)
 
     def run(
         self,
@@ -123,6 +128,7 @@ class SimCluster:
         trainer=None,
         train_every_s: float = 1.0,
         slo_admission: bool = False,
+        kv_transfer_s_per_kb: float = 0.002,
     ) -> RunStats:
         wl = workload
         sessions = [
@@ -132,6 +138,19 @@ class SimCluster:
         ]
         if policy == "tpu" and scheduler is None:
             scheduler = tuned_scheduler()
+        pd = (policy == "tpu" and scheduler is not None
+              and scheduler.cfg.pd_disaggregation)
+        if pd and (trainer is not None or slo_admission):
+            raise ValueError(
+                "pd_disaggregation with trainer/slo_admission is not "
+                "modeled in the sim yet")
+        from gie_tpu.sched.profile import pd_costs_host
+
+        # Disaggregated bookkeeping: prefill jobs in flight on prefill
+        # workers, decode jobs waiting on KV transfer, decode jobs running.
+        prefill_jobs: dict = {}   # (pod, rid) -> (d_pod, prompt, D, lora, t0)
+        pending_decode: list = []  # (ready_t, d_pod, prompt, D, lora, t0, hit)
+        decode_jobs: dict = {}    # (pod, rid) -> (t0, t_submit, pbytes, hit)
         rr_counter = 0
         clock = 0.0
         next_scrape = 0.0
@@ -164,7 +183,7 @@ class SimCluster:
 
             # --- schedule -------------------------------------------------
             if n_new:
-                picks = self._schedule(
+                picks, prefill_picks = self._schedule(
                     policy, scheduler, prompts, decodes, loras, clock, rr_counter
                 )
                 rr_counter += n_new
@@ -219,6 +238,24 @@ class SimCluster:
                         zip(prompts, decodes, loras, picks)):
                     if not admitted[i]:
                         continue
+                    if pd:
+                        p_pod = prefill_picks[i]
+                        if pod < 0 or p_pod < 0:
+                            # Rejected by the dual pick (no capacity on one
+                            # role): the cycle charged nothing; count as
+                            # shed rather than executing on a wrong-role
+                            # pod.
+                            shed += 1
+                            continue
+                        # Dual-phase execution: the prompt runs on the
+                        # PREFILL worker (a decode_tokens=0 job models
+                        # "compute KV, emit nothing"); its completion
+                        # triggers the KV transfer and the decode job.
+                        rid = self.stubs[p_pod].submit(
+                            prompt, decode_tokens=0.0, lora=lora)
+                        prefill_jobs[(p_pod, rid)] = (
+                            pod, prompt, decode, lora, clock)
+                        continue
                     rid = self.stubs[pod].submit(
                         prompt, decode_tokens=decode, lora=lora)
                     if trainer is not None:
@@ -230,6 +267,39 @@ class SimCluster:
             # --- advance the fleet ----------------------------------------
             for slot, stub in enumerate(self.stubs):
                 for comp in stub.step(dt):
+                    if pd and (slot, comp.rid) in prefill_jobs:
+                        # Prefill done: start the KV transfer; the decode
+                        # job submits when it lands. Release the prefill
+                        # worker's charge (pd split-charging twin).
+                        d_pod, prompt, decode, lora, t0 = prefill_jobs.pop(
+                            (slot, comp.rid))
+                        transfer_s = (
+                            0.0 if d_pod == slot
+                            else kv_transfer_s_per_kb * len(prompt) / 1024.0)
+                        pending_decode.append(
+                            (clock + transfer_s, d_pod, prompt, decode,
+                             lora, t0, comp.hit_fraction))
+                        p_cost, _ = pd_costs_host(float(len(prompt)), decode)
+                        scheduler.complete(
+                            np.asarray([slot], np.int32),
+                            np.asarray([p_cost], np.float32))
+                        continue
+                    if pd and (slot, comp.rid) in decode_jobs:
+                        t0, t_d, pbytes, hit = decode_jobs.pop(
+                            (slot, comp.rid))
+                        # User-visible TTFT spans the whole chain: prefill
+                        # queue+compute, transfer, decode queue+first token
+                        # = (decode submit time + decode-relative ttft)
+                        #   - original arrival.
+                        user_ttft = t_d + comp.ttft_s - t0
+                        completions.append(dataclasses.replace(
+                            comp, ttft_s=max(user_ttft, 0.0),
+                            hit_fraction=hit, prompt_bytes=pbytes))
+                        _, d_cost = pd_costs_host(pbytes, comp.output_tokens)
+                        scheduler.complete(
+                            np.asarray([slot], np.int32),
+                            np.asarray([d_cost], np.float32))
+                        continue
                     completions.append(comp)
                     if trainer is not None:
                         feats = feature_log.pop((slot, comp.rid), None)
@@ -246,6 +316,17 @@ class SimCluster:
                             np.asarray([slot], np.int32),
                             np.asarray([cost], np.float32),
                         )
+            if pd and pending_decode:
+                due = [x for x in pending_decode if x[0] <= clock]
+                if due:
+                    pending_decode = [
+                        x for x in pending_decode if x[0] > clock]
+                    for _t, d_pod, prompt, decode, lora, t0, hit in due:
+                        rid = self.stubs[d_pod].submit(
+                            prompt, decode_tokens=decode, lora=lora,
+                            prefill_done=True)
+                        decode_jobs[(d_pod, rid)] = (
+                            t0, clock, float(len(prompt)), hit)
             clock += dt
             if clock >= next_scrape:
                 self._scrape_all(clock)
@@ -284,10 +365,13 @@ class SimCluster:
 
     def _schedule(
         self, policy, scheduler, prompts, decodes, loras, now, rr_counter
-    ) -> list[int]:
+    ) -> tuple[list[int], Optional[list[int]]]:
+        """-> (destination picks, prefill picks or None). In pd mode a -1
+        pick means the dual pick rejected the row (dropped by the caller);
+        classic mode applies a least-kv fallback instead."""
         n = len(prompts)
         if policy == "round-robin":
-            return [(rr_counter + i) % self.n for i in range(n)]
+            return [(rr_counter + i) % self.n for i in range(n)], None
         if policy == "least-kv":
             # The reference default scorer: per request, pick the endpoint
             # with the most free KV cache (queue-depth tie-break), reading
@@ -302,7 +386,7 @@ class SimCluster:
                 picks.append(p)
                 # emulate the reference's assumed-load bump between scrapes
                 queue[p] += 1.0
-            return picks
+            return picks, None
         if policy == "tpu":
             hashes, counts = batch_chunk_hashes(prompts)
             lora_ids = np.asarray(
@@ -322,11 +406,19 @@ class SimCluster:
             eps = self._endpoint_batch(now)
             result = scheduler.pick(reqs, eps)
             primary = np.asarray(result.indices[:, 0])
+            if result.prefill is not None:
+                # pd mode: NO fallback — a non-OK row was charged nothing
+                # by the cycle and must not execute on a role it would
+                # violate (a role-blind least-kv fallback would both break
+                # the fleet model and desync charge/release accounting).
+                # The run loop drops rows whose pick is -1 as rejected.
+                return ([int(p) for p in primary],
+                        [int(p) for p in np.asarray(result.prefill)])
             # Fallback for any non-OK rows: least-kv choice.
             bad = primary < 0
             if bad.any():
                 kv = self.store._metrics[: self.n, C.Metric.KV_CACHE_UTIL]
                 primary = primary.copy()
                 primary[bad] = int(np.argmin(kv))
-            return [int(p) for p in primary]
+            return [int(p) for p in primary], None
         raise ValueError(f"unknown policy {policy!r}")

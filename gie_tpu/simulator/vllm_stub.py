@@ -33,6 +33,17 @@ class StubConfig:
     prefix_cache_chunks: int = 4096
     max_lora: int = 4
     lora_load_s: float = 0.5        # adapter cold-load penalty
+    # Serving role for disaggregated prefill/decode fleets
+    # ("both" | "prefill" | "decode"); maps to the
+    # inference.networking.k8s.io/role pod label in a real cluster.
+    role: str = "both"
+    # Prefill/decode interference under continuous batching: while any
+    # running request is still prefilling, decode token generation on this
+    # pod runs at (1 - decode_interference) of its rate — the prefill-
+    # priority stall that motivates disaggregated serving (decode-phase
+    # latency spikes whenever a long prompt enters the batch). 0.0 (off)
+    # preserves the classic independent-progress model.
+    decode_interference: float = 0.0
 
 
 @dataclasses.dataclass
@@ -48,6 +59,9 @@ class _Req:
     decode_left_tokens: float = 0.0
     first_token_at: float = -1.0
     hit_fraction: float = 0.0
+    # Disaggregated decode job: KV arrived via transfer — no prefill work,
+    # but the prompt's KV blocks are still held on this worker.
+    prefill_done: bool = False
 
 
 @dataclasses.dataclass
@@ -83,6 +97,7 @@ class VLLMStub:
         prompt: bytes,
         decode_tokens: float = 128.0,
         lora: Optional[str] = None,
+        prefill_done: bool = False,
     ) -> int:
         rid = self._next_id
         self._next_id += 1
@@ -102,6 +117,7 @@ class VLLMStub:
             lora=lora,
             chunks=[int(h) for h in hashes[:n]],
             submitted_at=self.clock,
+            prefill_done=prefill_done,
         )
         self.queue.append(req)
         return rid
@@ -190,16 +206,29 @@ class VLLMStub:
             if req.lora in self._lora_waiting:
                 self._lora_waiting.remove(req.lora)
                 self._lora_info_ts = self.clock
-            req.hit_fraction = self._prefix_hit(req)
-            effective_prompt = req.prompt_tokens * (1.0 - req.hit_fraction)
-            req.prefill_left_s += effective_prompt / self.cfg.prefill_tokens_per_s
+            if req.prefill_done:
+                # KV transferred in: no prompt prefill work (any accrued
+                # LoRA cold-load penalty in prefill_left_s stands — the
+                # adapter must be resident on the decode worker too); the
+                # local prefix cache is untouched (this worker never ran
+                # the prompt).
+                req.hit_fraction = 1.0
+            else:
+                req.hit_fraction = self._prefix_hit(req)
+                effective_prompt = req.prompt_tokens * (1.0 - req.hit_fraction)
+                req.prefill_left_s += (
+                    effective_prompt / self.cfg.prefill_tokens_per_s)
+                self._prefix_insert(req)
             req.decode_left_tokens = req.decode_tokens
             req.started_at = self.clock
-            self._prefix_insert(req)
             self.running.append(req)
 
     def _progress(self, dt: float) -> None:
         finished = []
+        any_prefill = any(r.prefill_left_s > 0 for r in self.running)
+        decode_rate = self.cfg.decode_tokens_per_s * (
+            1.0 - self.cfg.decode_interference if any_prefill else 1.0
+        )
         for r in self.running:
             if r.prefill_left_s > 0:
                 r.prefill_left_s -= dt
@@ -208,7 +237,7 @@ class VLLMStub:
                 continue
             if r.first_token_at < 0:
                 r.first_token_at = self.clock
-            r.decode_left_tokens -= dt * self.cfg.decode_tokens_per_s
+            r.decode_left_tokens -= dt * decode_rate
             if r.decode_left_tokens <= 0:
                 finished.append(r)
         for r in finished:

@@ -26,6 +26,7 @@ def make_endpoints(
     max_lora: float = 0.0,
     lora_active: Optional[Sequence[Sequence[int]]] = None,
     lora_waiting: Optional[Sequence[Sequence[int]]] = None,
+    role: Optional[Sequence[int]] = None,
 ) -> EndpointBatch:
     """Build an EndpointBatch with `m` valid endpoint slots."""
     metrics = np.zeros((C.M_MAX, C.NUM_METRICS), np.float32)
@@ -47,11 +48,15 @@ def make_endpoints(
 
     valid = np.zeros((C.M_MAX,), bool)
     valid[:m] = True
+    roles = np.zeros((C.M_MAX,), np.int32)
+    if role is not None:
+        roles[:m] = np.asarray(role, np.int32)
     return EndpointBatch(
         metrics=jnp.asarray(metrics),
         valid=jnp.asarray(valid),
         lora_active=jnp.asarray(active),
         lora_waiting=jnp.asarray(waiting),
+        role=jnp.asarray(roles),
     )
 
 

