@@ -37,5 +37,65 @@ SLOT_OVERFLOW = prom.Gauge(
 )
 
 
+_POOL_SNAPSHOT = {"fn": lambda: {}, "registered": False,
+                  "cache": {}, "cached_at": -1.0}
+
+
+def _pool_snapshot_cached() -> dict:
+    """One snapshot per scrape, not one per gauge: the 5 gauges evaluate
+    within the same exposition pass, and each uncached call would take the
+    scheduler lock and force a device sync (snapshot_assumed_load)."""
+    import time
+
+    now = time.monotonic()
+    if now - _POOL_SNAPSHOT["cached_at"] > 0.25:
+        _POOL_SNAPSHOT["cache"] = _POOL_SNAPSHOT["fn"]()
+        _POOL_SNAPSHOT["cached_at"] = now
+    return _POOL_SNAPSHOT["cache"]
+
+
+def register_pool_aggregates(snapshot) -> None:
+    """Pool-level aggregate gauges for autoscaling (reference roadmap item
+    4, README.md:111: 'HPA support for autoscaling on aggregate metrics
+    derived from the load balancer'). `snapshot` is a callable returning a
+    dict with keys ready_endpoints / queue_depth_total / kv_cache_util_mean
+    / assumed_load_total / saturated_fraction; each gauge evaluates it at
+    scrape time (set_function), so the exposition always reflects the live
+    datastore + metrics tensor with no update loop to maintain.
+
+    An HPA targeting e.g. gie_pool_queue_depth_total / gie_pool_endpoints
+    scales the model-server Deployment on load the EPP actually routes on —
+    truer than per-pod CPU for token workloads.
+
+    Re-registration swaps the snapshot source instead of duplicating the
+    gauges (the registry is process-global; tests build several runners)."""
+    _POOL_SNAPSHOT["fn"] = snapshot
+    _POOL_SNAPSHOT["cached_at"] = -1.0  # new source: drop any cache
+    if _POOL_SNAPSHOT["registered"]:
+        return
+    _POOL_SNAPSHOT["registered"] = True
+    specs = [
+        ("gie_pool_endpoints", "Ready routable endpoints in the pool",
+         "ready_endpoints"),
+        ("gie_pool_queue_depth_total",
+         "Sum of scraped queue depth across ready endpoints",
+         "queue_depth_total"),
+        ("gie_pool_kv_cache_util_mean",
+         "Mean scraped KV-cache utilization across ready endpoints",
+         "kv_cache_util_mean"),
+        ("gie_pool_assumed_load_total",
+         "Total in-flight assumed load (picks not yet reconciled)",
+         "assumed_load_total"),
+        ("gie_pool_saturated_fraction",
+         "Fraction of ready endpoints past the saturation thresholds",
+         "saturated_fraction"),
+    ]
+    for name, doc, field in specs:
+        g = prom.Gauge(name, doc, registry=REGISTRY)
+        g.set_function(
+            lambda field=field: float(
+                _pool_snapshot_cached().get(field, 0.0)))
+
+
 def start_metrics_server(port: int) -> None:
     prom.start_http_server(port, registry=REGISTRY)

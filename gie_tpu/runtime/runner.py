@@ -139,6 +139,7 @@ class ExtProcServerRunner:
             lora_registry=self.lora_registry,
             trainer=self.trainer,
         )
+        own_metrics.register_pool_aggregates(self._pool_snapshot)
         self._train_stop = threading.Event()
         self._train_thread: Optional[threading.Thread] = None
         self.elector = None
@@ -179,6 +180,32 @@ class ExtProcServerRunner:
         if self.elector is not None and not self.elector.is_leader():
             return False
         return True
+
+    def _pool_snapshot(self) -> dict:
+        """Aggregates for the HPA gauges (metrics.register_pool_aggregates)
+        — evaluated lazily at metrics-scrape time."""
+        import numpy as np
+
+        from gie_tpu.sched import constants as C
+
+        endpoints = self.datastore.endpoints()
+        slots = [ep.slot for ep in endpoints if 0 <= ep.slot < C.M_MAX]
+        n = len(slots)
+        if n == 0:
+            return {"ready_endpoints": 0.0}
+        metrics = self.metrics_store._metrics[slots]
+        queue = metrics[:, C.Metric.QUEUE_DEPTH]
+        kv = metrics[:, C.Metric.KV_CACHE_UTIL]
+        cfg = self.scheduler.cfg
+        saturated = (queue >= cfg.queue_limit) | (kv >= cfg.kv_limit)
+        load = self.scheduler.snapshot_assumed_load()
+        return {
+            "ready_endpoints": float(n),
+            "queue_depth_total": float(queue.sum()),
+            "kv_cache_util_mean": float(kv.mean()),
+            "assumed_load_total": float(load[slots].sum()),
+            "saturated_fraction": float(saturated.mean()),
+        }
 
     # -- scrape lifecycle follows endpoint lifecycle -----------------------
 

@@ -127,3 +127,37 @@ def test_mesh_guardrails():
     with pytest.raises(ValueError, match="power of two"):
         opts.validate()
     Options(pool_name="p", mesh_devices=8).validate()
+
+
+def test_pd_cycle_sharded_equivalence():
+    """The dual prefill/decode pick must survive dp-sharding bit-for-bit
+    (both picks, status merge, and split load charging)."""
+    from gie_tpu.sched import constants as C
+
+    assert len(jax.devices()) >= 8
+    cfg = ProfileConfig(pd_disaggregation=True)
+    R = C.Role
+    roles = [R.PREFILL, R.PREFILL, R.DECODE, R.DECODE, R.BOTH, R.BOTH,
+             R.PREFILL, R.DECODE]
+    rng = np.random.default_rng(11)
+    eps = make_endpoints(
+        8, queue=rng.integers(0, 30, 8).tolist(),
+        kv=rng.uniform(0, 0.9, 8).tolist(), role=roles)
+    prompts = [b"PD %d " % (i % 3) * 30 + b"q%d" % i for i in range(32)]
+    reqs = make_requests(32, prompts=prompts)
+    weights = Weights.default()
+    key = jax.random.PRNGKey(13)
+
+    single = jax.jit(
+        functools.partial(scheduling_cycle, cfg=cfg, predictor_fn=None))
+    r1, s1 = single(SchedState.init(), reqs, eps, weights, key, None)
+    sharded = sharded_cycle(make_mesh(8), cfg, None)
+    r2, s2 = sharded(SchedState.init(), reqs, eps, weights, key, None)
+
+    np.testing.assert_array_equal(
+        np.asarray(r1.indices), np.asarray(r2.indices))
+    np.testing.assert_array_equal(
+        np.asarray(r1.prefill), np.asarray(r2.prefill))
+    np.testing.assert_array_equal(np.asarray(r1.status), np.asarray(r2.status))
+    np.testing.assert_allclose(
+        np.asarray(s1.assumed_load), np.asarray(s2.assumed_load), atol=1e-6)

@@ -170,8 +170,7 @@ def test_sim_pd_chain_end_to_end():
     assert stats.goodput_tokens_per_s > 0
     # TTFT includes prefill (8 KB ~ 2048 tokens -> >= 0.5 s at 4000 tok/s).
     assert stats.ttft_p50_s > 0.3
-    # Prefill ran ONLY on prefill workers, decode only on decode workers.
-    assert all(len(s.queue) == 0 or True for s in cluster.stubs)
+    # Prefill ran ONLY on prefill workers, decode only on decode workers:
     for s in cluster.stubs[2:]:
         # decode pods only ever saw prefill_done jobs: their local prefix
         # caches were never populated.

@@ -182,3 +182,45 @@ def test_predictor_without_ceiling_skips_cycle_column():
     assert runner.trainer is not None          # admission path available
     assert runner.scheduler.predictor_fn is None   # no cycle cost
     assert runner.scheduler.base_latency_weight == 0.0
+
+
+def test_pool_aggregate_gauges_for_hpa():
+    """Reference roadmap item 4 (HPA on aggregate load-balancer metrics):
+    the /metrics exposition carries live pool aggregates computed from the
+    datastore + metrics tensor at scrape time."""
+    import numpy as np
+    from prometheus_client import generate_latest
+
+    from gie_tpu.controller.cluster import FakeCluster
+    from gie_tpu.datastore.objects import EndpointPool, Pod
+    from gie_tpu.runtime import metrics as own_metrics
+    from gie_tpu.runtime.runner import ExtProcServerRunner
+    from gie_tpu.sched import constants as C
+
+    opts = Options(pool_name="p")
+    runner = ExtProcServerRunner(opts, FakeCluster())
+    runner.datastore.pool_set(EndpointPool({"app": "x"}, [8000], "default"))
+    runner.datastore.pod_update_or_add(
+        Pod(name="p0", labels={"app": "x"}, ip="10.1.0.1"))
+    runner.datastore.pod_update_or_add(
+        Pod(name="p1", labels={"app": "x"}, ip="10.1.0.2"))
+    slots = [ep.slot for ep in runner.datastore.endpoints()]
+    for s in slots:
+        runner.metrics_store.update(
+            s, {C.Metric.QUEUE_DEPTH: 7.0, C.Metric.KV_CACHE_UTIL: 0.5})
+
+    snap = runner._pool_snapshot()
+    assert snap["ready_endpoints"] == 2.0
+    assert snap["queue_depth_total"] == pytest.approx(14.0)
+    assert snap["kv_cache_util_mean"] == pytest.approx(0.5)
+    assert snap["saturated_fraction"] == 0.0
+
+    text = generate_latest(own_metrics.REGISTRY).decode()
+    assert "gie_pool_endpoints 2.0" in text
+    assert "gie_pool_queue_depth_total 14.0" in text
+
+    # A second runner re-registers without duplicating collectors, and the
+    # gauges follow the LATEST runner's snapshot.
+    runner2 = ExtProcServerRunner(Options(pool_name="p2"), FakeCluster())
+    text = generate_latest(own_metrics.REGISTRY).decode()
+    assert "gie_pool_endpoints 0.0" in text
