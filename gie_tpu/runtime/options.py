@@ -45,6 +45,11 @@ class Options:
     # local devices (0 = single-device). Results are bit-identical to
     # single-device (tests/test_distributed_equivalence.py).
     mesh_devices: int = 0
+    # KV-cache event ingestion (reference roadmap item 1, remote-cache
+    # interface): HTTP port accepting JSON-lines BlockStored/BlockRemoved/
+    # AllBlocksCleared pushes from model servers or cache sidecars
+    # (0 = disabled).
+    kv_events_port: int = 0
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -93,6 +98,10 @@ class Options:
         parser.add_argument("--mesh-devices", type=int, default=d.mesh_devices,
                             help="dp-shard the scheduling cycle over the "
                                  "first N local devices (0 = single-device)")
+        parser.add_argument("--kv-events-port", type=int,
+                            default=d.kv_events_port,
+                            help="HTTP port for KV-cache event pushes "
+                                 "(JSON lines; 0 = disabled)")
         parser.add_argument("--objective", action="append", default=[],
                             dest="objectives", metavar="NAME=CRITICALITY",
                             help="register an InferenceObjective "
@@ -121,6 +130,7 @@ class Options:
             objectives=list(args.objectives),
             scheduler_config=args.scheduler_config,
             mesh_devices=args.mesh_devices,
+            kv_events_port=args.kv_events_port,
         )
 
     def validate(self) -> None:
@@ -142,6 +152,8 @@ class Options:
         # power of two to divide the request buckets (sched/profile.py).
         if self.mesh_devices > 1 and self.mesh_devices & (self.mesh_devices - 1):
             raise ValueError("--mesh-devices must be a power of two")
+        if not (0 <= self.kv_events_port < 65536):
+            raise ValueError("--kv-events-port out of range")
         for spec in self.objectives:
             name, sep, crit = spec.partition("=")
             if not sep or not name:

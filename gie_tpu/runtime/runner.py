@@ -169,6 +169,8 @@ class ExtProcServerRunner:
         )
         self.grpc_server: Optional[grpc.Server] = None
         self.health_server: Optional[grpc.Server] = None
+        self.kv_events = None
+        self.kv_events_server = None
         self._cert_reloader = None
         self._stopped = threading.Event()
 
@@ -291,6 +293,21 @@ class ExtProcServerRunner:
             raise OSError(f"failed to bind ext-proc port {addr}")
         server.start()
         self.grpc_server = server
+        if self.opts.kv_events_port > 0:
+            from gie_tpu.sched.kvevents import (
+                KVEventAggregator,
+                KVEventHTTPServer,
+            )
+
+            def _resolve(hostport: str):
+                ep = self.datastore.endpoint_by_hostport(hostport)
+                return None if ep is None else ep.slot
+
+            self.kv_events = KVEventAggregator(self.scheduler, _resolve)
+            self.kv_events_server = KVEventHTTPServer(
+                self.kv_events, self.opts.kv_events_port)
+            self.log.info("kv-events ingest listening",
+                          port=self.kv_events_server.port)
         if self.trainer is not None:
             self._train_thread = threading.Thread(
                 target=self._train_loop, daemon=True
@@ -346,6 +363,9 @@ class ExtProcServerRunner:
             self.grpc_server.stop(grace).wait()
         if self.health_server is not None:
             self.health_server.stop(0)
+        if self.kv_events_server is not None:
+            self.kv_events.flush()
+            self.kv_events_server.close()
         self.picker.close()
         self.scraper.close()
         if self.elector is not None:

@@ -117,6 +117,48 @@ def insert(
     return PrefixTable(keys=keys, present=present, ages=ages)
 
 
+def ingest_keys(
+    table: PrefixTable,
+    hashes: jax.Array,   # u32[B], 0 = padding (ignored)
+    ep_slot: jax.Array,  # i32 scalar endpoint slot
+    tick: jax.Array,     # u32 scalar
+    *,
+    remove: bool,
+) -> PrefixTable:
+    """Event-driven index update (reference roadmap item 1, README.md:108:
+    'prefix-cache aware load balancing with interfaces for REMOTE caches'):
+    a model server (or cache sidecar) reports chunk-chain hashes it stored
+    or evicted, and the device table reflects ground truth instead of the
+    pick-time optimistic guess.
+
+    Stored: same evict-then-OR scatter as `insert`, for one endpoint.
+    Removed: clear ONLY this endpoint's presence bit on matching rows —
+    other endpoints may still hold the chunk, and a non-matching row means
+    the table already recycled the slot (nothing to do)."""
+    nslots = table.keys.shape[0]
+    valid = hashes != 0
+    slot = _slots(hashes, nslots)
+    drop = nslots
+    if remove:
+        match = valid & (table.keys[slot] == hashes)
+        row = jnp.where(match, slot, drop)
+        # Advanced indexing with a matching-shape column vector scatters
+        # per-lane (row[b], ep_slot).
+        col = jnp.broadcast_to(ep_slot, row.shape)
+        present = table.present.at[row, col].set(False, mode="drop")
+        return table.replace(present=present)
+    safe = jnp.where(valid, slot, drop)
+    evict = valid & (table.keys[slot] != hashes)
+    evict_slot = jnp.where(evict, slot, drop)
+    present = table.present.at[evict_slot].set(False, mode="drop")
+    keys = table.keys.at[safe].set(hashes, mode="drop")
+    col = jnp.broadcast_to(ep_slot, safe.shape)
+    present = present.at[safe, col].max(valid, mode="drop")
+    ages = table.ages.at[safe].set(
+        jnp.broadcast_to(tick, safe.shape), mode="drop")
+    return PrefixTable(keys=keys, present=present, ages=ages)
+
+
 def clear_endpoint(table: PrefixTable, slot: jax.Array) -> PrefixTable:
     """Invalidate one endpoint's presence column (pod evicted/replaced —
     reference analogue: per-pod index removal on datastore PodDelete,

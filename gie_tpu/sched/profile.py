@@ -435,6 +435,7 @@ class Scheduler:
         self._key = jax.random.PRNGKey(seed)
         self._lock = threading.Lock()
         self._complete = jax.jit(_complete_update, donate_argnums=0)
+        self._ingest = jax.jit(prefix.ingest_keys, static_argnames=("remove",))
         self._evict = jax.jit(
             # Clear the slot's prefix columns AND its assumed load: the
             # endpoint (and its queue) is gone, and a reused slot must not
@@ -559,6 +560,32 @@ class Scheduler:
         out["mask"] = np.asarray(mask)[:n]
         out["shed"] = np.asarray(shed)[:n]
         return out
+
+    # Event batches pad to these sizes so the jitted ingest compiles for a
+    # handful of shapes, not one per batch.
+    _EVENT_BUCKETS = (64, 512, 4096)
+
+    def apply_prefix_events(
+        self, slot: int, stored: np.ndarray, removed: np.ndarray
+    ) -> None:
+        """KV-cache event ingestion (reference roadmap item 1 'interfaces
+        for remote caches'): fold a model server's reported stored/evicted
+        chunk-chain hashes into the device prefix index. Oversized batches
+        fold in chunks of the largest bucket."""
+        with self._lock:
+            state = self.state
+            for hashes, remove in ((stored, False), (removed, True)):
+                hashes = np.asarray(hashes, np.uint32)
+                for start in range(0, len(hashes), self._EVENT_BUCKETS[-1]):
+                    part = hashes[start:start + self._EVENT_BUCKETS[-1]]
+                    bucket = next(
+                        b for b in self._EVENT_BUCKETS if len(part) <= b)
+                    padded = np.zeros((bucket,), np.uint32)
+                    padded[: len(part)] = part
+                    state = state.replace(prefix=self._ingest(
+                        state.prefix, jnp.asarray(padded), jnp.int32(slot),
+                        state.tick, remove=remove))
+            self.state = state
 
     def evict_endpoint(self, slot: int) -> None:
         """Invalidate all prefix-cache knowledge of an endpoint slot (pod

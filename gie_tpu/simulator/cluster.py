@@ -129,6 +129,7 @@ class SimCluster:
         train_every_s: float = 1.0,
         slo_admission: bool = False,
         kv_transfer_s_per_kb: float = 0.002,
+        kv_events: bool = False,
     ) -> RunStats:
         wl = workload
         sessions = [
@@ -145,6 +146,22 @@ class SimCluster:
                 "pd_disaggregation with trainer/slo_admission is not "
                 "modeled in the sim yet")
         from gie_tpu.sched.profile import pd_costs_host
+
+        kv_agg = None
+        if kv_events and policy == "tpu" and scheduler is not None:
+            # Remote-cache interface (roadmap item 1): each stub publishes
+            # stored/evicted chunk hashes from its REAL cache LRU; the
+            # aggregator folds them into the device index, correcting the
+            # pick-time optimistic guesses (which never observe evictions).
+            from gie_tpu.sched.kvevents import KVEventAggregator
+
+            slot_by_hostport = {
+                stub.hostport: i for i, stub in enumerate(self.stubs)
+            }
+            kv_agg = KVEventAggregator(
+                scheduler, lambda hp: slot_by_hostport.get(hp))
+            for stub in self.stubs:
+                stub.event_sink = kv_agg.publish
 
         # Disaggregated bookkeeping: prefill jobs in flight on prefill
         # workers, decode jobs waiting on KV transfer, decode jobs running.
@@ -331,6 +348,8 @@ class SimCluster:
             if clock >= next_scrape:
                 self._scrape_all(clock)
                 next_scrape = clock + scrape_interval_s
+                if kv_agg is not None:
+                    kv_agg.flush()  # event latency ~ one scrape interval
             if trainer is not None and clock >= next_train:
                 if (trainer.train(steps=5) is not None
                         and scheduler is not None

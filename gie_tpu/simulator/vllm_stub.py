@@ -79,6 +79,11 @@ class VLLMStub:
     def __init__(self, cfg: StubConfig = StubConfig(), name: str = "stub-0"):
         self.cfg = cfg
         self.name = name
+        # KV-event publication (set post-construction by the harness):
+        # callable accepting kvevents-shaped dicts, and the endpoint
+        # identity events carry.
+        self.event_sink = None
+        self.hostport = name
         self.clock = 0.0
         self._next_id = 0
         self.queue: deque[_Req] = deque()
@@ -163,8 +168,24 @@ class VLLMStub:
             if h in self._prefix:
                 self._prefix.move_to_end(h)
             self._prefix[h] = self.clock
+        evicted = []
         while len(self._prefix) > self.cfg.prefix_cache_chunks:
-            self._prefix.popitem(last=False)
+            evicted.append(self._prefix.popitem(last=False)[0])
+        # KV-cache event publication (roadmap item 1 remote-cache
+        # interface): the stub's LRU uses the SAME chunk-hash chain the
+        # scheduler keys its index by, so stored/evicted hashes translate
+        # directly. Only the first MAX_CHUNKS matter to the index
+        # (requests carry at most that many), so cap the stored burst.
+        sink = getattr(self, "event_sink", None)
+        if sink is not None:
+            from gie_tpu.sched import constants as _C
+            from gie_tpu.sched.kvevents import BLOCK_REMOVED, BLOCK_STORED
+
+            sink({"type": BLOCK_STORED, "endpoint": self.hostport,
+                  "hashes": req.chunks[: _C.MAX_CHUNKS]})
+            if evicted:
+                sink({"type": BLOCK_REMOVED, "endpoint": self.hostport,
+                      "hashes": evicted})
 
     def _lora_ready(self, req: _Req) -> bool:
         """Adapter residency: resident -> ready; room -> cold load penalty
