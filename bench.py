@@ -7,10 +7,32 @@ the north-star shape: 1024 pending requests x 256 live endpoints
 the CPU EPP's O(10 ms)-per-request scheduler budget,
 reference docs/proposals/006-scheduler/README.md:43).
 
+Methodology (round 3): the measured quantity is DEVICE time per cycle, made
+robust to host contention. Each dispatch runs a chain of CHAIN_LEN cycles
+inside one XLA program (`jax.lax.scan` over the scheduling cycle, state
+donated and carried on device), so one host dispatch amortizes over
+CHAIN_LEN cycles; windows are kept PIPELINE deep in flight so the
+host<->device round trip (axon tunnel, ~ms under load) overlaps device
+compute instead of appearing in the measurement. Earlier rounds dispatched
+each cycle from the host and the driver capture inflated 38 us of device
+work to 76 us under a concurrent process (BENCH_r02.json vs
+docs/BENCH_NOTES.md); with the chain, a contended host delays only the
+enqueue of the next window, which is hidden while the device still has
+PIPELINE-1 windows of queued work.
+
+Honesty guard: the scan iterates over CHAIN_LEN DISTINCT request waves
+(stacked as the scan xs), not one wave reused — with a constant wave, XLA's
+loop-invariant code motion hoists nearly the whole scoring pipeline out of
+the loop and the "per-cycle" number collapses to the state-update tail
+(~0.4 us — measured, and rejected, while building this). Endpoint metrics
+stay constant across the chain, which matches production: waves arrive
+every few ms while metrics refresh at scrape cadence.
+
 Prints ONE JSON line:
-  metric       pick_p50_us_1024x256 — p50 per-batch latency in the
-               pipelined steady state (state donated on device; the host
-               does not sync each cycle, matching production operation)
+  metric       pick_p50_us_1024x256 — p50 per-cycle latency across
+               measurement repetitions (each rep = PIPELINE windows x
+               CHAIN_LEN chained cycles, timed end-to-end and divided by
+               the cycle count)
   vs_baseline  north-star target (50 us per 1024x256 batch, BASELINE.md)
                divided by our p50: >= 1.0 means the target is met. (The
                reference's own stated budget is O(10 ms) PER REQUEST on a
@@ -63,12 +85,39 @@ def _device_watchdog(timeout_s: float = 180.0):
         os._exit(3)
 
 
+def _preflight(n_probe: int = 5) -> None:
+    """Report host conditions so a contended capture is diagnosable.
+
+    The chained measurement is designed to survive contention, but the
+    1-min loadavg and a quick host-timer jitter probe make the conditions
+    of THIS capture part of the record.
+    """
+    try:
+        load1, load5, _ = os.getloadavg()
+    except OSError:  # pragma: no cover - platform without getloadavg
+        load1 = load5 = float("nan")
+    samples = []
+    for _ in range(n_probe):
+        t0 = time.perf_counter()
+        time.sleep(0.001)
+        samples.append(time.perf_counter() - t0 - 0.001)
+    jitter_us = max(samples) * 1e6
+    ncpu = os.cpu_count() or 1
+    print(
+        f"preflight: loadavg1={load1:.2f} loadavg5={load5:.2f} ncpu={ncpu} "
+        f"sleep-jitter={jitter_us:.0f}us "
+        f"{'(host contended)' if load1 > ncpu * 0.5 else '(host quiet)'}",
+        file=sys.stderr,
+    )
+
+
 def main() -> None:
     import jax.numpy as jnp
 
     _device_watchdog()
+    _preflight()
 
-    from gie_tpu.sched import constants as C
+    from gie_tpu.sched import constants as C  # noqa: F401 (shape doc)
     from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
     from gie_tpu.sched.types import SchedState, Weights
     from gie_tpu.utils.testing import make_endpoints, make_requests
@@ -93,46 +142,103 @@ def main() -> None:
         lora_id=(rng.integers(-1, 12, n)).tolist(),
     )
     cfg = ProfileConfig()
-    fn = jax.jit(
-        functools.partial(scheduling_cycle, cfg=cfg, predictor_fn=None),
-        donate_argnums=0,
+    cycle = functools.partial(scheduling_cycle, cfg=cfg, predictor_fn=None)
+
+    CHAIN_LEN = 64    # distinct request waves fused into one dispatch
+    PIPELINE = 4      # windows kept in flight per timed repetition
+    REPS = 30         # timed repetitions (p50/p99 across these)
+
+    # CHAIN_LEN distinct waves, stacked on a leading axis for lax.scan.
+    # Derived from the base wave by a per-wave row rotation + a per-wave
+    # hash salt: every wave keeps the realistic 16-system-prompt sharing
+    # structure, but no array is equal across iterations, so XLA cannot
+    # hoist any request-dependent stage out of the loop.
+    salts = rng.integers(1, 2**32, CHAIN_LEN, dtype=np.uint64).astype(np.uint32)
+
+    def stack_waves(x, *, hash_salt=False):
+        x = np.asarray(x)
+        rolled = np.stack(
+            [np.roll(x, 17 * w, axis=0) for w in range(CHAIN_LEN)]
+        )
+        if hash_salt:
+            rolled = rolled ^ salts.reshape(-1, *([1] * x.ndim))
+        return rolled
+
+    waves = jax.tree.map(stack_waves, reqs)
+    waves = waves.replace(
+        chunk_hashes=jnp.asarray(
+            stack_waves(reqs.chunk_hashes, hash_salt=True)
+        )
     )
+
+    def window(state, key, waves, eps, weights):
+        """CHAIN_LEN scheduling cycles as ONE device program.
+
+        The production scheduler streams waves back-to-back without a host
+        sync per cycle; the scan reproduces that steady state exactly (the
+        state pytree — prefix index, assumed load, rr, tick — is the scan
+        carry, so every cycle sees its predecessor's updates, same as the
+        per-dispatch path), with a fresh request wave per cycle.
+        """
+
+        def step(carry, wave):
+            st, k = carry
+            k, sub = jax.random.split(k)
+            result, st = cycle(st, wave, eps, weights, sub, None)
+            return (st, k), result.indices[:, 0]
+
+        (state, key), primaries = jax.lax.scan(step, (state, key), waves)
+        return state, key, primaries[-1]
+
+    win_fn = jax.jit(window, donate_argnums=(0,))
 
     state = SchedState.init()
     weights = Weights.default()
     key = jax.random.PRNGKey(0)
-    reqs = jax.device_put(reqs)
+    waves = jax.device_put(waves)
     eps = jax.device_put(eps)
 
     # Warm-up / compile.
     t0 = time.perf_counter()
-    result, state = fn(state, reqs, eps, weights, key, None)
+    state, key, last = win_fn(state, key, waves, eps, weights)
+    jax.block_until_ready(last)
+    print(f"compile+first window: {time.perf_counter()-t0:.2f}s", file=sys.stderr)
+
+    # One more settle window (cache/allocator steady state).
+    state, key, last = win_fn(state, key, waves, eps, weights)
+    jax.block_until_ready(last)
+
+    # Timed repetitions: each rep enqueues PIPELINE windows asynchronously
+    # and blocks once at the end. Per-cycle time = rep wall time /
+    # (PIPELINE*CHAIN_LEN). Host stalls during a rep only delay enqueues,
+    # which the device rides out on its queued windows.
+    rep_us = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        for _ in range(PIPELINE):
+            state, key, last = win_fn(state, key, waves, eps, weights)
+        jax.block_until_ready(last)
+        rep_us.append(
+            (time.perf_counter() - t0) / (PIPELINE * CHAIN_LEN) * 1e6
+        )
+    rep_us_arr = np.asarray(rep_us)
+    p50 = float(np.percentile(rep_us_arr, 50))
+    p99 = float(np.percentile(rep_us_arr, 99))
+    best = float(rep_us_arr.min())
+
+    # Synchronous single-cycle round trip (includes host<->device latency +
+    # tunnel RTT) — context only, not the headline.
+    single = jax.jit(cycle, donate_argnums=(0,))
+    s_state = SchedState.init()
+    result, s_state = single(s_state, reqs, eps, weights, key, None)
     jax.block_until_ready(result.indices)
-    print(f"compile+first: {time.perf_counter()-t0:.2f}s", file=sys.stderr)
-
-    # Steady state, pipelined: the scheduler never host-syncs per cycle in
-    # production (results stream back asynchronously while the next wave
-    # dispatches), so the honest per-batch latency is the amortized cost of
-    # a pipelined window. p50 over many windows suppresses tunnel jitter.
-    windows, per_window = 20, 50
-    window_us = []
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(per_window):
-            result, state = fn(state, reqs, eps, weights, key, None)
-        jax.block_until_ready(result.indices)
-        window_us.append((time.perf_counter() - t0) / per_window * 1e6)
-    p50 = float(np.percentile(window_us, 50))
-    p99 = float(np.percentile(window_us, 99))
-
-    # Synchronous single-cycle round trip (includes host<->device latency).
     sync = []
-    for _ in range(50):
+    for _ in range(30):
         t0 = time.perf_counter()
-        result, state = fn(state, reqs, eps, weights, key, None)
+        result, s_state = single(s_state, reqs, eps, weights, key, None)
         jax.block_until_ready(result.indices)
         sync.append(time.perf_counter() - t0)
-    amortized_us = float(np.percentile(np.asarray(sync) * 1e6, 50))
+    sync_p50 = float(np.percentile(np.asarray(sync) * 1e6, 50))
 
     per_req_us = p50 / n
     target_us = 50.0                # north-star batch target (BASELINE.md)
@@ -140,7 +246,9 @@ def main() -> None:
     vs = target_us / p50
 
     print(
-        f"p50={p50:.1f}us p99={p99:.1f}us sync_p50={amortized_us:.1f}us "
+        f"p50={p50:.1f}us p99={p99:.1f}us best={best:.1f}us "
+        f"sync_roundtrip_p50={sync_p50:.1f}us "
+        f"(chain={CHAIN_LEN} pipeline={PIPELINE} reps={REPS}) "
         f"per-request={per_req_us:.3f}us target<=50us/batch "
         f"picks/s={n/(p50/1e6):.0f} "
         f"vs-reference-per-request={baseline_per_req_us/per_req_us:.0f}x",
