@@ -50,6 +50,12 @@ class Options:
     # AllBlocksCleared pushes from model servers or cache sidecars
     # (0 = disabled).
     kv_events_port: int = 0
+    # Bind address for the KV-events listener. Loopback by default: this is
+    # a control-plane input (forged events steer routing); binding the pod
+    # network is an explicit decision, ideally with --kv-events-token.
+    kv_events_bind: str = "127.0.0.1"
+    # Shared bearer token required on KV-event POSTs (None = no auth).
+    kv_events_token: Optional[str] = None
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -102,6 +108,13 @@ class Options:
                             default=d.kv_events_port,
                             help="HTTP port for KV-cache event pushes "
                                  "(JSON lines; 0 = disabled)")
+        parser.add_argument("--kv-events-bind", default=d.kv_events_bind,
+                            help="bind address for the KV-events listener "
+                                 "(default loopback; set the pod-network "
+                                 "address explicitly to accept pushes)")
+        parser.add_argument("--kv-events-token", default=d.kv_events_token,
+                            help="shared bearer token required on KV-event "
+                                 "POSTs (default: no auth)")
         parser.add_argument("--objective", action="append", default=[],
                             dest="objectives", metavar="NAME=CRITICALITY",
                             help="register an InferenceObjective "
@@ -131,6 +144,8 @@ class Options:
             scheduler_config=args.scheduler_config,
             mesh_devices=args.mesh_devices,
             kv_events_port=args.kv_events_port,
+            kv_events_bind=args.kv_events_bind,
+            kv_events_token=args.kv_events_token,
         )
 
     def validate(self) -> None:

@@ -305,9 +305,13 @@ class ExtProcServerRunner:
 
             self.kv_events = KVEventAggregator(self.scheduler, _resolve)
             self.kv_events_server = KVEventHTTPServer(
-                self.kv_events, self.opts.kv_events_port)
+                self.kv_events, self.opts.kv_events_port,
+                bind=self.opts.kv_events_bind,
+                token=self.opts.kv_events_token)
             self.log.info("kv-events ingest listening",
-                          port=self.kv_events_server.port)
+                          port=self.kv_events_server.port,
+                          bind=self.opts.kv_events_bind,
+                          auth=self.opts.kv_events_token is not None)
         if self.trainer is not None:
             self._train_thread = threading.Thread(
                 target=self._train_loop, daemon=True

@@ -334,6 +334,12 @@ class BatchingTPUPicker:
         lora = np.full((n,), -1, np.int32)
         crit = np.full((n,), C.Criticality.STANDARD, np.int32)
         plen = np.zeros((n,), np.float32)
+        # Decode-length hint per request (types.py RequestBatch.decode_len).
+        # No transport populates it today, but charge and release MUST share
+        # one source: the device cycle charges from the RequestBatch value,
+        # so every host-side release below derives from this same array —
+        # populating the hint later cannot desync charge accounting.
+        dlen = np.zeros((n,), np.float32)
         own_metrics.BATCH_SIZE.observe(n)
         mask = np.zeros((n, C.M_MAX), bool)
         for i, it in enumerate(batch):
@@ -349,7 +355,7 @@ class BatchingTPUPicker:
             lora_id=jnp.asarray(lora),
             criticality=jnp.asarray(crit),
             prompt_len=jnp.asarray(plen),
-            decode_len=jnp.zeros((n,), jnp.float32),
+            decode_len=jnp.asarray(dlen),
             chunk_hashes=jnp.asarray(hashes),
             n_chunks=jnp.asarray(counts),
             subset_mask=jnp.asarray(mask),
@@ -392,7 +398,8 @@ class BatchingTPUPicker:
                     )
                 else:
                     res = PickResult(endpoint=picked[0], fallbacks=picked[1:])
-                    res.assumed_cost = request_cost_host(float(plen[i]))
+                    res.assumed_cost = request_cost_host(
+                        float(plen[i]), float(dlen[i]))
                     # The cycle charges the RAW primary (profile.py:214-218);
                     # if that slot wasn't routable, picked[0] differs and the
                     # observe_served guard will skip the release.
@@ -400,7 +407,8 @@ class BatchingTPUPicker:
                     if prefill_np is not None:
                         p_slot = int(prefill_np[i])
                         p_ep = by_slot.get(p_slot)
-                        p_cost, d_cost = pd_costs_host(float(plen[i]), 0.0)
+                        p_cost, d_cost = pd_costs_host(
+                            float(plen[i]), float(dlen[i]))
                         # pd charge bookkeeping is ALWAYS a charged list:
                         # falling back to the legacy single-slot path would
                         # release the full request cost from a slot the
@@ -423,7 +431,7 @@ class BatchingTPUPicker:
                                 metrics_np[slot],
                                 float(load_snapshot[slot]),
                                 float(plen[i]),
-                                0.0,
+                                float(dlen[i]),
                                 bool(lora[i] >= 0),
                             ),
                             slot,  # feeds the per-endpoint embedding

@@ -25,11 +25,14 @@ Wire format (one JSON object per line, POST /events):
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 from typing import Callable, Optional
 
 import numpy as np
+
+from gie_tpu.sched import constants as C
 
 BLOCK_STORED = "BlockStored"
 BLOCK_REMOVED = "BlockRemoved"
@@ -65,12 +68,16 @@ class KVEventAggregator:
         """Accept one event dict (see module docstring for the shape)."""
         etype = event.get("type")
         slot = self._resolve(str(event.get("endpoint", "")))
-        if slot is None or not (0 <= slot < 512):
+        if slot is None or not (0 <= slot < C.M_MAX):
             self.dropped += 1
             return
         if etype == ALL_CLEARED:
             self.flush()
-            self._scheduler.evict_endpoint(slot)
+            # Cache reset on a LIVE pod (vLLM emits AllBlocksCleared on
+            # cache reset, not pod death): forget its chunks, keep its
+            # assumed load — the pod still carries its in-flight queue.
+            # Full eviction (prefix + load) belongs to PodDelete.
+            self._scheduler.clear_prefix_endpoint(slot)
             self.ingested += 1
             return
         hashes = [int(h) & 0xFFFFFFFF for h in event.get("hashes", [])]
@@ -94,7 +101,10 @@ class KVEventAggregator:
             if not line:
                 continue
             try:
-                self.publish(json.loads(line))
+                event = json.loads(line)
+                if not isinstance(event, dict):
+                    continue  # a bare scalar/list parses but is no event
+                self.publish(event)
                 n += 1
             except (ValueError, TypeError):
                 continue
@@ -113,9 +123,26 @@ class KVEventAggregator:
 
 
 class KVEventHTTPServer:
-    """Minimal push transport: POST /events with JSON lines."""
+    """Minimal push transport: POST /events with JSON lines.
 
-    def __init__(self, aggregator: KVEventAggregator, port: int = 0):
+    This is a CONTROL-PLANE input — forged events steer routing — so it
+    ships with the same posture as the ext-proc surface: loopback bind by
+    default (set `bind` to the pod-network interface explicitly), an
+    optional shared bearer token (401 on mismatch when configured), and a
+    bounded request body (413 above `max_body` — the Content-Length is
+    never trusted to size a read)."""
+
+    MAX_BODY_DEFAULT = 4 * 1024 * 1024  # 4 MiB of JSON lines per POST
+
+    def __init__(
+        self,
+        aggregator: KVEventAggregator,
+        port: int = 0,
+        *,
+        bind: str = "127.0.0.1",
+        token: Optional[str] = None,
+        max_body: int = MAX_BODY_DEFAULT,
+    ):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         agg = aggregator
@@ -125,7 +152,19 @@ class KVEventHTTPServer:
                 if self.path != "/events":
                     self.send_error(404)
                     return
-                length = int(self.headers.get("Content-Length", 0))
+                if token is not None:
+                    got = self.headers.get("Authorization", "")
+                    if not hmac.compare_digest(got, f"Bearer {token}"):
+                        self.send_error(401)
+                        return
+                try:
+                    length = int(self.headers.get("Content-Length", ""))
+                except ValueError:
+                    self.send_error(411)  # length required
+                    return
+                if length < 0 or length > max_body:
+                    self.send_error(413)
+                    return
                 body = self.rfile.read(length)
                 n = agg.publish_lines(body)
                 self.send_response(200)
@@ -136,7 +175,7 @@ class KVEventHTTPServer:
             def log_message(self, *a):  # quiet
                 pass
 
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._httpd = ThreadingHTTPServer((bind, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)

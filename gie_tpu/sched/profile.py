@@ -436,6 +436,12 @@ class Scheduler:
         self._lock = threading.Lock()
         self._complete = jax.jit(_complete_update, donate_argnums=0)
         self._ingest = jax.jit(prefix.ingest_keys, static_argnames=("remove",))
+        self._clear_prefix = jax.jit(
+            lambda st, slot: st.replace(
+                prefix=prefix.clear_endpoint(st.prefix, slot)
+            ),
+            donate_argnums=0,
+        )
         self._evict = jax.jit(
             # Clear the slot's prefix columns AND its assumed load: the
             # endpoint (and its queue) is gone, and a reused slot must not
@@ -593,6 +599,15 @@ class Scheduler:
         (reference pkg/lwepp/datastore/datastore.go:257-265)."""
         with self._lock:
             self.state = self._evict(self.state, jnp.int32(slot))
+
+    def clear_prefix_endpoint(self, slot: int) -> None:
+        """Forget an endpoint's cached chunks WITHOUT touching its assumed
+        load. The live-pod cache-reset path (vLLM emits AllBlocksCleared on
+        cache reset, not pod death): the pod keeps its in-flight queue, so
+        zeroing its charge would make it look idle and over-route it —
+        eviction (prefix + load) is reserved for PodDelete."""
+        with self._lock:
+            self.state = self._clear_prefix(self.state, jnp.int32(slot))
 
     def snapshot_assumed_load(self) -> np.ndarray:
         with self._lock:

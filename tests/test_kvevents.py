@@ -3,6 +3,7 @@ LB with interfaces for remote caches): event-driven ground truth for the
 device prefix index."""
 
 import json
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -75,10 +76,23 @@ def test_aggregator_batches_resolves_and_flushes():
     agg.flush()
     cols = s.explain(make_requests(1, prompts=[prompt]), eps)
     assert cols["prefix"][0][0] == pytest.approx(1.0)
-    # AllBlocksCleared drops the endpoint's whole presence column.
+    # AllBlocksCleared drops the endpoint's whole presence column, but
+    # NOT its assumed load: a live pod that reset its KV cache (vLLM
+    # emits AllBlocksCleared on cache reset, not pod death) still owns
+    # its in-flight queue — wiping the charge would over-route it.
+    s.complete(np.asarray([-1]), np.asarray([0.0]))  # force state sync point
+    res = s.pick(make_requests(4, prompts=[prompt] * 4), eps)
+    assert (np.asarray(res.indices[:, 0]) == 0).all()  # affinity -> slot 0
+    load_before = s.snapshot_assumed_load()
+    assert load_before[0] > 0.0
     agg.publish({"type": ALL_CLEARED, "endpoint": "10.0.0.1:8000"})
     cols = s.explain(make_requests(1, prompts=[prompt]), eps)
     assert cols["prefix"].max() == 0.0
+    load_after = s.snapshot_assumed_load()
+    assert load_after[0] == pytest.approx(load_before[0])
+    # PodDelete (evict_endpoint) is the path that zeroes the charge too.
+    s.evict_endpoint(0)
+    assert s.snapshot_assumed_load()[0] == 0.0
 
 
 def test_http_transport_json_lines():
@@ -106,6 +120,54 @@ def test_http_transport_json_lines():
         eps = make_endpoints(8)
         cols = s.explain(make_requests(1, prompts=[prompt]), eps)
         assert cols["prefix"][0][5] == pytest.approx(1.0)
+    finally:
+        server.close()
+
+
+def test_http_transport_auth_and_body_cap():
+    """The events listener is a control-plane input: when a token is
+    configured, unauthenticated pushes are 401; oversized bodies are 413
+    before any read; missing Content-Length is 411."""
+    s = Scheduler(ProfileConfig())
+    seen = []
+    agg = KVEventAggregator(s, lambda hp: seen.append(hp) or 0)
+    server = KVEventHTTPServer(agg, port=0, token="s3cret", max_body=256)
+    url = f"http://127.0.0.1:{server.port}/events"
+    line = json.dumps(
+        {"type": BLOCK_STORED, "endpoint": "a:1", "hashes": [1]}
+    ).encode()
+    try:
+        # No token -> 401, and the event never reaches the aggregator.
+        req = urllib.request.Request(url, data=line, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 401
+
+        # Wrong token -> 401.
+        req = urllib.request.Request(
+            url, data=line, method="POST",
+            headers={"Authorization": "Bearer wrong"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 401
+        assert not seen
+
+        # Right token -> accepted.
+        req = urllib.request.Request(
+            url, data=line, method="POST",
+            headers={"Authorization": "Bearer s3cret"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["accepted"] == 1
+        assert seen == ["a:1"]
+
+        # Body above the cap -> 413 (Content-Length checked, not read).
+        big = b"x" * 1024
+        req = urllib.request.Request(
+            url, data=big, method="POST",
+            headers={"Authorization": "Bearer s3cret"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 413
     finally:
         server.close()
 
