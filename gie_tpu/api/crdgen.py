@@ -246,12 +246,30 @@ def inferencepoolimport_crd() -> dict:
     }
 
 
+def _check_cel_rules(crd: dict) -> None:
+    """Reject any x-kubernetes-validations rule outside the evaluator's
+    supported CEL subset AT GENERATION TIME — an unsupported rule must
+    fail the build, never ship in YAML and silently mis-evaluate at
+    admission (the reference gets this guarantee from compiling rules
+    against a real apiserver, test/cel/main_test.go:38-95)."""
+    from gie_tpu.api.cel import CelError, iter_rules, validate_rule_support
+
+    for rule in iter_rules(crd):
+        try:
+            validate_rule_support(rule)
+        except CelError as e:
+            raise ValueError(
+                f"CRD {crd['metadata']['name']} carries a rule outside "
+                f"the supported CEL subset: {rule!r}: {e}") from e
+
+
 def generate(out_dir: str) -> list[str]:
     """Write both CRDs to `<out_dir>/<group>_<plural>.yaml` (the reference
     generator's naming, generator/main.go:99)."""
     os.makedirs(out_dir, exist_ok=True)
     written = []
     for crd in (inferencepool_crd(), inferencepoolimport_crd()):
+        _check_cel_rules(crd)
         group = crd["spec"]["group"]
         plural = crd["spec"]["names"]["plural"]
         path = os.path.join(out_dir, f"{group}_{plural}.yaml")

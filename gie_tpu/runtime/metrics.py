@@ -29,6 +29,18 @@ BATCH_SIZE = prom.Histogram(
 STREAMS = prom.Gauge(
     "gie_active_streams", "Open ext-proc streams", registry=REGISTRY
 )
+QUEUE_DEPTH = prom.Gauge(
+    "gie_flow_queue_depth",
+    "Picks waiting in the flow-control queue (reference flow-controller "
+    "queue, proposal 0683)",
+    registry=REGISTRY,
+)
+QUEUE_SHED = prom.Counter(
+    "gie_flow_queue_shed_total",
+    "Picks shed by the flow-control queue bounds",
+    ["reason", "band"],  # reason: depth|evicted|age
+    registry=REGISTRY,
+)
 SLOT_OVERFLOW = prom.Gauge(
     "gie_endpoint_slot_overflow_total",
     "Endpoint admissions refused because every scheduler slot (M_MAX) was "

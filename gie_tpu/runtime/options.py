@@ -56,6 +56,11 @@ class Options:
     kv_events_bind: str = "127.0.0.1"
     # Shared bearer token required on KV-event POSTs (None = no auth).
     kv_events_token: Optional[str] = None
+    # Flow-control queue bounds (reference flow-controller overload policy,
+    # proposal 0683): max picks waiting (0 = unbounded) and max seconds a
+    # non-critical pick may queue before shedding 429 (0 = unbounded).
+    queue_bound: int = 0
+    queue_max_age_s: float = 0.0
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -115,6 +120,14 @@ class Options:
         parser.add_argument("--kv-events-token", default=d.kv_events_token,
                             help="shared bearer token required on KV-event "
                                  "POSTs (default: no auth)")
+        parser.add_argument("--queue-bound", type=int, default=d.queue_bound,
+                            help="max picks waiting in the flow-control "
+                                 "queue; a full queue sheds by criticality "
+                                 "(0 = unbounded)")
+        parser.add_argument("--queue-max-age-s", type=float,
+                            default=d.queue_max_age_s,
+                            help="shed non-critical picks queued longer "
+                                 "than this many seconds (0 = unbounded)")
         parser.add_argument("--objective", action="append", default=[],
                             dest="objectives", metavar="NAME=CRITICALITY",
                             help="register an InferenceObjective "
@@ -146,6 +159,8 @@ class Options:
             kv_events_port=args.kv_events_port,
             kv_events_bind=args.kv_events_bind,
             kv_events_token=args.kv_events_token,
+            queue_bound=args.queue_bound,
+            queue_max_age_s=args.queue_max_age_s,
         )
 
     def validate(self) -> None:

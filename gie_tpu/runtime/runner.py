@@ -138,6 +138,8 @@ class ExtProcServerRunner:
             max_wait_s=opts.batch_window_ms / 1000.0,
             lora_registry=self.lora_registry,
             trainer=self.trainer,
+            queue_bound=opts.queue_bound,
+            queue_max_age_s=opts.queue_max_age_s,
         )
         own_metrics.register_pool_aggregates(self._pool_snapshot)
         self._train_stop = threading.Event()

@@ -38,6 +38,13 @@ from gie_tpu.utils.lora import LoraRegistry
 
 import jax.numpy as jnp
 
+_BAND_NAMES = {
+    int(C.Criticality.CRITICAL): "critical",
+    int(C.Criticality.STANDARD): "standard",
+    int(C.Criticality.SHEDDABLE): "sheddable",
+}
+
+
 def _band_for(headers: dict, registry=None) -> int:
     """Scheduler band from the objective header: a registered
     InferenceObjective name (proposal 1199) or a literal band name."""
@@ -118,6 +125,8 @@ class BatchingTPUPicker:
         hold_queue_limit: float = 128.0,
         hold_retry_s: float = 0.01,
         pick_timeout_s: float = 60.0,
+        queue_bound: int = 0,
+        queue_max_age_s: float = 0.0,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
@@ -142,6 +151,26 @@ class BatchingTPUPicker:
         self.hold_queue_limit = hold_queue_limit
         self.hold_retry_s = hold_retry_s
         self.pick_timeout_s = pick_timeout_s
+        # Flow-control queue BOUNDS (the reference flow-controller implies
+        # bounded queues + overload policy, proposal 0683 README:64-66).
+        # queue_bound > 0 caps pending depth: an arrival into a full queue
+        # either evicts a strictly-lower-criticality waiter (which sheds
+        # with 429) or is itself shed with 429 — CRITICAL is only ever
+        # rejected when the whole queue is CRITICAL. queue_max_age_s > 0
+        # sheds non-critical items that waited longer than the bound
+        # (configure it ABOVE hold_max_s: holding is intentional queueing
+        # within the same clock, and the age bound backstops it).
+        if queue_bound < 0 or queue_max_age_s < 0:
+            raise ValueError("queue bounds must be non-negative")
+        if 0 < queue_max_age_s <= hold_max_s:
+            # An age bound inside the hold window would shed every held
+            # pick on its first retry — the hold feature would silently
+            # become a 429 generator.
+            raise ValueError(
+                f"queue_max_age_s ({queue_max_age_s}) must exceed "
+                f"hold_max_s ({hold_max_s}) when both are enabled")
+        self.queue_bound = queue_bound
+        self.queue_max_age_s = queue_max_age_s
         self._pending: list[_Pending] = []
         self._cond = threading.Condition()
         self._closed = False
@@ -158,7 +187,10 @@ class BatchingTPUPicker:
         with self._cond:
             if self._closed:
                 raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "picker shut down")
+            if self.queue_bound > 0 and len(self._pending) >= self.queue_bound:
+                self._admit_into_full_queue(req)
             self._pending.append(item)
+            own_metrics.QUEUE_DEPTH.set(len(self._pending))
             self._cond.notify()
         # Bounded wait: if the collector ever wedges (device hang, bug), fail
         # the stream instead of hanging the ext-proc thread forever. Budget =
@@ -173,6 +205,37 @@ class BatchingTPUPicker:
             raise item.error
         assert item.result is not None
         return item.result
+
+    def _admit_into_full_queue(self, req: PickRequest) -> None:
+        """Overload policy for a full flow-control queue (caller holds the
+        lock): free a slot by dropping an abandoned waiter if one exists,
+        else evict the NEWEST strictly-lower-criticality waiter (it sheds
+        with 429 — within-band FIFO is preserved, and a band never evicts
+        itself), else shed the arrival. Raises ShedError when the arrival
+        loses."""
+        for i in range(len(self._pending) - 1, -1, -1):
+            if self._pending[i].abandoned:
+                del self._pending[i]
+                return
+        band = _band_for(req.headers, self.objective_registry)
+        worst_i, worst_band = -1, band
+        for i in range(len(self._pending) - 1, -1, -1):
+            b = _band_for(self._pending[i].req.headers,
+                          self.objective_registry)
+            if b > worst_band:
+                worst_i, worst_band = i, b
+                if b == int(C.Criticality.SHEDDABLE):
+                    break  # no worse band exists
+        if worst_i < 0:
+            own_metrics.QUEUE_SHED.labels(
+                reason="depth", band=_BAND_NAMES.get(band, "standard")).inc()
+            raise ShedError("flow-control queue full")
+        victim = self._pending.pop(worst_i)
+        victim.error = ShedError("evicted by higher-criticality arrival")
+        victim.event.set()
+        own_metrics.QUEUE_SHED.labels(
+            reason="evicted",
+            band=_BAND_NAMES.get(worst_band, "standard")).inc()
 
     def observe_served(self, served_hostport: str, ctx) -> None:
         """Served-endpoint feedback -> assumed-load release
@@ -265,6 +328,7 @@ class BatchingTPUPicker:
                         )
                     batch = self._pending[: self.max_batch]
                     self._pending = self._pending[self.max_batch :]
+                    own_metrics.QUEUE_DEPTH.set(len(self._pending))
                 held = self._run_batch(batch)
             except Exception as e:  # propagate to all waiters
                 if not batch:
@@ -274,6 +338,7 @@ class BatchingTPUPicker:
                     # fail the whole queue rather than hang it.
                     with self._cond:
                         batch, self._pending = self._pending, []
+                        own_metrics.QUEUE_DEPTH.set(0)
                 for item in batch:
                     # A fresh exception per waiter: handler threads raise
                     # these concurrently, and a shared instance would race
@@ -291,6 +356,7 @@ class BatchingTPUPicker:
                     # and fresh arrivals are never delayed by the pacing.
                     new_arrivals = len(self._pending) > 0
                     self._pending = held + self._pending
+                    own_metrics.QUEUE_DEPTH.set(len(self._pending))
                     if not new_arrivals:
                         self._cond.wait(self.hold_retry_s)
 
@@ -298,6 +364,28 @@ class BatchingTPUPicker:
         # Timed-out callers are gone: scheduling their items would charge
         # assumed load with no served feedback to ever release it.
         batch = [it for it in batch if not it.abandoned]
+        if self.queue_max_age_s > 0 and batch:
+            # Age bound: a non-critical pick that has waited beyond the
+            # bound sheds with 429 instead of occupying a wave slot —
+            # bounded queue AGE, the second half of the flow-controller's
+            # overload policy. CRITICAL is exempt (its latency bound comes
+            # from draining first in _fair_order).
+            now = time.monotonic()
+            kept: list[_Pending] = []
+            for it in batch:
+                band = _band_for(it.req.headers, self.objective_registry)
+                if (
+                    band != int(C.Criticality.CRITICAL)
+                    and now - it.enqueued_at > self.queue_max_age_s
+                ):
+                    it.error = ShedError("queued beyond flow-control age bound")
+                    it.event.set()
+                    own_metrics.QUEUE_SHED.labels(
+                        reason="age",
+                        band=_BAND_NAMES.get(band, "standard")).inc()
+                else:
+                    kept.append(it)
+            batch = kept
         if not batch:
             return []
         # Flow-control hold decision happens BEFORE any scheduling, so a
