@@ -68,6 +68,18 @@ class InferencePoolClient:
         pool.status = status
         return pool
 
+    def server_side_apply(self, cfg) -> api.InferencePool:
+        """Server-side apply of an InferencePoolApply configuration
+        (gie_tpu.api.applyconfiguration): merge the sparse patch onto the
+        stored object — absent fields keep their stored values — validate,
+        and commit. The client-go clientset.Apply(...) analogue."""
+        from gie_tpu.api.applyconfiguration import apply_pool_configuration
+
+        existing = self._store.get_pool(cfg.namespace, cfg.name)
+        merged = apply_pool_configuration(existing, cfg)
+        self._write("apply_pool", merged)
+        return merged
+
     def to_yaml(self, pool: api.InferencePool) -> str:
         import yaml
 

@@ -3,27 +3,40 @@
 TPU re-design of the prefix-cache-aware scorer of reference
 docs/proposals/0602-prefix-cache/README.md:95-129. The reference keeps an
 LRU-indexed hash -> servers table per EPP replica and walks it per request;
-here the table is dense device arrays (PrefixTable) and matching for the
-whole batch is one gather + cumprod:
+here the table is dense device arrays (PrefixTable) with the endpoint set
+BITPACKED into u32 words, and matching for the whole batch is one packed
+gather + cumulative-AND + popcount:
 
-  slot(h)    = h & (S - 1)                       direct-mapped
-  hit(n,c)   = keys[slot(h_nc)] == h_nc          chunk known at all
-  on(n,c,m)  = present[slot(h_nc), m]            chunk plausibly cached on m
-  match(n,m) = sum_c prod_{c'<=c} on(n,c',m)     longest-prefix property
-  score      = match / n_chunks                  normalized [0, 1]
+  slot(h)     = h & (S - 1)                        direct-mapped
+  hit(n,c)    = keys[slot(h_nc)] == h_nc           chunk known at all
+  words(n,c,w)= present[slot(h_nc), w] * hit       packed endpoint bits
+  run(n,c,w)  = AND_{c'<=c} words(n,c',w)          longest-prefix property
+                (cumulative bitwise AND — 512 endpoints advance per word op)
+  match(n,m)  = sum_c bit_m(run(n,c))              popcount-style unpack
+  score       = match / n_chunks                   normalized [0, 1]
+
+The packed layout is the load-bearing TPU choice: the table is 2 MiB
+(u32[S, M_WORDS]) instead of 16 MiB (bool[S, M_MAX]), so the per-cycle
+gather of [N, C] rows moves 8x fewer bytes and the cumulative AND runs on
+16 words instead of 512 lanes.
 
 Staleness: every touched slot is stamped with the cycle tick; match ignores
 slots older than `max_age` ticks (the LRU-decay analogue of the reference's
 index eviction, 0602 README:113-122). Endpoint churn is handled by
-`clear_endpoint`, which zeroes one endpoint's presence column when the
-datastore evicts a pod, so a reused slot never inherits a dead pod's cache.
+`clear_endpoint`, which zeroes one endpoint's presence BIT across the table
+when the datastore evicts a pod, so a reused slot never inherits a dead
+pod's cache.
 
 Inserts happen at pick time (assumed cache: the picked endpoint will hold
 these chunks after serving — the same optimistic update the reference does
-per pick), via dense scatters. Slot collisions overwrite the older key
-(LRU-ish by construction); within one batch, colliding lanes resolve by
-scatter order. The index is explicitly approximate — exactly as in the
-reference design (0602 README:101 "approximate index").
+per pick) via gather-OR-scatter on single (row, word) cells. Slot
+collisions overwrite the older key (LRU-ish by construction). Within one
+batch, lanes colliding on the same (row, word) cell resolve last-wins — a
+concurrently-inserted OTHER endpoint's bit from the same wave can be lost
+for that chunk (re-asserted the next time that endpoint is picked for it);
+bits from earlier cycles are preserved by the OR. The index is explicitly
+approximate — exactly as in the reference design (0602 README:101
+"approximate index").
 """
 
 from __future__ import annotations
@@ -37,6 +50,15 @@ from gie_tpu.sched.types import PrefixTable, RequestBatch
 
 def _slots(hashes: jax.Array, table_slots: int) -> jax.Array:
     return (hashes & jnp.uint32(table_slots - 1)).astype(jnp.int32)
+
+
+def _unpack_bits(words: jax.Array) -> jax.Array:
+    """u32[..., W] -> i32[..., W*32]: bit b of word w = endpoint 32*w+b."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*words.shape[:-1], words.shape[-1] * 32).astype(
+        jnp.int32
+    )
 
 
 def match_scores(
@@ -55,14 +77,23 @@ def match_scores(
     fresh = (tick - table.ages[slots]) <= jnp.uint32(max_age)  # [N, C]
     hit = (keys == reqs.chunk_hashes) & (reqs.chunk_hashes != 0) & chunk_valid & fresh
 
-    on = table.present[slots] & hit[..., None]                 # bool[N, C, M]
+    words = table.present[slots]                               # u32[N, C, W]
+    words = words * hit[..., None].astype(jnp.uint32)
 
     # Longest-prefix property: a chunk only counts if every earlier chunk
-    # also matched on that endpoint (reference 0602 README:107-112).
-    prefix_run = jnp.cumprod(on.astype(jnp.int32), axis=1)     # [N, C, M]
-    matched = jnp.sum(prefix_run, axis=1).astype(jnp.float32)  # [N, M]
+    # also matched on that endpoint (reference 0602 README:107-112) —
+    # cumulative AND along the chunk axis, on packed words.
+    run = jax.lax.associative_scan(jnp.bitwise_and, words, axis=1)
+    matched = jnp.sum(_unpack_bits(run), axis=1).astype(jnp.float32)  # [N, M]
     denom = jnp.maximum(reqs.n_chunks.astype(jnp.float32), 1.0)
     return matched / denom[:, None]
+
+
+def _cell(ep: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Endpoint slot -> (word column, bit value) in the packed row."""
+    word = (ep // 32).astype(jnp.int32)
+    bit = jnp.uint32(1) << (ep % 32).astype(jnp.uint32)
+    return word, bit
 
 
 def insert(
@@ -75,11 +106,12 @@ def insert(
     endpoints (assumed-cache update, reference 0602 README:113-122).
 
     Per (request, chunk) lane: if the slot already holds this hash, OR the
-    picked endpoint into its presence row; otherwise evict (clear the row,
-    write the new key) and set the bit. Evictions are applied first, then
-    presence bits scatter-OR (max) in. Invalid lanes scatter to index S,
-    which is out of bounds and therefore dropped (JAX scatter drop
-    semantics), so they never alias a real row.
+    picked endpoint's bit into its presence row; otherwise evict (clear the
+    row, write the new key) and set the bit. Evictions are applied first
+    (full W-word row clear), then each lane ORs its single (row, word)
+    cell via gather-modify-scatter. Invalid lanes scatter to index S, which
+    is out of bounds and therefore dropped (JAX scatter drop semantics), so
+    they never alias a real row.
     """
     n, cmax = reqs.chunk_hashes.shape
     nslots = table.keys.shape[0]
@@ -101,15 +133,15 @@ def insert(
     evict = valid & (table.keys[flat_slot] != flat_hash)
     evict_slot = jnp.where(evict, flat_slot, drop)
 
-    # 1) Evictions: clear presence row, stamp new key.
-    present = table.present.at[evict_slot].set(False, mode="drop")
+    # 1) Evictions: clear the packed presence row, stamp the new key.
+    present = table.present.at[evict_slot].set(
+        jnp.uint32(0), mode="drop")
     keys = table.keys.at[safe_slot].set(flat_hash, mode="drop")
 
-    # 2) OR the picked-endpoint bit in (max == OR for bool).
-    onehot = (
-        jnp.arange(C.M_MAX, dtype=jnp.int32)[None, :] == ep[:, None]
-    ) & valid[:, None]
-    present = present.at[safe_slot].max(onehot, mode="drop")
+    # 2) OR the picked endpoint's bit into its (row, word) cell.
+    word, bit = _cell(ep)
+    old = present[jnp.where(valid, flat_slot, 0), word]             # [N*C]
+    present = present.at[safe_slot, word].set(old | bit, mode="drop")
 
     ages = table.ages.at[safe_slot].set(
         jnp.broadcast_to(tick, valid.shape), mode="drop"
@@ -131,7 +163,7 @@ def ingest_keys(
     or evicted, and the device table reflects ground truth instead of the
     pick-time optimistic guess.
 
-    Stored: same evict-then-OR scatter as `insert`, for one endpoint.
+    Stored: same evict-then-OR as `insert`, for one endpoint.
     Removed: clear ONLY this endpoint's presence bit on matching rows —
     other endpoints may still hold the chunk, and a non-matching row means
     the table already recycled the slot (nothing to do)."""
@@ -139,28 +171,42 @@ def ingest_keys(
     valid = hashes != 0
     slot = _slots(hashes, nslots)
     drop = nslots
+    word, bit = _cell(jnp.broadcast_to(ep_slot, slot.shape))
     if remove:
         match = valid & (table.keys[slot] == hashes)
         row = jnp.where(match, slot, drop)
-        # Advanced indexing with a matching-shape column vector scatters
-        # per-lane (row[b], ep_slot).
-        col = jnp.broadcast_to(ep_slot, row.shape)
-        present = table.present.at[row, col].set(False, mode="drop")
+        old = table.present[jnp.where(match, slot, 0), word]
+        present = table.present.at[row, word].set(
+            old & ~bit, mode="drop")
         return table.replace(present=present)
     safe = jnp.where(valid, slot, drop)
     evict = valid & (table.keys[slot] != hashes)
     evict_slot = jnp.where(evict, slot, drop)
-    present = table.present.at[evict_slot].set(False, mode="drop")
+    present = table.present.at[evict_slot].set(jnp.uint32(0), mode="drop")
     keys = table.keys.at[safe].set(hashes, mode="drop")
-    col = jnp.broadcast_to(ep_slot, safe.shape)
-    present = present.at[safe, col].max(valid, mode="drop")
+    old = present[jnp.where(valid, slot, 0), word]
+    present = present.at[safe, word].set(old | bit, mode="drop")
     ages = table.ages.at[safe].set(
         jnp.broadcast_to(tick, safe.shape), mode="drop")
     return PrefixTable(keys=keys, present=present, ages=ages)
 
 
+def unpack_presence(present) -> "np.ndarray":
+    """u32[S, W] packed presence -> bool[S, W*32] (host-side test/debug
+    helper; the device path never materializes this)."""
+    import numpy as np
+
+    p = np.asarray(present)
+    bits = (p[..., None] >> np.arange(32, dtype=np.uint32)) & 1
+    return bits.reshape(p.shape[0], -1).astype(bool)
+
+
 def clear_endpoint(table: PrefixTable, slot: jax.Array) -> PrefixTable:
-    """Invalidate one endpoint's presence column (pod evicted/replaced —
-    reference analogue: per-pod index removal on datastore PodDelete,
-    pkg/lwepp/datastore/datastore.go:257-265)."""
-    return table.replace(present=table.present.at[:, slot].set(False))
+    """Invalidate one endpoint's presence bit across the table (pod
+    evicted/replaced — reference analogue: per-pod index removal on
+    datastore PodDelete, pkg/lwepp/datastore/datastore.go:257-265)."""
+    word, bit = _cell(slot)
+    column = table.present[:, word]
+    return table.replace(
+        present=table.present.at[:, word].set(column & ~bit)
+    )

@@ -98,21 +98,25 @@ class PrefixTable:
     TPU-native re-design of the approximate prefix-cache index of reference
     docs/proposals/0602-prefix-cache/README.md:95-129 (chunk-hash -> servers
     map with LRU): a direct-mapped table of PREFIX_SLOTS rows, each holding a
-    32-bit chunk-hash key, a per-endpoint presence row (who plausibly has
-    this chunk cached), and an age tick for staleness decay. Collisions
-    overwrite (the index is explicitly approximate in the reference design
-    too); XLA sees only dense scatter/gather.
+    32-bit chunk-hash key, a BITPACKED per-endpoint presence row (who
+    plausibly has this chunk cached — bit m of word m//32), and an age tick
+    for staleness decay. Packing the presence matrix into u32 words keeps
+    the whole table at S x M_WORDS x 4 B (2 MiB at 32768 x 512) instead of
+    S x M_MAX bytes (16 MiB as bools) — 8x less HBM traffic on every
+    match gather and insert scatter, the ops that dominate the cycle.
+    Collisions overwrite (the index is explicitly approximate in the
+    reference design too); XLA sees only dense scatter/gather.
     """
 
     keys: jax.Array     # u32[PREFIX_SLOTS], 0 = empty
-    present: jax.Array  # bool[PREFIX_SLOTS, M_MAX] endpoint presence per chunk
+    present: jax.Array  # u32[PREFIX_SLOTS, M_WORDS] packed endpoint bits
     ages: jax.Array     # u32[PREFIX_SLOTS] last-touch tick
 
     @staticmethod
     def empty(slots: int = C.PREFIX_SLOTS) -> "PrefixTable":
         return PrefixTable(
             keys=jnp.zeros((slots,), jnp.uint32),
-            present=jnp.zeros((slots, C.M_MAX), bool),
+            present=jnp.zeros((slots, C.M_WORDS), jnp.uint32),
             ages=jnp.zeros((slots,), jnp.uint32),
         )
 

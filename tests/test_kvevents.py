@@ -188,7 +188,8 @@ def test_event_bucket_padding_large_batches():
     match = table.keys[slots] == hashes
     # Collisions overwrite, so not all survive — but many must.
     assert match.mean() > 0.5
-    assert table.present[slots[match], 2].all()
+    from gie_tpu.sched.prefix import unpack_presence
+    assert unpack_presence(table.present)[slots[match], 2].all()
 
 
 def test_sim_events_correct_a_wiped_cache():
@@ -211,10 +212,12 @@ def test_sim_events_correct_a_wiped_cache():
     # The tiny 64-chunk caches churn hard: each 4 KB prompt is 64 chunks,
     # so every new session wipes the previous one. The index must NOT
     # claim more cached affinity than the stubs actually hold.
+    from gie_tpu.sched.prefix import unpack_presence
     table = jax.tree.map(np.asarray, sched.state).prefix
+    presence = unpack_presence(table.present)
     claimed = set()
     for slot in range(4):
-        rows = table.present[:, slot]
+        rows = presence[:, slot]
         claimed |= {int(k) for k in table.keys[rows] if k != 0}
     actually_cached = set()
     for stub in cluster.stubs:

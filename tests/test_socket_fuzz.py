@@ -131,25 +131,29 @@ def test_midstream_disconnect_during_deferred_header_pick(live):
     must unwind, not accumulate."""
     deferred = headers_msg(end_of_stream=False).SerializeToString()
     before = threading.active_count()
+    stops = []
     for _ in range(10):
         feeding = threading.Event()
+        stop = threading.Event()
+        stops.append(stop)
 
-        def frames():
+        def frames(feeding=feeding, stop=stop):
             yield deferred
             feeding.set()
-            time.sleep(30)  # never send the body; the cancel interrupts us
+            stop.wait(30)  # never send the body; released after cancel
 
         call = live(frames())
         feeding.wait(timeout=10)
         time.sleep(0.05)  # let the server enter its deferred-pick wait
         call.cancel()
+        stop.set()  # release the feeder thread promptly
     # Handler threads unwound (pool reuse allowed; no unbounded growth).
     deadline = time.monotonic() + 10
     while time.monotonic() < deadline:
         if threading.active_count() <= before + 12:
             break
         time.sleep(0.2)
-    assert threading.active_count() <= before + 12
+    assert threading.active_count() <= before + 12, threading.active_count()
     assert_still_serving(live)
 
 
