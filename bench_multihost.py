@@ -8,6 +8,17 @@ the scheduling cycle over ICI; on a host with one chip (or CPU) it falls
 back to a virtual device mesh, which validates the sharded program
 end-to-end but measures host threads, not ICI — the JSON line says which.
 
+Beyond the wall-clock curve, the harness SEPARATES compute scaling from
+collective overhead (VERDICT r02 #4): it parses the compiled sharded
+program for its actual collective ops (all-reduce / all-gather /
+reduce-scatter / collective-permute) and their tensor sizes, then emits an
+analytic ICI projection — per-chip compute = t1/dp, collective time =
+ring cost of the measured collective bytes at the stated ICI bandwidth —
+with the crossover dp (if any) where sharding pays on real hardware. The
+emulated-CPU wall numbers validate the program; the projection is the
+deployment guidance (the CPU fabric's thread overheads say nothing about
+ICI).
+
 Prints ONE JSON line:
   metric       sharded_pick_p50_us_1024x256_dp<N> at the widest mesh
   vs_baseline  single-device p50 / widest-mesh p50 (speedup; >= 1.0 means
@@ -45,6 +56,55 @@ def _ensure_devices(min_devices: int) -> str:
     ).strip()
     jax.config.update("jax_platforms", "cpu")
     return "virtual-cpu"
+
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+    "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+}
+
+
+def collective_bytes(hlo_text: str) -> dict[str, int]:
+    """Sum the output bytes of every cross-device collective in a compiled
+    HLO module, by op kind. This is the program's ACTUAL communication
+    volume — not a guess — read from the same executable the bench times."""
+    import re
+
+    out: dict[str, int] = {}
+    op_re = re.compile(
+        r"=\s*((?:\(|)[a-z0-9]+\[[^=]*?)\s*"
+        r"(all-reduce|all-gather|reduce-scatter|collective-permute)"
+        r"(?:-start)?\(", )
+    shape_re = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+    for m in op_re.finditer(hlo_text):
+        shapes, kind = m.group(1), m.group(2)
+        total = 0
+        for dt, dims in shape_re.findall(shapes):
+            if dt not in _DTYPE_BYTES:
+                continue
+            size = _DTYPE_BYTES[dt]
+            for d in dims.split(","):
+                if d:
+                    size *= int(d)
+            total += size
+        out[kind] = out.get(kind, 0) + total
+    return out
+
+
+def project_ici(t1_us: float, coll: dict[str, int], dp: int,
+                ici_gbps: float) -> tuple[float, float, float]:
+    """Analytic per-batch time at width dp on real ICI:
+      compute = t1/dp (the cycle is embarrassingly dp-parallel over N)
+      collective = ring cost 2*(dp-1)/dp * all-reduce bytes / BW
+                   + (dp-1)/dp * (all-gather + reduce-scatter) bytes / BW
+    Returns (compute_us, collective_us, total_us)."""
+    compute = t1_us / dp
+    ar = coll.get("all-reduce", 0)
+    agrs = coll.get("all-gather", 0) + coll.get("reduce-scatter", 0)
+    cp = coll.get("collective-permute", 0)
+    bw = ici_gbps * 1e9
+    coll_s = (2 * (dp - 1) / dp * ar + (dp - 1) / dp * agrs + cp) / bw
+    return compute, coll_s * 1e6, compute + coll_s * 1e6
 
 
 def main() -> None:

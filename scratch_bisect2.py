@@ -1,0 +1,109 @@
+import time, sys
+import jax, jax.numpy as jnp, numpy as np
+from gie_tpu.sched import constants as C
+from gie_tpu.sched import filters, pickers, scorers
+from gie_tpu.sched.types import SchedState, Weights
+from gie_tpu.utils.testing import make_endpoints, make_requests
+
+n, m = 1024, 256
+rng = np.random.default_rng(0)
+eps = make_endpoints(m, queue=rng.integers(0, 50, m).tolist(),
+                     kv=rng.uniform(0, 0.95, m).tolist(), max_lora=8)
+base = b"SYSTEM: You are a helpful assistant specialised in task %d. "
+prompts = [(base % (i % 16)) * 6 + b"user question %d" % i for i in range(n)]
+reqs = make_requests(n, prompts=prompts, lora_id=(rng.integers(-1, 12, n)).tolist())
+
+K = 64
+def stack_waves(x):
+    x = np.asarray(x)
+    return np.stack([np.roll(x, 17 * w, axis=0) for w in range(K)])
+waves = jax.tree.map(stack_waves, reqs)
+waves = jax.device_put(waves)
+eps = jax.device_put(eps)
+weights = Weights.default()
+cfg_queue_norm, cfg_load_norm = 64.0, 32.0
+
+def harness(name, step_fn, reps=5):
+    def win(load, rr, waves):
+        def step(carry, wave):
+            load, rr = carry
+            load, rr, out = step_fn(load, rr, wave)
+            return (load, rr), out
+        (load, rr), outs = jax.lax.scan(step, (load, rr), waves)
+        return load, rr, outs[-1]
+    win = jax.jit(win, donate_argnums=(0,))
+    load = jnp.zeros((C.M_MAX,), jnp.float32); rr = jnp.uint32(0)
+    load, rr, o = win(load, rr, waves); jax.block_until_ready(o)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        load, rr, o = win(load, rr, waves)
+        jax.block_until_ready(o)
+        ts.append((time.perf_counter()-t0)/K*1e6)
+    print(f"{name}: per-iter min={min(ts):.1f}us", file=sys.stderr)
+
+def columns(load, wave):
+    mask = filters.base_mask(wave, eps)
+    named = {
+        "queue": jnp.broadcast_to(scorers.queue_score(eps, queue_norm=cfg_queue_norm)[None, :], mask.shape),
+        "kv_cache": jnp.broadcast_to(scorers.kv_cache_score(eps)[None, :], mask.shape),
+        "assumed_load": jnp.broadcast_to(scorers.assumed_load_score(load, load_norm=cfg_load_norm)[None, :], mask.shape),
+    }
+    stacked = jnp.stack(list(named.values()))
+    wvec = jnp.stack([getattr(weights, k) for k in named])
+    total = jnp.einsum("s,snm->nm", wvec, stacked) / jnp.maximum(jnp.sum(wvec), jnp.float32(1e-6))
+    return mask, stacked, wvec, total
+
+# L1: columns + blend + argmax, minimal update
+def l1(load, rr, wave):
+    mask, stacked, wvec, total = columns(load, wave)
+    masked = jnp.where(mask, total, C.NEG_SCORE)
+    pick = jnp.argmax(masked, axis=-1)
+    load = load * 0.95 + jnp.zeros((C.M_MAX,), jnp.float32).at[pick].add(1.0)
+    return load, rr + 1, pick
+harness("L1 columns+blend+argmax", l1)
+
+# L2: + quantize/rotate tie-break
+def l2(load, rr, wave):
+    mask, stacked, wvec, total = columns(load, wave)
+    quantized = jnp.round(total / pickers._TIE_RESOLUTION) * pickers._TIE_RESOLUTION
+    lane = jnp.arange(C.M_MAX, dtype=jnp.uint32)
+    rot = ((lane + rr) % jnp.uint32(C.M_MAX)).astype(jnp.float32)
+    masked = jnp.where(mask, quantized + rot * pickers._TIE_EPS, C.NEG_SCORE)
+    pick = jnp.argmax(masked, axis=-1)
+    load = load * 0.95 + jnp.zeros((C.M_MAX,), jnp.float32).at[pick].add(1.0)
+    return load, rr + 1, pick
+harness("L2 +tiebreak", l2)
+
+# L3: + full _topk(4) + finalize
+def l3(load, rr, wave):
+    mask, stacked, wvec, total = columns(load, wave)
+    quantized = jnp.round(total / pickers._TIE_RESOLUTION) * pickers._TIE_RESOLUTION
+    lane = jnp.arange(C.M_MAX, dtype=jnp.uint32)
+    rot = ((lane + rr) % jnp.uint32(C.M_MAX)).astype(jnp.float32)
+    masked = jnp.where(mask, quantized + rot * pickers._TIE_EPS, C.NEG_SCORE)
+    shed = jnp.zeros(wave.valid.shape, bool)
+    res = pickers._finalize(masked, mask, shed, wave.valid)
+    pick = res.indices[:, 0]
+    safe = jnp.where(pick >= 0, pick, C.M_MAX - 1)
+    load = load * 0.95 + jnp.zeros((C.M_MAX,), jnp.float32).at[safe].add(1.0)
+    return load, rr + 1, pick
+harness("L3 +topk4+finalize", l3)
+
+# L4: + request_cost + where-gating like real cycle
+def l4(load, rr, wave):
+    mask, stacked, wvec, total = columns(load, wave)
+    quantized = jnp.round(total / pickers._TIE_RESOLUTION) * pickers._TIE_RESOLUTION
+    lane = jnp.arange(C.M_MAX, dtype=jnp.uint32)
+    rot = ((lane + rr) % jnp.uint32(C.M_MAX)).astype(jnp.float32)
+    masked = jnp.where(mask, quantized + rot * pickers._TIE_EPS, C.NEG_SCORE)
+    shed = jnp.zeros(wave.valid.shape, bool)
+    res = pickers._finalize(masked, mask, shed, wave.valid)
+    primary = res.indices[:, 0]
+    picked_ok = primary >= 0
+    cost = jnp.where(picked_ok, jnp.clip((wave.prompt_len + wave.decode_len) / 2048.0, 0.25, 8.0), 0.0)
+    slot = jnp.where(picked_ok, primary, C.M_MAX - 1)
+    load = load * 0.95 + jnp.zeros((C.M_MAX,), jnp.float32).at[slot].add(cost)
+    return load, rr + 1, primary
+harness("L4 +cost-gating (≈queue_kv_only cycle)", l4)
+EOF

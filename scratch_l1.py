@@ -1,0 +1,58 @@
+import time, sys
+t00 = time.time()
+def log(msg):
+    print(f"[{time.time()-t00:6.1f}s] {msg}", file=sys.stderr, flush=True)
+import jax, jax.numpy as jnp, numpy as np
+from gie_tpu.sched import constants as C
+from gie_tpu.sched import filters, scorers
+from gie_tpu.sched.types import Weights
+from gie_tpu.utils.testing import make_endpoints, make_requests
+log("imports done")
+n, m = 1024, 256
+rng = np.random.default_rng(0)
+eps = make_endpoints(m, queue=rng.integers(0, 50, m).tolist(), kv=rng.uniform(0, 0.95, m).tolist(), max_lora=8)
+base = b"SYSTEM: You are a helpful assistant specialised in task %d. "
+prompts = [(base % (i % 16)) * 6 + b"user question %d" % i for i in range(n)]
+reqs = make_requests(n, prompts=prompts, lora_id=(rng.integers(-1, 12, n)).tolist())
+log("requests made")
+K = 64
+def stack_waves(x):
+    x = np.asarray(x)
+    return np.stack([np.roll(x, 17 * w, axis=0) for w in range(K)])
+waves = jax.tree.map(stack_waves, reqs)
+log("waves stacked (host)")
+waves = jax.device_put(waves)
+jax.block_until_ready(waves.valid)
+log("waves on device")
+eps = jax.device_put(eps)
+weights = Weights.default()
+
+def l1_win(load, rr, waves):
+    def step(carry, wave):
+        load, rr = carry
+        mask = filters.base_mask(wave, eps)
+        named = {
+            "queue": jnp.broadcast_to(scorers.queue_score(eps, queue_norm=64.0)[None, :], mask.shape),
+            "kv_cache": jnp.broadcast_to(scorers.kv_cache_score(eps)[None, :], mask.shape),
+            "assumed_load": jnp.broadcast_to(scorers.assumed_load_score(load, load_norm=32.0)[None, :], mask.shape),
+        }
+        stacked = jnp.stack(list(named.values()))
+        wvec = jnp.stack([getattr(weights, k) for k in named])
+        total = jnp.einsum("s,snm->nm", wvec, stacked) / jnp.maximum(jnp.sum(wvec), jnp.float32(1e-6))
+        masked = jnp.where(mask, total, C.NEG_SCORE)
+        pick = jnp.argmax(masked, axis=-1)
+        load = load * 0.95 + jnp.zeros((C.M_MAX,), jnp.float32).at[pick].add(1.0)
+        return (load, rr + 1), pick
+    (load, rr), outs = jax.lax.scan(step, (load, rr), waves)
+    return load, rr, outs[-1]
+
+win = jax.jit(l1_win, donate_argnums=(0,))
+load = jnp.zeros((C.M_MAX,), jnp.float32); rr = jnp.uint32(0)
+log("compiling...")
+load, rr, o = win(load, rr, waves); jax.block_until_ready(o)
+log("first window done")
+for rep in range(5):
+    t0 = time.perf_counter()
+    load, rr, o = win(load, rr, waves)
+    jax.block_until_ready(o)
+    log(f"rep {rep}: {(time.perf_counter()-t0)/K*1e6:.1f}us/iter")

@@ -1,0 +1,63 @@
+import functools, time, sys
+import jax, jax.numpy as jnp, numpy as np
+from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
+from gie_tpu.sched.types import SchedState, Weights
+from gie_tpu.utils.testing import make_endpoints, make_requests
+
+n, m = 1024, 256
+rng = np.random.default_rng(0)
+eps = make_endpoints(m, queue=rng.integers(0, 50, m).tolist(),
+                     kv=rng.uniform(0, 0.95, m).tolist(), max_lora=8)
+base = b"SYSTEM: You are a helpful assistant specialised in task %d. "
+prompts = [(base % (i % 16)) * 6 + b"user question %d" % i for i in range(n)]
+reqs = make_requests(n, prompts=prompts, lora_id=(rng.integers(-1, 12, n)).tolist())
+
+K = 64
+salts = rng.integers(1, 2**32, K, dtype=np.uint64).astype(np.uint32)
+def stack_waves(x, *, hash_salt=False):
+    x = np.asarray(x)
+    rolled = np.stack([np.roll(x, 17 * w, axis=0) for w in range(K)])
+    if hash_salt:
+        rolled = rolled ^ salts.reshape(-1, *([1] * x.ndim))
+    return rolled
+waves = jax.tree.map(stack_waves, reqs)
+waves = waves.replace(chunk_hashes=jnp.asarray(stack_waves(np.asarray(reqs.chunk_hashes), hash_salt=True)))
+waves = jax.device_put(waves)
+eps = jax.device_put(eps)
+weights = Weights.default()
+
+def bench_cfg(name, cfg, reps=6):
+    cycle = functools.partial(scheduling_cycle, cfg=cfg, predictor_fn=None)
+    def window(state, key, waves, eps, weights):
+        def step(carry, wave):
+            st, k = carry
+            k, sub = jax.random.split(k)
+            result, st = cycle(st, wave, eps, weights, sub, None)
+            return (st, k), result.indices[:, 0]
+        (state, key), primaries = jax.lax.scan(step, (state, key), waves)
+        return state, key, primaries[-1]
+    win = jax.jit(window, donate_argnums=(0,))
+    state = SchedState.init(); key = jax.random.PRNGKey(0)
+    state, key, last = win(state, key, waves, eps, weights)
+    jax.block_until_ready(last)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        state, key, last = win(state, key, waves, eps, weights)
+        jax.block_until_ready(last)
+        ts.append((time.perf_counter()-t0)/K*1e6)
+    print(f"{name}: per-cycle min={min(ts):.1f}us p50={np.percentile(ts,50):.1f}us", file=sys.stderr)
+
+import sys as _s
+which = _s.argv[1] if len(_s.argv) > 1 else "all"
+cfgs = {
+    "full": ProfileConfig(),
+    "no_prefix": ProfileConfig(enable_prefix=False),
+    "no_lora": ProfileConfig(enable_lora=False),
+    "no_session": ProfileConfig(enable_session=False),
+    "no_sat": ProfileConfig(enable_saturation=False),
+    "queue_kv_only": ProfileConfig(enable_prefix=False, enable_lora=False, enable_session=False, enable_saturation=False),
+}
+for nm, c in cfgs.items():
+    if which in ("all", nm):
+        bench_cfg(nm, c)
