@@ -77,22 +77,31 @@ class MetricsStore:
             self._has_data[slot] = False
 
     def endpoint_batch(
-        self, endpoints: Iterable[Endpoint], now: Optional[float] = None
+        self,
+        endpoints: Iterable[Endpoint],
+        now: Optional[float] = None,
+        m_slots: int = C.M_MAX,
     ) -> EndpointBatch:
         """Dense snapshot for one scheduling cycle. Endpoints without any
         scrape yet are still valid (zero metrics = optimistic cold start,
-        matching the reference's fresh-endpoint admission)."""
+        matching the reference's fresh-endpoint admission).
+
+        `m_slots` is the endpoint-axis width of the snapshot (an M bucket —
+        the batching layer sizes it to the live high-water slot so the
+        compiled cycle scores only the lanes that can exist); every
+        endpoint's slot must be < m_slots."""
         now = time.time() if now is None else now
         with self._lock:
-            metrics = self._metrics.copy()
-            active = self._lora_active.copy()
-            waiting = self._lora_waiting.copy()
+            metrics = self._metrics[:m_slots].copy()
+            active = self._lora_active[:m_slots].copy()
+            waiting = self._lora_waiting[:m_slots].copy()
             age = np.where(
-                self._has_data, now - self._scraped_at, 0.0
+                self._has_data[:m_slots],
+                now - self._scraped_at[:m_slots], 0.0
             ).astype(np.float32)
         metrics[:, C.Metric.METRICS_AGE_S] = age
-        valid = np.zeros((C.M_MAX,), bool)
-        role = np.zeros((C.M_MAX,), np.int32)
+        valid = np.zeros((m_slots,), bool)
+        role = np.zeros((m_slots,), np.int32)
         for ep in endpoints:
             valid[ep.slot] = True
             labels = getattr(ep, "labels", None) or {}

@@ -193,8 +193,12 @@ def predictor_score_fn(predictor: LatencyPredictor):
     ) -> jax.Array:
         feats = build_features(reqs, eps, assumed_load)
         n = reqs.valid.shape[0]
+        m = eps.valid.shape[0]
+        # Slot ids are GLOBAL endpoint identities regardless of the live M
+        # bucket; the embedding table stays M_MAX+1 wide so a slot keeps
+        # its learned bias across bucket migrations.
         slots = jnp.broadcast_to(
-            jnp.arange(C.M_MAX, dtype=jnp.int32)[None, :], (n, C.M_MAX)
+            jnp.arange(m, dtype=jnp.int32)[None, :], (n, m)
         )
         latency = predictor.request_latency(
             params, feats, slots, reqs.decode_len)

@@ -203,11 +203,15 @@ class ExtProcServerRunner:
         cfg = self.scheduler.cfg
         saturated = (queue >= cfg.queue_limit) | (kv >= cfg.kv_limit)
         load = self.scheduler.snapshot_assumed_load()
+        # The assumed-load vector is sized to the scheduler's CURRENT M
+        # bucket; a slot beyond it (endpoint registered but not yet picked
+        # at the grown width) carries zero assumed load by definition.
+        in_bucket = [s for s in slots if s < load.shape[0]]
         return {
             "ready_endpoints": float(n),
             "queue_depth_total": float(queue.sum()),
             "kv_cache_util_mean": float(kv.mean()),
-            "assumed_load_total": float(load[slots].sum()),
+            "assumed_load_total": float(load[in_bucket].sum()),
             "saturated_fraction": float(saturated.mean()),
         }
 

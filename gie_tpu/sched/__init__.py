@@ -6,6 +6,7 @@ how this replaces the reference's per-request plugin chain.
 
 from gie_tpu.sched.constants import (
     FALLBACKS,
+    M_BUCKETS,
     M_MAX,
     MAX_CHUNKS,
     NUM_METRICS,
@@ -25,6 +26,7 @@ from gie_tpu.sched.types import (
 
 __all__ = [
     "FALLBACKS",
+    "M_BUCKETS",
     "M_MAX",
     "MAX_CHUNKS",
     "NUM_METRICS",

@@ -33,7 +33,7 @@ from gie_tpu.sched import constants as C
 from gie_tpu.sched.hashing import batch_chunk_hashes
 from gie_tpu.models.latency import host_features
 from gie_tpu.sched.profile import Scheduler, pd_costs_host, request_cost_host
-from gie_tpu.sched.types import RequestBatch
+from gie_tpu.sched.types import RequestBatch, m_bucket_for
 from gie_tpu.utils.lora import LoraRegistry
 
 import jax.numpy as jnp
@@ -171,6 +171,12 @@ class BatchingTPUPicker:
                 f"hold_max_s ({hold_max_s}) when both are enabled")
         self.queue_bound = queue_bound
         self.queue_max_age_s = queue_max_age_s
+        # Endpoint-axis (M) bucket: sized to the datastore's high-water
+        # slot, grown immediately, shrunk only after _M_SHRINK_PATIENCE
+        # consecutive waves fit the smaller bucket (a pod flap must not
+        # thrash state migrations). Collector-thread-only state.
+        self._m_bucket = C.M_BUCKETS[0]
+        self._m_shrink_streak = 0
         self._pending: list[_Pending] = []
         self._cond = threading.Condition()
         self._closed = False
@@ -209,10 +215,10 @@ class BatchingTPUPicker:
     def _admit_into_full_queue(self, req: PickRequest) -> None:
         """Overload policy for a full flow-control queue (caller holds the
         lock): free a slot by dropping an abandoned waiter if one exists,
-        else evict the NEWEST strictly-lower-criticality waiter (it sheds
-        with 429 — within-band FIFO is preserved, and a band never evicts
-        itself), else shed the arrival. Raises ShedError when the arrival
-        loses."""
+        else evict the newest waiter in the lowest-criticality band present
+        (which must be strictly lower than the arrival's; it sheds with 429
+        — within-band FIFO is preserved, and a band never evicts itself),
+        else shed the arrival. Raises ShedError when the arrival loses."""
         for i in range(len(self._pending) - 1, -1, -1):
             if self._pending[i].abandoned:
                 del self._pending[i]
@@ -360,6 +366,28 @@ class BatchingTPUPicker:
                     if not new_arrivals:
                         self._cond.wait(self.hold_retry_s)
 
+    _M_SHRINK_PATIENCE = 64  # consecutive smaller-bucket waves before shrink
+
+    def _pick_m_bucket(self, endpoints) -> int:
+        """Endpoint-axis bucket for this wave: smallest M bucket covering
+        the high-water live slot. Grows immediately (a new pod must be
+        addressable now); shrinks only after _M_SHRINK_PATIENCE consecutive
+        waves fit the smaller bucket, so churn at a boundary doesn't thrash
+        compiled shapes and state migrations. Collector-thread only."""
+        high = 1 + max((ep.slot for ep in endpoints), default=-1)
+        needed = m_bucket_for(max(high, 1))
+        if needed > self._m_bucket:
+            self._m_bucket = needed
+            self._m_shrink_streak = 0
+        elif needed < self._m_bucket:
+            self._m_shrink_streak += 1
+            if self._m_shrink_streak >= self._M_SHRINK_PATIENCE:
+                self._m_bucket = needed
+                self._m_shrink_streak = 0
+        else:
+            self._m_shrink_streak = 0
+        return self._m_bucket
+
     def _run_batch(self, batch: list[_Pending]) -> list["_Pending"]:
         # Timed-out callers are gone: scheduling their items would charge
         # assumed load with no served feedback to ever release it.
@@ -417,6 +445,8 @@ class BatchingTPUPicker:
             if not batch:
                 return held
         n = len(batch)
+        endpoints = self.datastore.endpoints()
+        mb = self._pick_m_bucket(endpoints)
         prompts = [it.req.body or b"" for it in batch]
         hashes, counts = batch_chunk_hashes(prompts)
         lora = np.full((n,), -1, np.int32)
@@ -429,13 +459,13 @@ class BatchingTPUPicker:
         # populating the hint later cannot desync charge accounting.
         dlen = np.zeros((n,), np.float32)
         own_metrics.BATCH_SIZE.observe(n)
-        mask = np.zeros((n, C.M_MAX), bool)
+        mask = np.zeros((n, mb), bool)
         for i, it in enumerate(batch):
             lora[i] = self.lora_registry.id_for(it.req.model)
             crit[i] = _band_for(it.req.headers, self.objective_registry)
             plen[i] = float(len(prompts[i]))
             for ep in it.candidates:
-                if 0 <= ep.slot < C.M_MAX:
+                if 0 <= ep.slot < mb:
                     mask[i, ep.slot] = True
 
         reqs = RequestBatch(
@@ -448,13 +478,18 @@ class BatchingTPUPicker:
             n_chunks=jnp.asarray(counts),
             subset_mask=jnp.asarray(mask),
         )
-        endpoints = self.datastore.endpoints()
-        eps = self.metrics_store.endpoint_batch(endpoints)
+        eps = self.metrics_store.endpoint_batch(endpoints, m_slots=mb)
+        result = self.scheduler.pick(reqs, eps)
         if self.trainer is not None:
             # One bulk device->host transfer per wave, not one per request.
+            # Taken AFTER pick(): the state has been migrated to this
+            # wave's M bucket, so every picked slot is indexable (a
+            # pre-pick snapshot at the old width crashed on the first pick
+            # past a grow boundary) — and the simulator's feature twin
+            # snapshots post-schedule too, keeping the trained feature
+            # space identical.
             load_snapshot = self.scheduler.snapshot_assumed_load()
             metrics_np = np.asarray(eps.metrics)
-        result = self.scheduler.pick(reqs, eps)
 
         by_slot = {ep.slot: ep for ep in endpoints}
         indices = np.asarray(result.indices)

@@ -37,12 +37,21 @@ NUM_METRICS = len(Metric)
 # Global endpoint-axis budget. The reference supports pods x up to 8 DP-rank
 # target ports (api/v1/inferencepool_types.go:72-81); 512 endpoint slots cover
 # the north-star 256-endpoint benchmark with headroom. All device state
-# (assumed load, prefix-table bitmasks) is laid out against this fixed axis so
+# (assumed load, prefix-table bitmasks) is laid out against a fixed axis so
 # pod churn never changes a compiled shape — rows are masked, not resized.
 M_MAX = 512
 
 # Words of a uint32 bitmask spanning M_MAX endpoints.
 M_WORDS = M_MAX // 32
+
+# Endpoint-axis buckets. Like N_BUCKETS for requests: device state and the
+# compiled cycle are sized to the smallest bucket covering the live
+# endpoint slots (high-water slot index), so an 8-pod pool pays for 64
+# scoring lanes, the 256-endpoint north star for 256 — not M_MAX. Each
+# bucket is a multiple of 32 (the packed prefix-word width) and a distinct
+# compiled shape; crossing a boundary migrates state (types.resize_state),
+# it never recompiles mid-cycle.
+M_BUCKETS = (64, 256, 512)
 
 # Request-axis buckets: incoming micro-batches are padded up to the nearest
 # bucket so only a handful of shapes ever compile.

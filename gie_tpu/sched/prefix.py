@@ -52,15 +52,6 @@ def _slots(hashes: jax.Array, table_slots: int) -> jax.Array:
     return (hashes & jnp.uint32(table_slots - 1)).astype(jnp.int32)
 
 
-def _unpack_bits(words: jax.Array) -> jax.Array:
-    """u32[..., W] -> i32[..., W*32]: bit b of word w = endpoint 32*w+b."""
-    shifts = jnp.arange(32, dtype=jnp.uint32)
-    bits = (words[..., None] >> shifts) & jnp.uint32(1)
-    return bits.reshape(*words.shape[:-1], words.shape[-1] * 32).astype(
-        jnp.int32
-    )
-
-
 def match_scores(
     table: PrefixTable,
     reqs: RequestBatch,
@@ -68,7 +59,8 @@ def match_scores(
     *,
     max_age: int,
 ) -> jax.Array:
-    """Longest-prefix match fraction per (request, endpoint) -> f32[N, M_MAX]."""
+    """Longest-prefix match fraction per (request, endpoint) -> f32[N, m]
+    (m = the table's packed endpoint width, an M bucket)."""
     slots = _slots(reqs.chunk_hashes, table.keys.shape[0])     # i32[N, C]
     keys = table.keys[slots]                                   # u32[N, C]
     chunk_valid = (
@@ -84,7 +76,18 @@ def match_scores(
     # also matched on that endpoint (reference 0602 README:107-112) —
     # cumulative AND along the chunk axis, on packed words.
     run = jax.lax.associative_scan(jnp.bitwise_and, words, axis=1)
-    matched = jnp.sum(_unpack_bits(run), axis=1).astype(jnp.float32)  # [N, M]
+    # Bit-plane depth count: sum the unpacked bits over the chunk axis
+    # BEFORE flattening (word, bit) -> endpoint. The [N, C, W, 32] bit
+    # tensor then fuses straight into the reduction (nothing bigger than
+    # [N, W, 32] materializes); reshaping first would force XLA to write
+    # the full [N, C, M] unpack (64 MiB at 1024x32x512) to HBM.
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (run[..., None] >> shifts) & jnp.uint32(1)          # [N, C, W, 32]
+    matched = (
+        jnp.sum(bits.astype(jnp.int32), axis=1)                # [N, W, 32]
+        .reshape(run.shape[0], -1)                             # [N, M]
+        .astype(jnp.float32)
+    )
     denom = jnp.maximum(reqs.n_chunks.astype(jnp.float32), 1.0)
     return matched / denom[:, None]
 
@@ -124,7 +127,8 @@ def insert(
         chunk_valid & (reqs.chunk_hashes != 0) & (picked[:, None] >= 0)
     ).reshape(-1)
 
-    ep = jnp.clip(picked, 0, C.M_MAX - 1)                           # [N]
+    m = table.present.shape[1] * 32
+    ep = jnp.clip(picked, 0, m - 1)                                 # [N]
     ep = jnp.broadcast_to(ep[:, None], (n, cmax)).reshape(-1)       # [N*C]
 
     # Out-of-bounds sentinel: dropped by scatter, aliases nothing.

@@ -34,7 +34,9 @@ from gie_tpu.sched.types import (
     SchedState,
     Weights,
     bucket_for,
+    m_bucket_for,
     pad_requests,
+    resize_state,
 )
 
 # Optional learned scorer column:
@@ -265,11 +267,12 @@ def scheduling_cycle(
         total, stacked, wvec, mask, shed, reqs, eps, state, key, cfg)
 
     # ---- State update ----------------------------------------------------
+    m = state.assumed_load.shape[0]
     primary = result.indices[:, 0]                  # i32[N], -1 on non-OK
     picked_ok = primary >= 0
     cost = jnp.where(picked_ok, request_cost(reqs), 0.0)
-    slot = jnp.where(picked_ok, primary, C.M_MAX - 1)
-    added = jnp.zeros((C.M_MAX,), jnp.float32).at[slot].add(cost)
+    slot = jnp.where(picked_ok, primary, m - 1)
+    added = jnp.zeros((m,), jnp.float32).at[slot].add(cost)
     new_load = state.assumed_load * cfg.load_decay + added
 
     new_prefix = (
@@ -356,13 +359,14 @@ def _pd_cycle(
         ~ok & (status == C.Status.OK), C.Status.NO_CAPACITY, status)
 
     # ---- State update: charge each side's cost to its own worker --------
+    m_state = state.assumed_load.shape[0]
     p_cost_all, d_cost_all = pd_costs(reqs)
     p_cost = jnp.where(ok, p_cost_all, 0.0)
     d_cost = jnp.where(ok, d_cost_all, 0.0)
-    p_slot = jnp.where(ok, p_primary, C.M_MAX - 1)
-    d_slot = jnp.where(ok, d_primary, C.M_MAX - 1)
+    p_slot = jnp.where(ok, p_primary, m_state - 1)
+    d_slot = jnp.where(ok, d_primary, m_state - 1)
     added = (
-        jnp.zeros((C.M_MAX,), jnp.float32)
+        jnp.zeros((m_state,), jnp.float32)
         .at[p_slot].add(p_cost)
         .at[d_slot].add(d_cost)
     )
@@ -393,10 +397,16 @@ def _pd_cycle(
 
 
 def _complete_update(state: SchedState, slots: jax.Array, costs: jax.Array) -> SchedState:
-    """Request-termination feedback: subtract reconciled assumed load."""
-    safe = jnp.where(slots >= 0, slots, C.M_MAX - 1)
-    sub = jnp.zeros((C.M_MAX,), jnp.float32).at[safe].add(
-        jnp.where(slots >= 0, costs, 0.0)
+    """Request-termination feedback: subtract reconciled assumed load.
+
+    Slots beyond the state's current M bucket are dropped, not clamped: a
+    request picked before a shrink migration may complete after it, and
+    its (already-truncated) charge must not land on an unrelated slot."""
+    m = state.assumed_load.shape[0]
+    ok = (slots >= 0) & (slots < m)
+    safe = jnp.where(ok, slots, m)  # out of bounds -> scatter-drop
+    sub = jnp.zeros((m,), jnp.float32).at[safe].add(
+        jnp.where(ok, costs, 0.0), mode="drop"
     )
     return state.replace(assumed_load=jnp.maximum(state.assumed_load - sub, 0.0))
 
@@ -431,10 +441,14 @@ class Scheduler:
             self.weights = self.weights.replace(latency=jnp.float32(0.0))
         self.predictor_fn = predictor_fn
         self.predictor_params = predictor_params
-        self.state = SchedState.init()
+        # State starts at the smallest M bucket; the first pick migrates it
+        # to whatever width the caller's EndpointBatch arrives with.
+        self.state = SchedState.init(m=C.M_BUCKETS[0])
         self._key = jax.random.PRNGKey(seed)
         self._lock = threading.Lock()
         self._complete = jax.jit(_complete_update, donate_argnums=0)
+        # No donation: resized buffers change size, so none can alias.
+        self._resize = jax.jit(resize_state, static_argnames=("m",))
         self._ingest = jax.jit(prefix.ingest_keys, static_argnames=("remove",))
         self._clear_prefix = jax.jit(
             lambda st, slot: st.replace(
@@ -484,7 +498,7 @@ class Scheduler:
             )
             self._min_bucket = 1
         self.mesh = mesh
-        self._warm_buckets: set[int] = set()
+        self._warm_buckets: set[tuple[int, int]] = set()  # (n_bucket, m)
         self._warm_lock = threading.Lock()
 
     def _warm(self, reqs: RequestBatch, eps: EndpointBatch) -> None:
@@ -493,22 +507,39 @@ class Scheduler:
         concurrent pick()/complete() calls. The throwaway state is donated
         and discarded; the live state is untouched."""
         self._jit(
-            SchedState.init(), reqs, eps, self.weights,
-            jax.random.PRNGKey(0), self.predictor_params,
+            SchedState.init(m=int(eps.valid.shape[0])), reqs, eps,
+            self.weights, jax.random.PRNGKey(0), self.predictor_params,
         )
 
     def pick(self, reqs: RequestBatch, eps: EndpointBatch) -> PickResult:
         """Schedule a micro-batch; returns host-side PickResult rows for the
-        original (pre-padding) batch."""
+        original (pre-padding) batch.
+
+        The endpoint-axis width of `eps` (an M bucket — see
+        constants.M_BUCKETS; the batching layer sizes it to the live
+        high-water slot) selects the compiled cycle; the device state is
+        migrated across bucket boundaries in place, carrying assumed load
+        and prefix affinity for every surviving slot."""
         n = int(np.asarray(reqs.valid).shape[0])
         bucket = bucket_for(max(n, self._min_bucket))
         reqs = pad_requests(reqs, bucket)
-        if bucket not in self._warm_buckets:
+        m = int(eps.valid.shape[0])
+        if m not in C.M_BUCKETS:
+            raise ValueError(
+                f"EndpointBatch width {m} is not an M bucket {C.M_BUCKETS}")
+        if int(reqs.subset_mask.shape[1]) != m:
+            raise ValueError(
+                f"subset_mask width {reqs.subset_mask.shape[1]} != "
+                f"endpoint width {m}")
+        warm_key = (bucket, m)
+        if warm_key not in self._warm_buckets:
             with self._warm_lock:
-                if bucket not in self._warm_buckets:
+                if warm_key not in self._warm_buckets:
                     self._warm(reqs, eps)
-                    self._warm_buckets.add(bucket)
+                    self._warm_buckets.add(warm_key)
         with self._lock:
+            if self.state.m != m:
+                self.state = self._resize(self.state, m=m)
             self._key, sub = jax.random.split(self._key)
             result, self.state = self._jit(
                 self.state, reqs, eps, self.weights, sub, self.predictor_params
@@ -556,6 +587,11 @@ class Scheduler:
             state = jax.tree.map(np.asarray, self.state)
             weights = self.weights
             params = self.predictor_params
+        m = int(eps.valid.shape[0])
+        if int(state.assumed_load.shape[0]) != m:
+            # Explaining against a different M bucket than the live state
+            # (e.g. before the first pick after churn): resize the snapshot.
+            state = resize_state(state, m)
         mask, shed, named, _stacked, _wvec, total = build_stages(
             state, reqs, eps, weights,
             cfg=self.cfg, predictor_fn=self.predictor_fn,
@@ -580,6 +616,11 @@ class Scheduler:
         fold in chunks of the largest bucket."""
         with self._lock:
             state = self.state
+            if slot >= state.m:
+                # The reporting endpoint lives beyond the current bucket
+                # (events arrived before its first pick) — grow now so its
+                # presence bits have somewhere to land.
+                state = self._resize(state, m=m_bucket_for(slot + 1))
             for hashes, remove in ((stored, False), (removed, True)):
                 hashes = np.asarray(hashes, np.uint32)
                 for start in range(0, len(hashes), self._EVENT_BUCKETS[-1]):
@@ -598,6 +639,8 @@ class Scheduler:
         deleted or slot reassigned). Called by the datastore on PodDelete
         (reference pkg/lwepp/datastore/datastore.go:257-265)."""
         with self._lock:
+            if slot >= self.state.m:
+                return  # beyond the live bucket: nothing was ever recorded
             self.state = self._evict(self.state, jnp.int32(slot))
 
     def clear_prefix_endpoint(self, slot: int) -> None:
@@ -607,6 +650,8 @@ class Scheduler:
         zeroing its charge would make it look idle and over-route it —
         eviction (prefix + load) is reserved for PodDelete."""
         with self._lock:
+            if slot >= self.state.m:
+                return  # beyond the live bucket: nothing was ever recorded
             self.state = self._clear_prefix(self.state, jnp.int32(slot))
 
     def snapshot_assumed_load(self) -> np.ndarray:
@@ -631,7 +676,16 @@ class Scheduler:
     def restore_state(self, directory: str) -> bool:
         from gie_tpu.utils.checkpoint import restore_pytree
 
-        restored = restore_pytree(directory, SchedState.init())
+        # The saved state was laid out for whichever M bucket was live at
+        # save time; try each template until one round-trips. The next
+        # pick migrates it to the current bucket as usual.
+        restored = None
+        for m in C.M_BUCKETS:
+            restored = restore_pytree(directory, SchedState.init(m=m))
+            if restored is not None and int(
+                    restored.assumed_load.shape[0]) == m:
+                break
+            restored = None
         if restored is None:
             return False
         with self._lock:

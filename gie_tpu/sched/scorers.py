@@ -93,7 +93,7 @@ def session_affinity_score(
         reqs.chunk_hashes, depth[:, None], axis=1
     )[:, 0].astype(jnp.uint32)                              # u32[N]
 
-    slots = jnp.arange(C.M_MAX, dtype=jnp.uint32)
+    slots = jnp.arange(eps.valid.shape[0], dtype=jnp.uint32)
     h = key[:, None] ^ (slots[None, :] * jnp.uint32(0x9E3779B1))
     # splitmix32-style avalanche so slot order carries no structure.
     h = (h ^ (h >> 16)) * jnp.uint32(0x7FEB352D)

@@ -109,14 +109,14 @@ class PrefixTable:
     """
 
     keys: jax.Array     # u32[PREFIX_SLOTS], 0 = empty
-    present: jax.Array  # u32[PREFIX_SLOTS, M_WORDS] packed endpoint bits
+    present: jax.Array  # u32[PREFIX_SLOTS, m//32] packed endpoint bits
     ages: jax.Array     # u32[PREFIX_SLOTS] last-touch tick
 
     @staticmethod
-    def empty(slots: int = C.PREFIX_SLOTS) -> "PrefixTable":
+    def empty(slots: int = C.PREFIX_SLOTS, m: int = C.M_MAX) -> "PrefixTable":
         return PrefixTable(
             keys=jnp.zeros((slots,), jnp.uint32),
-            present=jnp.zeros((slots, C.M_WORDS), jnp.uint32),
+            present=jnp.zeros((slots, m // 32), jnp.uint32),
             ages=jnp.zeros((slots,), jnp.uint32),
         )
 
@@ -133,18 +133,23 @@ class SchedState:
     """
 
     prefix: PrefixTable
-    assumed_load: jax.Array  # f32[M_MAX] in normalized request-cost units
+    assumed_load: jax.Array  # f32[m] in normalized request-cost units
     rr: jax.Array            # u32 scalar round-robin / tie-break counter
     tick: jax.Array          # u32 scalar cycle counter
 
     @staticmethod
-    def init(slots: int = C.PREFIX_SLOTS) -> "SchedState":
+    def init(slots: int = C.PREFIX_SLOTS, m: int = C.M_MAX) -> "SchedState":
         return SchedState(
-            prefix=PrefixTable.empty(slots),
-            assumed_load=jnp.zeros((C.M_MAX,), jnp.float32),
+            prefix=PrefixTable.empty(slots, m),
+            assumed_load=jnp.zeros((m,), jnp.float32),
             rr=jnp.zeros((), jnp.uint32),
             tick=jnp.zeros((), jnp.uint32),
         )
+
+    @property
+    def m(self) -> int:
+        """Endpoint-axis width this state is laid out for (an M bucket)."""
+        return int(self.assumed_load.shape[0])
 
 
 @flax.struct.dataclass
@@ -225,3 +230,39 @@ def bucket_for(n: int) -> int:
         if n <= b:
             return b
     raise ValueError(f"batch of {n} exceeds max bucket {C.N_BUCKETS[-1]}")
+
+
+def m_bucket_for(count: int) -> int:
+    """Smallest endpoint-axis bucket covering `count` slots (the HIGH-WATER
+    slot index + 1, not the live count — slot ids must stay addressable)."""
+    for b in C.M_BUCKETS:
+        if count <= b:
+            return b
+    raise ValueError(
+        f"{count} endpoint slots exceed max bucket {C.M_BUCKETS[-1]}")
+
+
+def resize_state(state: SchedState, m: int) -> SchedState:
+    """Migrate scheduler state across an M-bucket boundary.
+
+    Grow: new slots start with zero assumed load and no prefix presence
+    bits — exactly the state a fresh endpoint would have. Shrink: slots
+    beyond the new bucket are dropped; the caller (Scheduler) only shrinks
+    when the high-water live slot fits the smaller bucket, so anything
+    truncated belongs to endpoints the datastore already evicted. Table
+    keys/ages are m-independent and carried untouched, so surviving
+    endpoints keep their cache affinity across the migration.
+    """
+    m_old = int(state.assumed_load.shape[0])
+    if m == m_old:
+        return state
+    w = m // 32
+    if m > m_old:
+        load = jnp.pad(state.assumed_load, (0, m - m_old))
+        present = jnp.pad(
+            state.prefix.present, ((0, 0), (0, w - m_old // 32)))
+    else:
+        load = state.assumed_load[:m]
+        present = state.prefix.present[:, :w]
+    return state.replace(
+        assumed_load=load, prefix=state.prefix.replace(present=present))

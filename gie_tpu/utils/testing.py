@@ -27,9 +27,14 @@ def make_endpoints(
     lora_active: Optional[Sequence[Sequence[int]]] = None,
     lora_waiting: Optional[Sequence[Sequence[int]]] = None,
     role: Optional[Sequence[int]] = None,
+    m_slots: int = C.M_MAX,
 ) -> EndpointBatch:
-    """Build an EndpointBatch with `m` valid endpoint slots."""
-    metrics = np.zeros((C.M_MAX, C.NUM_METRICS), np.float32)
+    """Build an EndpointBatch with `m` valid endpoint slots laid out on an
+    `m_slots`-wide axis (an M bucket; defaults to M_MAX so existing tests
+    keep their shapes)."""
+    if m > m_slots:
+        raise ValueError(f"{m} endpoints do not fit m_slots={m_slots}")
+    metrics = np.zeros((m_slots, C.NUM_METRICS), np.float32)
     if queue is not None:
         metrics[:m, C.Metric.QUEUE_DEPTH] = np.asarray(queue, np.float32)
     if kv is not None:
@@ -38,17 +43,17 @@ def make_endpoints(
         metrics[:m, C.Metric.RUNNING_REQUESTS] = np.asarray(running, np.float32)
     metrics[:m, C.Metric.MAX_LORA] = max_lora
 
-    active = np.full((C.M_MAX, C.LORA_SLOTS), -1, np.int32)
-    waiting = np.full((C.M_MAX, C.LORA_SLOTS), -1, np.int32)
+    active = np.full((m_slots, C.LORA_SLOTS), -1, np.int32)
+    waiting = np.full((m_slots, C.LORA_SLOTS), -1, np.int32)
     for table, src in ((active, lora_active), (waiting, lora_waiting)):
         if src is not None:
             for i, ids in enumerate(src):
                 for j, a in enumerate(ids):
                     table[i, j] = a
 
-    valid = np.zeros((C.M_MAX,), bool)
+    valid = np.zeros((m_slots,), bool)
     valid[:m] = True
-    roles = np.zeros((C.M_MAX,), np.int32)
+    roles = np.zeros((m_slots,), np.int32)
     if role is not None:
         roles[:m] = np.asarray(role, np.int32)
     return EndpointBatch(
@@ -68,6 +73,7 @@ def make_requests(
     criticality: Optional[Sequence[int]] = None,
     subset: Optional[Sequence[Optional[Sequence[int]]]] = None,
     prompt_len: Optional[Sequence[float]] = None,
+    m_slots: int = C.M_MAX,
 ) -> RequestBatch:
     """Build a RequestBatch of `n` valid requests.
 
@@ -91,7 +97,7 @@ def make_requests(
     if prompt_len is not None:
         plen = np.asarray(prompt_len, np.float32)
 
-    mask = np.ones((n, C.M_MAX), bool)
+    mask = np.ones((n, m_slots), bool)
     hint = np.zeros((n,), bool)
     if subset is not None:
         for i, allow in enumerate(subset):
