@@ -69,19 +69,32 @@ class ModelRewritePlugin:
         )
 
 
+def parse_body(body: bytes) -> Optional[dict]:
+    """The chain's single JSON parse (1964 README:59 shared-parse rule),
+    exposed so a chain-less EPP can honor the same at-most-once contract."""
+    if not body:
+        return None
+    try:
+        obj = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
 class PluginChain:
     def __init__(self, plugins: list[BBRPlugin]):
         self.plugins = list(plugins)
 
-    def execute(self, body: bytes) -> tuple[dict[str, str], Optional[bytes]]:
-        parsed: Optional[dict] = None
-        if body:
-            try:
-                obj = json.loads(body)
-                if isinstance(obj, dict):
-                    parsed = obj
-            except (ValueError, UnicodeDecodeError):
-                parsed = None
+    def execute(
+        self, body: bytes
+    ) -> tuple[dict[str, str], Optional[bytes], Optional[dict]]:
+        """-> (headers-to-set, mutated-body-or-None, final parsed dict).
+
+        The parsed dict (post-mutation view) rides along so downstream
+        consumers — the EPP's decode-length extraction — reuse this parse
+        instead of re-reading the body (the 1964 shared-parse rule applies
+        to the whole request path, not just the plugins)."""
+        parsed = parse_body(body)
         headers: dict[str, str] = {}
         mutated: Optional[bytes] = None
         current = parsed
@@ -90,9 +103,7 @@ class PluginChain:
             headers.update(h)
             if m is not None:
                 mutated = m
-                try:
-                    obj = json.loads(m)
-                    current = obj if isinstance(obj, dict) else current
-                except ValueError:
-                    pass
-        return headers, mutated
+                reparsed = parse_body(m)
+                if reparsed is not None:
+                    current = reparsed
+        return headers, mutated, current

@@ -28,6 +28,11 @@ class WatchEvent:
     kind: str        # "Pod" | "InferencePool"
     namespace: str
     name: str
+    # Raw manifest carried by the watch stream / relist (informer-style
+    # pass-through so per-event reconciles need no re-GET). None for
+    # DELETED events and for sources that don't carry objects
+    # (FakeCluster) — consumers fall back to a client GET.
+    object: Optional[dict] = None
 
 
 class ClusterClient(Protocol):

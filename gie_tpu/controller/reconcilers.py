@@ -60,13 +60,22 @@ class PodReconciler:
         self.client = client
         self.datastore = datastore
 
-    def reconcile(self, namespace: str, name: str) -> Optional[RequeueAfter]:
+    def reconcile(self, namespace: str, name: str,
+                  obj: Optional[dict] = None) -> Optional[RequeueAfter]:
         if not self.datastore.pool_has_synced():
             return RequeueAfter(5.0)
         pool = self.datastore.pool_get()
         if namespace != pool.namespace:
             return None
-        pod = self.client.get_pod(namespace, name)
+        if obj is not None:
+            # Informer-style pass-through: the watch/relist already carried
+            # the manifest at this event's resourceVersion — no re-GET
+            # (the reference gets this from controller-runtime's cache).
+            from gie_tpu.controller.kube import pod_from_k8s
+
+            pod = pod_from_k8s(obj)
+        else:
+            pod = self.client.get_pod(namespace, name)
         if pod is None:
             self.datastore.pod_delete(namespace, name)
             return None
@@ -90,8 +99,12 @@ def wire(
 
     def on_event(ev: WatchEvent) -> None:
         if ev.kind == "InferencePool":
+            # Pool events always re-GET: there is one pool object, its
+            # events are rare, and deletionTimestamp semantics stay in
+            # one place (get_pool).
             pool_reconciler.reconcile(ev.namespace, ev.name)
         elif ev.kind == "Pod":
-            pod_reconciler.reconcile(ev.namespace, ev.name)
+            pod_reconciler.reconcile(
+                ev.namespace, ev.name, obj=getattr(ev, "object", None))
 
     cluster.subscribe(on_event)

@@ -34,6 +34,12 @@ MODEL_NAME_HEADER = "X-Gateway-Model-Name"
 # predictor enabled, non-critical requests whose PREDICTED TTFT already
 # misses this bound are shed with 429 instead of wasting capacity.
 TTFT_SLO_MS_KEY = "x-gateway-inference-ttft-slo-ms"
+# Per-request expected output length in TOKENS (proposal 006's
+# output-length dimension, reference docs/proposals/006-scheduler/
+# README.md:27-36). Explicit header beats the body's max_tokens /
+# max_completion_tokens / max_output_tokens cap, which the EPP extracts
+# from the (single, shared) BBR body parse otherwise.
+DECODE_TOKENS_HINT_KEY = "x-gateway-inference-decode-tokens"
 
 TEST_ENDPOINT_SELECTION_HEADER = "test-epp-endpoint-selection"
 

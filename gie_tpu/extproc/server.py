@@ -22,6 +22,8 @@ endpoint-picker protocol (004 README:80).
 from __future__ import annotations
 
 import dataclasses
+import re
+import time
 from typing import Optional, Protocol
 
 import grpc
@@ -54,6 +56,12 @@ class PickRequest:
     headers: dict[str, list[str]]
     body: Optional[bytes] = None
     model: str = ""
+    # Expected output length in TOKENS (0 = unknown): the decode-tokens
+    # header, else the body's max_tokens-style cap — the output-length
+    # scheduling dimension of reference 006 README:27-36. Feeds
+    # RequestBatch.decode_len (via CHARS_PER_TOKEN) so request_cost and
+    # the pd decode-side cost see generation length on the live path.
+    decode_tokens: float = 0.0
 
 
 @dataclasses.dataclass
@@ -83,6 +91,51 @@ class PickResult:
     def destination_value(self) -> str:
         """Comma-separated ordered fallback list (004 README:50-82)."""
         return ",".join([self.endpoint] + self.fallbacks)
+
+
+# Body fields carrying the client's output-token cap, by API generation:
+# completions/chat legacy, newer chat, responses API.
+_MAX_TOKENS_FIELDS = ("max_tokens", "max_completion_tokens",
+                      "max_output_tokens")
+
+
+# Bound on client-supplied token hints: beyond any real context window,
+# and small enough that downstream features (decode_len / DECODE_NORM)
+# stay finite — an inf/1e400 from a hostile body must not reach the
+# predictor's training buffer (one NaN gradient poisons every later pick).
+_DECODE_TOKENS_CAP = 1_000_000.0
+
+
+def _decode_tokens(
+    headers: dict[str, list[str]], parsed: Optional[dict]
+) -> float:
+    """Expected output tokens for one request: explicit decode-tokens
+    header first, else the parsed body's max_tokens-style cap; 0.0 when
+    neither is present/parsable (the scheduler treats 0 as unknown).
+    Values are clamped to a finite cap — JSON and float() both happily
+    produce inf."""
+    import math
+
+    def clamp(v: float) -> float:
+        if not math.isfinite(v) or v <= 0:
+            return 0.0
+        return min(v, _DECODE_TOKENS_CAP)
+
+    raw = headers.get(metadata.DECODE_TOKENS_HINT_KEY, [""])[0]
+    try:
+        val = clamp(float(raw))
+        if val > 0:
+            return val
+    except (TypeError, ValueError):
+        pass
+    if parsed:
+        for field in _MAX_TOKENS_FIELDS:
+            v = parsed.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                val = clamp(float(v))
+                if val > 0:
+                    return val
+    return 0.0
 
 
 class EndpointPicker(Protocol):
@@ -121,6 +174,16 @@ class RequestContext:
     frame_decoder: object = None
     response_frames: list = dataclasses.field(default_factory=list)
     held_bytes: int = 0  # running size of buffered response_frames
+    # Response-stream observation (TPOT training signal, reference 006's
+    # two-headed latency model): endpoint reported as having served, token
+    # count harvested from the stream, and first/last body-chunk times.
+    served_hostport: str = ""
+    resp_tokens: int = 0
+    resp_first_at: float = 0.0
+    resp_last_at: float = 0.0
+    sse_carry: bytes = b""   # split-"data:" guard across chunk boundaries
+    resp_tail: bytes = b""   # last bytes kept for the usage-block parse
+    last_frame: Optional[bytes] = None  # last decoded Generate frame
 
 
 class Stream(Protocol):
@@ -134,12 +197,18 @@ class StreamingServer:
     (Envoy opens an ext-proc stream per request)."""
 
     def __init__(self, datastore, picker: EndpointPicker, on_served=None,
-                 bbr_chain=None, transcode_h2c: bool = True):
+                 bbr_chain=None, transcode_h2c: bool = True,
+                 on_response_complete=None):
         self.datastore = datastore
         self.picker = picker
         # Served-endpoint feedback hook (004 README:84-101): called with the
         # hostport reported by the data plane at response time.
         self.on_served = on_served
+        # Response-stream-complete hook: called with the RequestContext
+        # once the response body finishes — carries the harvested token
+        # count + chunk timings (the TPOT training signal the
+        # response-headers hop cannot observe).
+        self.on_response_complete = on_response_complete
         # Optional BBR plugin chain (proposal 1964): runs over the complete
         # request body before the pick; its headers join the header mutation
         # and its body mutation is forwarded chunked.
@@ -239,11 +308,17 @@ class StreamingServer:
             elif which == "response_headers":
                 stream.send(self._handle_response_headers(ctx, req))
             elif which == "response_body":
+                now = time.monotonic()
+                if req.response_body.body:
+                    if ctx.resp_first_at == 0.0:
+                        ctx.resp_first_at = now
+                    ctx.resp_last_at = now
                 if ctx.transcoding:
                     stream.send(
                         self._transcode_response_body(ctx, req.response_body)
                     )
                 else:
+                    self._count_plain_tokens(ctx, req.response_body.body)
                     stream.send(
                         pb.ProcessingResponse(
                             response_body=pb.BodyResponse(
@@ -251,6 +326,10 @@ class StreamingServer:
                             )
                         )
                     )
+                if req.response_body.end_of_stream:
+                    self._finish_token_count(ctx)
+                    if self.on_response_complete is not None:
+                        self.on_response_complete(ctx)
             else:
                 # request_trailers / response_trailers parse (wire-correct
                 # fields 4/7) but are ignored, matching the reference
@@ -330,9 +409,17 @@ class StreamingServer:
     def _pick_inner(self, ctx: RequestContext, body: Optional[bytes]) -> PickResult:
         bbr_headers: dict[str, str] = {}
         bbr_body: Optional[bytes] = None
+        parsed: Optional[dict] = None
         if self.bbr_chain is not None and body:
             with tracing.span("extproc.bbr"):
-                bbr_headers, bbr_body = self.bbr_chain.execute(body)
+                bbr_headers, bbr_body, parsed = self.bbr_chain.execute(body)
+        elif body:
+            # No BBR chain: the EPP still owes the scheduler its
+            # output-length hint; this is the request path's one parse
+            # (same at-most-once contract as the chain's).
+            from gie_tpu.bbr.chain import parse_body
+
+            parsed = parse_body(body)
         # Model precedence: an explicit rewrite (from BBR's rewrite plugin,
         # else the upstream rewrite header) beats the raw extracted body
         # model (proposal 1816 rewrite > 1964 extraction).
@@ -348,6 +435,7 @@ class StreamingServer:
                 headers=ctx.headers,
                 body=bbr_body if bbr_body is not None else body,
                 model=model,
+                decode_tokens=_decode_tokens(ctx.headers, parsed),
             ),
             ctx.candidates,
         )
@@ -447,6 +535,11 @@ class StreamingServer:
             )
         try:
             messages = ctx.frame_decoder.feed(body_msg.body)
+            if messages:
+                # TPOT harvest: one Generate frame ~ one token group; the
+                # final frame's completion_tokens overrides at stream end.
+                ctx.resp_tokens += len(messages)
+                ctx.last_frame = messages[-1]
             if ctx.stream_requested:
                 out = b"".join(
                     codec.generate_response_to_sse(m, ctx.model) for m in messages
@@ -474,6 +567,49 @@ class StreamingServer:
                 ctx, f"upstream response not decodable: {type(e).__name__}"
             )
 
+    # Matches the OpenAI usage block's completion-token count in a JSON
+    # response (or an SSE stream's final usage frame).
+    _USAGE_RE = re.compile(rb'"completion_tokens"\s*:\s*(\d+)')
+
+    def _count_plain_tokens(self, ctx: RequestContext, data: bytes) -> None:
+        """Token-count harvest on the NON-transcoded response path: SSE
+        `data:` frames approximate one token-group each (counted with a
+        carry so a frame marker split across chunk boundaries still
+        counts); a rolling tail is kept so a final usage block — the
+        authoritative count — can override in _finish_token_count."""
+        if not data:
+            return
+        buf = ctx.sse_carry + data
+        # Matches ENDING in this chunk only (the carry's own complete
+        # matches were counted when their chunk arrived).
+        ctx.resp_tokens += buf.count(b"data:") - ctx.sse_carry.count(b"data:")
+        ctx.sse_carry = buf[-4:]
+        ctx.resp_tail = (ctx.resp_tail + data)[-4096:]
+
+    def _finish_token_count(self, ctx: RequestContext) -> None:
+        """End of response stream: prefer authoritative counts. Transcoded
+        streams read completion_tokens from the final Generate frame;
+        plain streams fall back to the usage block in the tail; the SSE
+        frame count (minus the [DONE] sentinel) remains the floor."""
+        if ctx.resp_tokens and b"data: [DONE]" in ctx.resp_tail:
+            ctx.resp_tokens -= 1
+        if ctx.transcoding and ctx.last_frame is not None:
+            from gie_tpu.extproc.pb import generate_pb2
+
+            try:
+                last = generate_pb2.GenerateResponse.FromString(
+                    ctx.last_frame)
+                if last.completion_tokens > 0:
+                    ctx.resp_tokens = int(last.completion_tokens)
+                    return
+            except _DecodeError:
+                pass
+        m = None
+        for m in self._USAGE_RE.finditer(ctx.resp_tail):
+            pass  # keep the LAST usage block (cumulative in SSE streams)
+        if m is not None:
+            ctx.resp_tokens = int(m.group(1))
+
     def _handle_response_headers(
         self, ctx: RequestContext, req: pb.ProcessingRequest
     ) -> pb.ProcessingResponse:
@@ -485,6 +621,7 @@ class StreamingServer:
             v = lb.get(metadata.DESTINATION_ENDPOINT_SERVED_KEY)
             if isinstance(v, str):
                 served = v
+        ctx.served_hostport = served
         if served and self.on_served is not None:
             self.on_served(served, ctx)
         set_headers = {metadata.WENT_INTO_RESP_HEADERS: "true"}

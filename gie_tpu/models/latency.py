@@ -287,20 +287,31 @@ class OnlineTrainer:
     def observe(
         self,
         features: np.ndarray,
-        ttft_s: float,
+        ttft_s: Optional[float],
         tpot_s: Optional[float] = None,
         slot: int = C.M_MAX,
     ) -> None:
-        """Record one observation. Pass tpot_s=None when only TTFT was
-        measured — the TPOT head is masked out of the loss for that sample
-        instead of being dragged toward zero. `slot` is the served
-        endpoint's scheduler slot (feeds the per-endpoint embedding;
-        defaults to the unknown bucket)."""
+        """Record one observation. Either head may be None when that
+        quantity was not measured — it is masked out of the loss for the
+        sample instead of being dragged toward zero (TTFT-only: response
+        headers with no token counts; TPOT-only: the response-stream
+        completion signal, which arrives on a different hop than the TTFT
+        approximation). A both-None observation is dropped. `slot` is the
+        served endpoint's scheduler slot (feeds the per-endpoint
+        embedding; defaults to the unknown bucket)."""
+        if ttft_s is None and tpot_s is None:
+            return
         with self._lock:
             self._feats[self._head] = features
             self._slots[self._head] = min(max(int(slot), 0), C.M_MAX)
-            self._targets[self._head] = (ttft_s, tpot_s if tpot_s is not None else 0.0)
-            self._weights[self._head] = (1.0, 0.0 if tpot_s is None else 1.0)
+            self._targets[self._head] = (
+                ttft_s if ttft_s is not None else 0.0,
+                tpot_s if tpot_s is not None else 0.0,
+            )
+            self._weights[self._head] = (
+                0.0 if ttft_s is None else 1.0,
+                0.0 if tpot_s is None else 1.0,
+            )
             self._head = (self._head + 1) % self.capacity
             self._n = min(self._n + 1, self.capacity)
             self._observed_total += 1

@@ -5,9 +5,17 @@ picker protocol's readiness rules, 004 README:111-115): multiple replicas
 run, exactly one leads; followers keep liveness SERVING but readiness
 NOT_SERVING so the data plane only routes ext-proc traffic to the leader.
 
-Implementation: a filesystem lease with atomic primitives — the right shape
-for single-host/demo deployments and the seam where a Kubernetes Lease
-object plugs in for real clusters. Mutual exclusion:
+Two electors share the start/stop/is_leader surface:
+
+  KubeLeaseElector — coordination.k8s.io/v1 Lease objects through the
+      stdlib kube adapter (the reference's client-go leaderelection
+      equivalent): acquire-on-404/expiry, holder-only renew, optimistic
+      concurrency via resourceVersion (a 409 means another replica won),
+      graceful release on stop. The real-cluster elector.
+  LeaseFileElector — a filesystem lease with atomic primitives: the
+      single-host/demo fallback.
+
+File-lease mutual exclusion:
 
   takeover of an expired lease = rename(lease -> lease.expired.<id>)
       (exactly one contender's rename succeeds; losers get ENOENT), then
@@ -25,11 +33,212 @@ holds.
 
 from __future__ import annotations
 
+import datetime
 import os
 import threading
 import time
 import uuid
 from typing import Optional
+
+_LEASES = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+
+
+def _microtime(t: Optional[float] = None) -> str:
+    """metav1.MicroTime wire format."""
+    return (
+        datetime.datetime.fromtimestamp(
+            time.time() if t is None else t, datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+    )
+
+
+def _parse_microtime(s: str) -> float:
+    try:
+        return datetime.datetime.strptime(
+            s, "%Y-%m-%dT%H:%M:%S.%fZ"
+        ).replace(tzinfo=datetime.timezone.utc).timestamp()
+    except (TypeError, ValueError):
+        try:
+            return datetime.datetime.strptime(
+                s, "%Y-%m-%dT%H:%M:%SZ"
+            ).replace(tzinfo=datetime.timezone.utc).timestamp()
+        except (TypeError, ValueError):
+            return 0.0
+
+
+class KubeLeaseElector:
+    """Distributed leader election on a coordination.k8s.io/v1 Lease.
+
+    `client` is the stdlib kube adapter (controller/kube.py
+    KubeClusterClient) — anything exposing its `_json(method, path,
+    body)` HTTP core works. Contention rules (reference
+    internal/runnable/leader_election.go via client-go leaderelection):
+
+      404                -> POST create with our holderIdentity; a 409
+                            means another replica created first.
+      holder == us       -> PUT renewTime refresh carrying the observed
+                            resourceVersion; 409 = someone took the
+                            lease from under us -> follower.
+      holder empty/other -> take over ONLY when the lease is expired;
+                            the PUT carries the observed resourceVersion
+                            so exactly one contender wins the takeover.
+
+    Expiry is judged by LOCAL observation, never by the record's own
+    timestamps (client-go leaderelection's rule): a foreign lease is
+    expired when its (holder, renewTime) pair has not CHANGED for
+    leaseDurationSeconds of this replica's monotonic clock. Comparing
+    the holder's wall-clock renewTime against our wall clock would let
+    a replica with a skewed clock steal a live lease — two ready
+    leaders.
+
+    Failed renews get a grace window: a transient apiserver error keeps
+    locally-confirmed leadership until the lease we last wrote would
+    have expired anyway (client-go's renewDeadline tolerance) — without
+    it, one 5xx blips readiness fleet-wide while the unexpired Lease
+    still blocks every other replica. A 409 (someone else holds the
+    lease) always drops leadership immediately.
+
+    stop() releases a lease we still hold by blanking holderIdentity, so
+    failover needs no TTL wait on clean shutdown."""
+
+    def __init__(
+        self,
+        client,
+        namespace: str,
+        lease_name: str,
+        *,
+        identity: Optional[str] = None,
+        lease_ttl_s: float = 15.0,
+        renew_interval_s: float = 2.0,
+    ):
+        self.client = client
+        self.path = _LEASES.format(ns=namespace) + f"/{lease_name}"
+        self.create_path = _LEASES.format(ns=namespace)
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self.lease_ttl_s = lease_ttl_s
+        self.renew_interval_s = renew_interval_s
+        self._leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Local-observation record for skew-safe expiry:
+        # (holder, renewTime-string) -> monotonic time we FIRST saw it.
+        self._observed: Optional[tuple[str, str]] = None
+        self._observed_at = 0.0
+        # Monotonic deadline until which a transient renew failure keeps
+        # locally-confirmed leadership (see class docstring).
+        self._good_until = 0.0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._leader:
+            try:
+                lease = self.client._json("GET", self.path)
+                spec = lease.get("spec") or {}
+                if spec.get("holderIdentity") == self.identity:
+                    spec["holderIdentity"] = ""
+                    spec["renewTime"] = _microtime()
+                    lease["spec"] = spec
+                    self.client._json("PUT", self.path, lease)
+            except Exception:
+                pass  # release is best-effort; the TTL backstops it
+        self._leader = False
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    # ------------------------------------------------------------------ #
+
+    def _lease_body(self, acquire: bool, base: Optional[dict] = None) -> dict:
+        lease = base if base is not None else {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.lease_name,
+                         "namespace": self.namespace},
+        }
+        spec = dict(lease.get("spec") or {})
+        now = _microtime()
+        if acquire:
+            spec["acquireTime"] = now
+            spec["leaseTransitions"] = int(
+                spec.get("leaseTransitions") or 0) + 1
+        spec["holderIdentity"] = self.identity
+        spec["renewTime"] = now
+        spec["leaseDurationSeconds"] = int(max(self.lease_ttl_s, 1))
+        lease["spec"] = spec
+        return lease
+
+    def _tick(self) -> bool:
+        from gie_tpu.controller.kube import ApiError
+
+        try:
+            lease = self.client._json("GET", self.path)
+        except ApiError as e:
+            if e.status != 404:
+                raise
+            try:
+                self.client._json(
+                    "POST", self.create_path, self._lease_body(acquire=True))
+                return True
+            except ApiError as e2:
+                if e2.status == 409:
+                    return False  # another replica created first
+                raise
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity") or ""
+        ttl = float(spec.get("leaseDurationSeconds")
+                    or self.lease_ttl_s)
+        # Skew-safe expiry: the lease is stale only when ITS OWN record
+        # (holder + renewTime string) has sat unchanged for ttl seconds
+        # of OUR monotonic clock. The record's wall-clock value is never
+        # compared against ours.
+        record = (holder, str(spec.get("renewTime") or ""))
+        now_mono = time.monotonic()
+        if record != self._observed:
+            self._observed = record
+            self._observed_at = now_mono
+        expired = (now_mono - self._observed_at) > ttl
+        if holder == self.identity:
+            body = self._lease_body(acquire=False, base=lease)
+        elif not holder or expired:
+            body = self._lease_body(acquire=True, base=lease)
+        else:
+            return False  # live foreign lease
+        try:
+            self.client._json("PUT", self.path, body)
+            return True
+        except ApiError as e:
+            if e.status == 409:
+                return False  # lost the optimistic-concurrency race
+            raise
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._leader = self._tick()
+                if self._leader:
+                    # The lease we just wrote blocks every other replica
+                    # for ttl; transient failures inside that window keep
+                    # leadership (renewDeadline grace).
+                    self._good_until = (
+                        time.monotonic() + self.lease_ttl_s)
+            except Exception:
+                # Apiserver unreachable: keep locally-confirmed
+                # leadership while our last written lease is still
+                # unexpired (no one else can hold it), then fail safe to
+                # follower. Followers stay followers.
+                self._leader = (
+                    self._leader
+                    and time.monotonic() < self._good_until
+                )
+            self._stop.wait(self.renew_interval_s)
 
 
 class LeaseFileElector:

@@ -38,8 +38,21 @@ def _demo_cluster(opts, n_pods: int):
                 body = s.metrics_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")
+                # Which pod served — lets a data-plane smoke test (an
+                # Envoy routing on x-gateway-destination-endpoint via
+                # original_dst, hack/envoy_smoke.sh) assert the EPP's
+                # steering was honored end to end.
+                self.send_header("X-Served-By",
+                                 "%s:%d" % self.server.server_address)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_POST(self):
+                # Drain the body so keep-alive connections stay in sync.
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                if n:
+                    self.rfile.read(n)
+                self.do_GET()
 
             def log_message(self, *a):
                 pass
@@ -108,7 +121,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--kube", action="store_true",
         help="connect to a real kube-apiserver (in-cluster config, or "
-             "--kubeconfig); requires the kubernetes package",
+             "--kubeconfig); stdlib HTTP list/watch, no client dependency",
     )
     parser.add_argument("--kubeconfig", default=None)
     parser.add_argument(
@@ -136,7 +149,7 @@ def main(argv=None) -> int:
     else:
         log.error(
             "no cluster integration configured; run with --demo (simulated) "
-            "or --kube (real apiserver via the kubernetes package)"
+            "or --kube (real apiserver over stdlib HTTP list/watch)"
         )
         return 2
 

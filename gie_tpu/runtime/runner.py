@@ -146,9 +146,20 @@ class ExtProcServerRunner:
         self._train_thread: Optional[threading.Thread] = None
         self.elector = None
         if opts.leader_elect:
-            from gie_tpu.runtime.leader import LeaseFileElector
+            # Kube deployments elect on a coordination.k8s.io Lease
+            # (reference internal/runnable/leader_election.go) — any
+            # cluster client exposing the adapter's _json HTTP core
+            # qualifies; the file lease covers single-host/demo runs.
+            if hasattr(cluster, "_json"):
+                from gie_tpu.runtime.leader import KubeLeaseElector
 
-            self.elector = LeaseFileElector(opts.leader_lease_path)
+                self.elector = KubeLeaseElector(
+                    cluster, opts.pool_namespace,
+                    f"{opts.pool_name}-epp-leader")
+            else:
+                from gie_tpu.runtime.leader import LeaseFileElector
+
+                self.elector = LeaseFileElector(opts.leader_lease_path)
         # Objective registry (proposal 1199): named objectives -> bands,
         # populated from --objective NAME=CRITICALITY declarations (the CRD
         # watch adapter feeds the same registry in a kube deployment).
@@ -167,7 +178,9 @@ class ExtProcServerRunner:
             )
         self.picker.objective_registry = self.objectives
         self.streaming = StreamingServer(
-            self.datastore, self.picker, on_served=self.picker.observe_served
+            self.datastore, self.picker,
+            on_served=self.picker.observe_served,
+            on_response_complete=self.picker.observe_response_complete,
         )
         self.grpc_server: Optional[grpc.Server] = None
         self.health_server: Optional[grpc.Server] = None
@@ -251,13 +264,13 @@ class ExtProcServerRunner:
         original_pod = pod_rec.reconcile
         original_pool = pool_rec.reconcile
 
-        def pod_reconcile(ns, name):
-            res = original_pod(ns, name)
+        def pod_reconcile(ns, name, *args, **kw):
+            res = original_pod(ns, name, *args, **kw)
             self._sync_scrapers()
             return res
 
-        def pool_reconcile(ns, name):
-            res = original_pool(ns, name)
+        def pool_reconcile(ns, name, *args, **kw):
+            res = original_pool(ns, name, *args, **kw)
             self._sync_scrapers()
             return res
 

@@ -296,9 +296,39 @@ class BatchingTPUPicker:
             elapsed = max(time.monotonic() - picked_at, 1e-4)
             # Response headers arrive ~ first token: elapsed approximates
             # TTFT; TPOT is unobservable at this hop (no token counts), so
-            # the sample trains the TTFT head only (tpot masked).
+            # the sample trains the TTFT head only (tpot masked). The TPOT
+            # half arrives later via observe_response_complete.
             self.trainer.observe(features, ttft_s=elapsed, tpot_s=None,
                                  slot=slot)
+
+    def observe_response_complete(self, ctx) -> None:
+        """Response-stream-complete feedback -> TPOT training signal
+        (VERDICT r3 #7): the ext-proc response-body hop harvests the
+        output token count (transcoded Generate frames' completion_tokens,
+        SSE data-frame count, or the usage block) and the first/last
+        body-chunk times; their quotient is the measured per-token
+        latency. Trains the TPOT head only — the TTFT half was observed
+        at the response-headers hop."""
+        if self.trainer is None:
+            return
+        pick_result = getattr(ctx, "pick_result", None)
+        feedback = getattr(pick_result, "feedback", None)
+        if feedback is None:
+            return
+        features, slot, _picked_at, picked_hostport = feedback
+        served = getattr(ctx, "served_hostport", "")
+        if served and served != picked_hostport:
+            # Data-plane failover: the features describe the primary, the
+            # stream timing describes the fallback. Skip (same rule as
+            # the TTFT hop).
+            return
+        tokens = int(getattr(ctx, "resp_tokens", 0))
+        t0 = getattr(ctx, "resp_first_at", 0.0)
+        t1 = getattr(ctx, "resp_last_at", 0.0)
+        if tokens < 2 or t1 <= t0:
+            return  # single-chunk response: no inter-token interval exists
+        tpot = (t1 - t0) / (tokens - 1)
+        self.trainer.observe(features, ttft_s=None, tpot_s=tpot, slot=slot)
 
     def close(self) -> None:
         with self._cond:
@@ -452,11 +482,13 @@ class BatchingTPUPicker:
         lora = np.full((n,), -1, np.int32)
         crit = np.full((n,), C.Criticality.STANDARD, np.int32)
         plen = np.zeros((n,), np.float32)
-        # Decode-length hint per request (types.py RequestBatch.decode_len).
-        # No transport populates it today, but charge and release MUST share
-        # one source: the device cycle charges from the RequestBatch value,
-        # so every host-side release below derives from this same array —
-        # populating the hint later cannot desync charge accounting.
+        # Decode-length hint per request (types.py RequestBatch.decode_len,
+        # in prompt-char-equivalents): the transport's token hint (decode-
+        # tokens header or the body's max_tokens cap, extproc/server.py
+        # _decode_tokens) scaled by CHARS_PER_TOKEN. Charge and release
+        # share this one array: the device cycle charges from the
+        # RequestBatch value and every host-side release below derives
+        # from the same dlen, so the hint cannot desync accounting.
         dlen = np.zeros((n,), np.float32)
         own_metrics.BATCH_SIZE.observe(n)
         mask = np.zeros((n, mb), bool)
@@ -464,6 +496,7 @@ class BatchingTPUPicker:
             lora[i] = self.lora_registry.id_for(it.req.model)
             crit[i] = _band_for(it.req.headers, self.objective_registry)
             plen[i] = float(len(prompts[i]))
+            dlen[i] = C.CHARS_PER_TOKEN * float(it.req.decode_tokens or 0.0)
             for ep in it.candidates:
                 if 0 <= ep.slot < mb:
                     mask[i, ep.slot] = True

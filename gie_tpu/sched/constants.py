@@ -57,6 +57,13 @@ M_BUCKETS = (64, 256, 512)
 # bucket so only a handful of shapes ever compile.
 N_BUCKETS = (1, 8, 64, 256, 1024)
 
+# Output-length hints arrive in TOKENS (the client's max_tokens cap, the
+# decode-tokens header, or the simulator's workload cap) while the cost
+# model blends prompt length in CHARS (request_cost, pd_costs). One
+# conversion factor, applied at every ingestion point, keeps charge and
+# release in the same unit; ~4 chars/token is the usual English-text rate.
+CHARS_PER_TOKEN = 4.0
+
 # Max rolling-hash chunks considered per request prompt (prefix-cache match
 # depth, reference docs/proposals/0602-prefix-cache/README.md:95-112).
 MAX_CHUNKS = 32

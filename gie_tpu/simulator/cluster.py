@@ -47,6 +47,18 @@ class WorkloadConfig:
     ttft_slo_s: float = 2.0
 
 
+def client_cap_tokens(decode_tokens: float) -> float:
+    """Client-style max_tokens cap for a request whose TRUE generated
+    length is `decode_tokens`: rounded UP to the next power-of-two bucket
+    (min 16) — what a real client that roughly knows its answer size would
+    send. The scheduler sees ONLY this cap (sim-to-prod signal parity:
+    production extracts max_tokens from the body, never the true length;
+    VERDICT r3 #3); execution still generates the true length."""
+    import math
+
+    return float(max(16, 1 << math.ceil(math.log2(max(decode_tokens, 1.0)))))
+
+
 def tuned_scheduler() -> Scheduler:
     """Scheduler built from sched.config.tuned_profile() — the round-1
     swept Sinkhorn profile (goodput 2.15x vs least-kv; see
@@ -177,12 +189,20 @@ class SimCluster:
         # (pod_slot, stub_rid) -> pick-time feature row for online training
         # (BASELINE configs[3]: the predictor learns from served timings).
         feature_log: dict[tuple[int, int], np.ndarray] = {}
+        # (pod_slot, stub_rid) -> assumed cost charged at pick time (from
+        # the client-cap hint), released verbatim on completion.
+        charge_log: dict[tuple[int, int], float] = {}
+        # Adversarial baseline bookkeeping ("least-kv-assumed", VERDICT r3
+        # #8): requests in flight per pod, maintained between scrapes the
+        # way the reference EPP's assumed-load accounting would — the
+        # baseline stops being blind to its own last-50ms placements.
+        self._baseline_inflight = np.zeros((self.n,), np.float64)
         self._scrape_all(0.0)
 
         while clock < duration_s:
             # --- arrivals (Poisson) ---------------------------------------
             n_new = self.rng.poisson(wl.arrival_qps * dt)
-            prompts, decodes, loras = [], [], []
+            prompts, decodes, hints, loras = [], [], [], []
             for _ in range(n_new):
                 sess = self.rng.integers(0, wl.n_sessions)
                 suffix = bytes(
@@ -192,6 +212,10 @@ class SimCluster:
                 decodes.append(
                     float(max(self.rng.exponential(wl.decode_tokens_mean), 8.0))
                 )
+                # What the scheduler/predictor may see: the client cap in
+                # prompt-char-equivalents — never the true decode length.
+                hints.append(
+                    client_cap_tokens(decodes[-1]) * C.CHARS_PER_TOKEN)
                 loras.append(
                     f"adapter-{self.rng.integers(0, wl.lora_adapters)}"
                     if wl.lora_adapters > 0
@@ -201,7 +225,7 @@ class SimCluster:
             # --- schedule -------------------------------------------------
             if n_new:
                 picks, prefill_picks = self._schedule(
-                    policy, scheduler, prompts, decodes, loras, clock, rr_counter
+                    policy, scheduler, prompts, hints, loras, clock, rr_counter
                 )
                 rr_counter += n_new
                 if trainer is not None:
@@ -212,7 +236,7 @@ class SimCluster:
                     loads = (scheduler.snapshot_assumed_load()
                              if scheduler is not None else None)
 
-                    def feats_for(pod, prompt, decode, lora):
+                    def feats_for(pod, prompt, decode_hint, lora):
                         row = self.store._metrics[pod].copy()
                         row[C.Metric.METRICS_AGE_S] = max(
                             clock - self.store._scraped_at[pod], 0.0)
@@ -220,7 +244,7 @@ class SimCluster:
                             row,
                             float(loads[pod]) if loads is not None else 0.0,
                             float(len(prompt)),
-                            float(decode),
+                            float(decode_hint),
                             lora is not None,
                         )
 
@@ -233,9 +257,9 @@ class SimCluster:
                     # capacity for zero goodput. Released charges mirror
                     # the EPP's _slo_admission path.
                     precomputed_rows = [
-                        feats_for(pod, prompt, decode, lora)
-                        for prompt, decode, lora, pod in zip(
-                            prompts, decodes, loras, picks)
+                        feats_for(pod, prompt, hint, lora)
+                        for prompt, hint, lora, pod in zip(
+                            prompts, hints, loras, picks)
                     ]
                     pred = trainer.predict_ttft(
                         np.stack(precomputed_rows),
@@ -249,10 +273,11 @@ class SimCluster:
                                     np.asarray([pod], np.int32),
                                     np.asarray([request_cost_host(
                                         float(len(prompts[i])),
-                                        decodes[i])], np.float32),
+                                        hints[i])], np.float32),
                                 )
                 for i, (prompt, decode, lora, pod) in enumerate(
                         zip(prompts, decodes, loras, picks)):
+                    hint = hints[i]
                     if not admitted[i]:
                         continue
                     if pd:
@@ -271,15 +296,22 @@ class SimCluster:
                         rid = self.stubs[p_pod].submit(
                             prompt, decode_tokens=0.0, lora=lora)
                         prefill_jobs[(p_pod, rid)] = (
-                            pod, prompt, decode, lora, clock)
+                            pod, prompt, decode, hint, lora, clock)
                         continue
                     rid = self.stubs[pod].submit(
                         prompt, decode_tokens=decode, lora=lora)
+                    self._baseline_inflight[pod] += 1.0
+                    # Release-what-was-charged: the cycle charged from the
+                    # HINT (the only signal it had); completion must
+                    # release the same amount, not one recomputed from the
+                    # true generated length.
+                    charge_log[(pod, rid)] = request_cost_host(
+                        float(len(prompt)), hint)
                     if trainer is not None:
                         feature_log[(pod, rid)] = (
                             precomputed_rows[i]
                             if precomputed_rows is not None
-                            else feats_for(pod, prompt, decode, lora))
+                            else feats_for(pod, prompt, hint, lora))
 
             # --- advance the fleet ----------------------------------------
             for slot, stub in enumerate(self.stubs):
@@ -288,21 +320,21 @@ class SimCluster:
                         # Prefill done: start the KV transfer; the decode
                         # job submits when it lands. Release the prefill
                         # worker's charge (pd split-charging twin).
-                        d_pod, prompt, decode, lora, t0 = prefill_jobs.pop(
-                            (slot, comp.rid))
+                        (d_pod, prompt, decode, hint, lora,
+                         t0) = prefill_jobs.pop((slot, comp.rid))
                         transfer_s = (
                             0.0 if d_pod == slot
                             else kv_transfer_s_per_kb * len(prompt) / 1024.0)
                         pending_decode.append(
                             (clock + transfer_s, d_pod, prompt, decode,
-                             lora, t0, comp.hit_fraction))
-                        p_cost, _ = pd_costs_host(float(len(prompt)), decode)
+                             hint, lora, t0, comp.hit_fraction))
+                        p_cost, _ = pd_costs_host(float(len(prompt)), hint)
                         scheduler.complete(
                             np.asarray([slot], np.int32),
                             np.asarray([p_cost], np.float32))
                         continue
                     if pd and (slot, comp.rid) in decode_jobs:
-                        t0, t_d, pbytes, hit = decode_jobs.pop(
+                        t0, t_d, pbytes, hint, hit = decode_jobs.pop(
                             (slot, comp.rid))
                         # User-visible TTFT spans the whole chain: prefill
                         # queue+compute, transfer, decode queue+first token
@@ -312,12 +344,14 @@ class SimCluster:
                         completions.append(dataclasses.replace(
                             comp, ttft_s=max(user_ttft, 0.0),
                             hit_fraction=hit, prompt_bytes=pbytes))
-                        _, d_cost = pd_costs_host(pbytes, comp.output_tokens)
+                        _, d_cost = pd_costs_host(pbytes, hint)
                         scheduler.complete(
                             np.asarray([slot], np.int32),
                             np.asarray([d_cost], np.float32))
                         continue
                     completions.append(comp)
+                    self._baseline_inflight[slot] = max(
+                        self._baseline_inflight[slot] - 1.0, 0.0)
                     if trainer is not None:
                         feats = feature_log.pop((slot, comp.rid), None)
                         if feats is not None:
@@ -325,10 +359,13 @@ class SimCluster:
                                 feats, ttft_s=comp.ttft_s,
                                 tpot_s=comp.tpot_s, slot=slot)
                     if scheduler is not None and policy == "tpu":
-                        # Release exactly what pick time charged.
-                        cost = request_cost_host(
-                            comp.prompt_bytes, comp.output_tokens
-                        )
+                        # Release exactly what pick time charged (logged at
+                        # submit; the fallback recomputation only covers a
+                        # rid the log never saw, which shouldn't happen).
+                        cost = charge_log.pop(
+                            (slot, comp.rid),
+                            request_cost_host(
+                                comp.prompt_bytes, comp.output_tokens))
                         scheduler.complete(
                             np.asarray([slot], np.int32),
                             np.asarray([cost], np.float32),
@@ -338,12 +375,13 @@ class SimCluster:
                 if due:
                     pending_decode = [
                         x for x in pending_decode if x[0] > clock]
-                    for _t, d_pod, prompt, decode, lora, t0, hit in due:
+                    for (_t, d_pod, prompt, decode, hint, lora, t0,
+                         hit) in due:
                         rid = self.stubs[d_pod].submit(
                             prompt, decode_tokens=decode, lora=lora,
                             prefill_done=True)
                         decode_jobs[(d_pod, rid)] = (
-                            t0, clock, float(len(prompt)), hit)
+                            t0, clock, float(len(prompt)), hint, hit)
             clock += dt
             if clock >= next_scrape:
                 self._scrape_all(clock)
@@ -383,7 +421,7 @@ class SimCluster:
     # ------------------------------------------------------------------ #
 
     def _schedule(
-        self, policy, scheduler, prompts, decodes, loras, now, rr_counter
+        self, policy, scheduler, prompts, decode_hints, loras, now, rr_counter
     ) -> tuple[list[int], Optional[list[int]]]:
         """-> (destination picks, prefill picks or None). In pd mode a -1
         pick means the dual pick rejected the row (dropped by the caller);
@@ -391,13 +429,19 @@ class SimCluster:
         n = len(prompts)
         if policy == "round-robin":
             return [(rr_counter + i) % self.n for i in range(n)], None
-        if policy == "least-kv":
+        if policy in ("least-kv", "least-kv-assumed"):
             # The reference default scorer: per request, pick the endpoint
             # with the most free KV cache (queue-depth tie-break), reading
             # the latest scraped metrics — per-request greedy, no batch
-            # awareness (BASELINE configs[0]).
+            # awareness (BASELINE configs[0]). The "-assumed" variant is
+            # the ADVERSARIAL floor (VERDICT r3 #8): it additionally sees
+            # its own in-flight placements between scrapes (persistent
+            # per-pod counter, decremented on completion) — the strongest
+            # per-request greedy baseline the reference design supports.
             kv = self.store._metrics[: self.n, C.Metric.KV_CACHE_UTIL].copy()
             queue = self.store._metrics[: self.n, C.Metric.QUEUE_DEPTH].copy()
+            if policy == "least-kv-assumed":
+                queue = queue + self._baseline_inflight[: self.n]
             picks = []
             for _ in range(n):
                 score = (1.0 - kv) - 0.01 * queue
@@ -416,7 +460,7 @@ class SimCluster:
                 lora_id=jnp.asarray(lora_ids),
                 criticality=jnp.full((n,), C.Criticality.STANDARD, jnp.int32),
                 prompt_len=jnp.asarray([float(len(p)) for p in prompts]),
-                decode_len=jnp.asarray(np.asarray(decodes, np.float32)),
+                decode_len=jnp.asarray(np.asarray(decode_hints, np.float32)),
                 chunk_hashes=jnp.asarray(hashes),
                 n_chunks=jnp.asarray(counts),
                 subset_mask=jnp.ones((n, C.M_MAX), bool),

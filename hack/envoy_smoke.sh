@@ -1,0 +1,74 @@
+#!/usr/bin/env bash
+# Data-plane smoke: a real (unmodified) Envoy routes one HTTP request
+# through ext_proc -> this EPP -> a demo pod, and the response proves the
+# EPP's steering was honored (reference site-src/guides/
+# implementers.md:125-135; config/envoy/bootstrap.yaml for the wiring).
+#
+#   envoy --config config/envoy/bootstrap.yaml
+#        \__ ext_proc -> EPP :9002 (demo mode, --insecure-serving)
+#        \__ original_dst on x-gateway-destination-endpoint -> demo pod
+#
+# Skips cleanly (exit 0, "SKIP") when no envoy binary is on PATH — the CI
+# image has none; run it wherever Envoy is installed. Requires: bash,
+# curl, python3, and the repo at its root.
+set -u
+cd "$(dirname "$0")/.."
+
+ENVOY_BIN="${ENVOY_BIN:-$(command -v envoy || true)}"
+if [ -z "${ENVOY_BIN}" ]; then
+  echo "SKIP: no envoy binary on PATH (set ENVOY_BIN to override)"
+  exit 0
+fi
+
+LOGDIR="$(mktemp -d)"
+EPP_PID=""
+ENVOY_PID=""
+cleanup() {
+  [ -n "${ENVOY_PID}" ] && kill "${ENVOY_PID}" 2>/dev/null
+  [ -n "${EPP_PID}" ] && kill "${EPP_PID}" 2>/dev/null
+  echo "logs: ${LOGDIR}"
+}
+trap cleanup EXIT
+
+echo "== starting EPP (demo mode, CPU backend) =="
+python3 -c "import jax; jax.config.update('jax_platforms','cpu');
+import sys
+from gie_tpu.runtime.main import main
+sys.exit(main(['--demo','--demo-pods','3','--insecure-serving','--pool-name','demo-pool']))" \
+  >"${LOGDIR}/epp.log" 2>&1 &
+EPP_PID=$!
+
+for _ in $(seq 1 60); do
+  grep -q '"msg": "serving"' "${LOGDIR}/epp.log" 2>/dev/null && break
+  sleep 1
+done
+if ! grep -q '"msg": "serving"' "${LOGDIR}/epp.log"; then
+  echo "FAIL: EPP did not start"; tail -5 "${LOGDIR}/epp.log"; exit 1
+fi
+
+echo "== starting envoy =="
+"${ENVOY_BIN}" --config-path config/envoy/bootstrap.yaml \
+  --log-level warn >"${LOGDIR}/envoy.log" 2>&1 &
+ENVOY_PID=$!
+for _ in $(seq 1 30); do
+  curl -sf -o /dev/null http://127.0.0.1:9901/ready && break
+  sleep 1
+done
+
+echo "== driving one completion request through envoy =="
+RESP_HEADERS="${LOGDIR}/resp_headers.txt"
+BODY='{"model":"demo","prompt":"hello","max_tokens":16}'
+HTTP_CODE=$(curl -s -o "${LOGDIR}/resp_body.txt" -D "${RESP_HEADERS}" \
+  -w '%{http_code}' -X POST -H 'content-type: application/json' \
+  -d "${BODY}" http://127.0.0.1:8081/v1/completions)
+
+if [ "${HTTP_CODE}" != "200" ]; then
+  echo "FAIL: expected 200 through the data plane, got ${HTTP_CODE}"
+  tail -5 "${LOGDIR}/envoy.log"; exit 1
+fi
+SERVED=$(awk 'tolower($1)=="x-served-by:" {print $2}' "${RESP_HEADERS}" | tr -d '\r')
+if [ -z "${SERVED}" ]; then
+  echo "FAIL: response did not come from a demo pod (no X-Served-By)"
+  exit 1
+fi
+echo "PASS: request served by demo pod ${SERVED} via EPP steering"

@@ -1,0 +1,305 @@
+"""In-process HTTP kube-apiserver for adapter tests.
+
+Speaks just enough of the Kubernetes REST protocol to drive
+`controller/kube.py`'s REAL request/watch/resync code paths (VERDICT r3
+#5: the adapter had only ever seen duck-typed dicts):
+
+- GET list with a collection resourceVersion + `items`
+- GET single object (404 as a Status body)
+- chunked `?watch=1&resourceVersion=N` streams (one JSON event per line,
+  delivered live as objects change, closed after `timeoutSeconds`)
+- 410 Gone when the requested resourceVersion predates the retained
+  event window (`compact()` forces this — the relist path)
+- PATCH .../status (merge-patch recorded and applied)
+- coordination.k8s.io/v1 Lease GET/POST/PUT with resourceVersion
+  optimistic concurrency (409 on mismatch) — the leader-election
+  substrate (reference internal/runnable/leader_election.go uses the
+  same Lease semantics through client-go)
+
+Event-log model mirrors etcd: a single monotonically increasing
+resourceVersion, per-object rv stamped on every write, watches replay
+retained events after their rv then stream live.
+"""
+
+from __future__ import annotations
+
+import copy
+import http.server
+import json
+import threading
+
+
+class FakeKubeApiServer:
+    def __init__(self, retention: int = 1024, port: int = 0):
+        self._lock = threading.Condition()
+        self._rv = 0
+        # path key: ("pods"|"pools"|"services"|"leases", ns, name) -> dict
+        self._objects: dict[tuple[str, str, str], dict] = {}
+        # retained event log: (rv, resource, event-dict)
+        self._events: list[tuple[int, str, dict]] = []
+        self._oldest_rv = 0
+        self.retention = retention
+        self.status_patches: list[tuple[str, str, dict]] = []
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                outer._handle_get(self)
+
+            def do_PATCH(self):
+                outer._handle_patch(self)
+
+            def do_POST(self):
+                outer._handle_put_post(self, create=True)
+
+            def do_PUT(self):
+                outer._handle_put_post(self, create=False)
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                      Handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        # Release the listening socket too, so a test can rebind the port
+        # (shutdown() alone only stops serve_forever).
+        self._httpd.server_close()
+
+    # -- object mutation (test driver side) --------------------------------
+
+    def _bump(self, resource: str, ev_type: str, obj: dict) -> None:
+        """Caller holds the lock."""
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        self._events.append((self._rv, resource, {
+            "type": ev_type, "object": copy.deepcopy(obj)}))
+        if len(self._events) > self.retention:
+            self._events = self._events[-self.retention:]
+            self._oldest_rv = self._events[0][0] - 1
+        self._lock.notify_all()
+
+    def apply(self, resource: str, obj: dict) -> None:
+        """Create-or-update; emits ADDED/MODIFIED."""
+        meta = obj.setdefault("metadata", {})
+        key = (resource, meta.get("namespace", "default"),
+               meta.get("name", ""))
+        with self._lock:
+            ev = "MODIFIED" if key in self._objects else "ADDED"
+            self._objects[key] = obj
+            self._bump(resource, ev, obj)
+
+    def delete(self, resource: str, namespace: str, name: str) -> None:
+        key = (resource, namespace, name)
+        with self._lock:
+            obj = self._objects.pop(key, None)
+            if obj is not None:
+                self._bump(resource, "DELETED", obj)
+
+    def compact(self) -> None:
+        """Drop every retained event: the next watch from an old
+        resourceVersion gets 410 Gone and must relist."""
+        with self._lock:
+            self._events = []
+            self._oldest_rv = self._rv
+
+    # -- request routing ---------------------------------------------------
+
+    @staticmethod
+    def _route(path: str):
+        """-> (resource, namespace, name|None, subresource|None)."""
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        # /api/v1/namespaces/{ns}/{pods|services}[/name]
+        if parts[:2] == ["api", "v1"] and parts[2] == "namespaces":
+            ns, kind = parts[3], parts[4]
+            rest = parts[5:]
+        # /apis/{group}/{version}/namespaces/{ns}/{plural}[/name[/status]]
+        elif parts[0] == "apis" and parts[3] == "namespaces":
+            ns, kind = parts[4], parts[5]
+            rest = parts[6:]
+        else:
+            return None
+        resource = {"pods": "pods", "services": "services",
+                    "inferencepools": "pools", "leases": "leases"}.get(kind)
+        if resource is None:
+            return None
+        name = rest[0] if rest else None
+        sub = rest[1] if len(rest) > 1 else None
+        return resource, ns, name, sub
+
+    @staticmethod
+    def _query(path: str) -> dict:
+        if "?" not in path:
+            return {}
+        out = {}
+        for pair in path.split("?", 1)[1].split("&"):
+            k, _, v = pair.partition("=")
+            out[k] = v
+        return out
+
+    def _send_json(self, handler, code: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    def _send_404(self, handler) -> None:
+        self._send_json(handler, 404, {
+            "kind": "Status", "status": "Failure", "code": 404,
+            "reason": "NotFound"})
+
+    # -- GET: single / list / watch ---------------------------------------
+
+    def _handle_get(self, handler) -> None:
+        route = self._route(handler.path)
+        if route is None:
+            return self._send_404(handler)
+        resource, ns, name, _sub = route
+        q = self._query(handler.path)
+        if name is not None:
+            with self._lock:
+                obj = self._objects.get((resource, ns, name))
+            if obj is None:
+                return self._send_404(handler)
+            return self._send_json(handler, 200, obj)
+        if q.get("watch") in ("1", "true"):
+            return self._handle_watch(handler, resource, ns, q)
+        with self._lock:
+            items = [copy.deepcopy(o) for (r, n, _), o in
+                     self._objects.items() if r == resource and n == ns]
+            rv = self._rv
+        self._send_json(handler, 200, {
+            "kind": "List", "metadata": {"resourceVersion": str(rv)},
+            "items": items})
+
+    def _handle_watch(self, handler, resource, ns, q) -> None:
+        try:
+            since = int(q.get("resourceVersion", "0") or "0")
+        except ValueError:
+            since = 0
+        timeout_s = float(q.get("timeoutSeconds", "5") or "5")
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        def send_line(obj: dict) -> bool:
+            data = json.dumps(obj).encode() + b"\n"
+            try:
+                handler.wfile.write(
+                    f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                handler.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return False
+
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        sent_rv = since
+        with self._lock:
+            if since < self._oldest_rv:
+                # The requested window was compacted: 410 Gone.
+                send_line({"type": "ERROR", "object": {
+                    "kind": "Status", "code": 410,
+                    "message": "too old resource version"}})
+                try:
+                    handler.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+                return
+            while True:
+                pending = [
+                    ev for rv, res, ev in self._events
+                    if rv > sent_rv and res == resource
+                    and (ev["object"].get("metadata") or {}).get(
+                        "namespace", "default") == ns
+                ]
+                for ev in pending:
+                    if not send_line(ev):
+                        return
+                if self._events:
+                    sent_rv = max(sent_rv, self._events[-1][0])
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._lock.wait(min(remaining, 0.25))
+        try:
+            handler.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            pass
+
+    # -- PATCH: status subresource ----------------------------------------
+
+    def _handle_patch(self, handler) -> None:
+        route = self._route(handler.path)
+        if route is None:
+            return self._send_404(handler)
+        resource, ns, name, sub = route
+        n = int(handler.headers.get("Content-Length", 0) or 0)
+        patch = json.loads(handler.rfile.read(n) or b"{}")
+        with self._lock:
+            obj = self._objects.get((resource, ns, name))
+            if obj is None:
+                return self._send_404(handler)
+            if sub == "status":
+                self.status_patches.append((ns, name, patch))
+            # merge-patch: top-level keys replace.
+            for k, v in patch.items():
+                obj[k] = v
+            self._bump(resource, "MODIFIED", obj)
+            out = copy.deepcopy(obj)
+        self._send_json(handler, 200, out)
+
+    # -- POST/PUT: Lease create/update with optimistic concurrency ---------
+
+    def _handle_put_post(self, handler, create: bool) -> None:
+        route = self._route(handler.path)
+        if route is None:
+            return self._send_404(handler)
+        resource, ns, name, _sub = route
+        n = int(handler.headers.get("Content-Length", 0) or 0)
+        body = json.loads(handler.rfile.read(n) or b"{}")
+        meta = body.setdefault("metadata", {})
+        meta.setdefault("namespace", ns)
+        if name is not None:
+            meta.setdefault("name", name)
+        key = (resource, ns, meta.get("name", ""))
+        with self._lock:
+            existing = self._objects.get(key)
+            if create:
+                if existing is not None:
+                    return self._send_json(handler, 409, {
+                        "kind": "Status", "code": 409,
+                        "reason": "AlreadyExists"})
+                self._objects[key] = body
+                self._bump(resource, "ADDED", body)
+                out = copy.deepcopy(body)
+            else:
+                if existing is None:
+                    return self._send_404(handler)
+                sent_rv = meta.get("resourceVersion")
+                have_rv = (existing.get("metadata") or {}).get(
+                    "resourceVersion")
+                if sent_rv is not None and sent_rv != have_rv:
+                    # Optimistic-concurrency conflict: another writer won.
+                    return self._send_json(handler, 409, {
+                        "kind": "Status", "code": 409,
+                        "reason": "Conflict"})
+                self._objects[key] = body
+                self._bump(resource, "MODIFIED", body)
+                out = copy.deepcopy(body)
+        self._send_json(handler, 200 if not create else 201, out)

@@ -23,15 +23,17 @@ from tests.test_extproc import FakeStream, body_msg, headers_msg, make_ds
 
 def test_model_extractor_sets_header():
     chain = PluginChain([ModelExtractorPlugin()])
-    headers, mutated = chain.execute(json.dumps({"model": "llama-8b"}).encode())
+    headers, mutated, parsed = chain.execute(
+        json.dumps({"model": "llama-8b"}).encode())
     assert headers[MODEL_HEADER] == "llama-8b"
     assert mutated is None
+    assert parsed == {"model": "llama-8b"}  # shared parse rides along
 
 
 def test_chain_tolerates_non_json_body():
     chain = PluginChain([ModelExtractorPlugin()])
-    headers, mutated = chain.execute(b"\x00\x01 not json")
-    assert headers == {} and mutated is None
+    headers, mutated, parsed = chain.execute(b"\x00\x01 not json")
+    assert headers == {} and mutated is None and parsed is None
 
 
 def make_engine():
@@ -95,13 +97,14 @@ def test_rewrite_plugin_mutates_body_and_sets_headers():
         ModelExtractorPlugin(),
         ModelRewritePlugin(eng, pool="pool"),
     ])
-    headers, mutated = chain.execute(
+    headers, mutated, parsed = chain.execute(
         json.dumps({"model": "gpt-fast", "prompt": "hi"}).encode()
     )
     assert headers[MODEL_HEADER] == "llama-70b"
     assert headers[mdkeys.MODEL_NAME_REWRITE_KEY] == "llama-70b"
     assert json.loads(mutated)["model"] == "llama-70b"
     assert json.loads(mutated)["prompt"] == "hi"
+    assert parsed["model"] == "llama-70b"  # post-mutation view
 
 
 def test_bbr_through_extproc_server():

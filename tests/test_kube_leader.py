@@ -1,0 +1,177 @@
+"""Distributed (Lease-object) leader election against the fake apiserver.
+
+VERDICT r3 #6: the file elector is single-node; real multi-replica EPP
+deployments elect on a coordination.k8s.io Lease (reference
+internal/runnable/leader_election.go; readiness semantics 004
+README:111-115). These tests contend two electors through the REAL
+stdlib kube adapter against tests/fakeapi's Lease endpoints (optimistic
+concurrency included) and pin: single leader, failover on expiry (crash)
+and on graceful release, follower readiness, and the runner wiring.
+"""
+
+import time
+
+import pytest
+
+from gie_tpu.controller.kube import KubeClusterClient
+from gie_tpu.runtime.leader import KubeLeaseElector
+from tests.fakeapi import FakeKubeApiServer
+
+NS = "default"
+
+
+def _wait(predicate, timeout_s: float = 5.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture()
+def apiserver():
+    srv = FakeKubeApiServer()
+    yield srv
+    srv.close()
+
+
+def _elector(srv, ident, ttl=0.6, renew=0.08) -> KubeLeaseElector:
+    client = KubeClusterClient(NS, "pool", server=srv.url, token="t")
+    return KubeLeaseElector(
+        client, NS, "pool-epp-leader", identity=ident,
+        lease_ttl_s=ttl, renew_interval_s=renew)
+
+
+def _leaders(*electors) -> list[bool]:
+    return [e.is_leader() for e in electors]
+
+
+def test_exactly_one_leader_under_contention(apiserver):
+    a, b = _elector(apiserver, "a"), _elector(apiserver, "b")
+    a.start(), b.start()
+    try:
+        assert _wait(lambda: sum(_leaders(a, b)) == 1), "no leader elected"
+        # Stable over several renew cycles: never two leaders.
+        for _ in range(10):
+            time.sleep(0.08)
+            assert sum(_leaders(a, b)) <= 1, "split brain"
+        assert sum(_leaders(a, b)) == 1
+    finally:
+        a.stop(), b.stop()
+
+
+def test_failover_on_lease_expiry_after_crash(apiserver):
+    a, b = _elector(apiserver, "a"), _elector(apiserver, "b")
+    a.start()
+    assert _wait(lambda: a.is_leader())
+    b.start()
+    try:
+        time.sleep(0.2)
+        assert not b.is_leader(), "follower grabbed a live lease"
+        # Crash the leader: stop its renew loop WITHOUT the graceful
+        # release (stop() would blank the holder; a crash cannot).
+        a._stop.set()
+        a._thread.join(timeout=2)
+        assert _wait(lambda: b.is_leader(), timeout_s=3.0), (
+            "no takeover after the lease expired")
+    finally:
+        b.stop()
+
+
+def test_graceful_release_fails_over_fast(apiserver):
+    a = _elector(apiserver, "a", ttl=30.0)  # TTL too long to expire here
+    b = _elector(apiserver, "b", ttl=30.0)
+    a.start()
+    assert _wait(lambda: a.is_leader())
+    b.start()
+    try:
+        time.sleep(0.2)
+        a.stop()  # graceful: blanks holderIdentity
+        assert _wait(lambda: b.is_leader(), timeout_s=3.0), (
+            "released lease not claimed without waiting out the TTL")
+    finally:
+        b.stop()
+
+
+def test_unreachable_apiserver_grace_then_follower(apiserver):
+    """A transient apiserver outage must NOT blip readiness instantly:
+    the last written lease still blocks every other replica, so
+    leadership holds through the grace window — and then fails safe to
+    follower once the lease would have expired."""
+    a = _elector(apiserver, "a", ttl=0.8, renew=0.08)
+    a.start()
+    assert _wait(lambda: a.is_leader())
+    apiserver.close()
+    time.sleep(0.3)  # several failed renews, still inside the window
+    assert a.is_leader(), "one blip dropped leadership (no grace)"
+    assert _wait(lambda: not a.is_leader(), timeout_s=3.0), (
+        "leadership outlived the lease it could no longer renew")
+    a._stop.set()
+    a._thread.join(timeout=2)
+
+
+def test_skewed_record_timestamps_cannot_steal_a_live_lease(apiserver):
+    """Expiry is judged by local observation of record CHANGES, never by
+    comparing the record's wall-clock renewTime to ours: a live leader
+    whose clock is decades behind keeps its lease as long as it renews."""
+    lease_name = "pool-epp-leader"
+    seq = {"n": 0}
+
+    def foreign_renew():
+        # A "skewed leader": renewTime strings from 1970, but changing —
+        # the lease is live by observation.
+        seq["n"] += 1
+        apiserver.apply("leases", {
+            "metadata": {"name": lease_name, "namespace": NS},
+            "spec": {
+                "holderIdentity": "skewed-leader",
+                "leaseDurationSeconds": 1,
+                "renewTime": f"1970-01-01T00:00:{seq['n'] % 60:02d}.000000Z",
+            },
+        })
+
+    foreign_renew()
+    b = _elector(apiserver, "b", ttl=0.4, renew=0.05)
+    b.start()
+    try:
+        for _ in range(12):  # keep renewing while b watches
+            time.sleep(0.1)
+            foreign_renew()
+            assert not b.is_leader(), (
+                "takeover from a LIVE leader on wall-clock comparison")
+        # The skewed leader stops renewing: record sits unchanged ->
+        # locally-observed expiry -> legitimate takeover.
+        assert _wait(lambda: b.is_leader(), timeout_s=3.0)
+    finally:
+        b.stop()
+
+
+def test_runner_wires_kube_elector_and_gates_readiness(apiserver):
+    """An ExtProcServerRunner on a kube cluster client + --leader-elect
+    must elect over the Lease API and gate ready() on leadership."""
+    from gie_tpu.runtime.options import Options
+    from gie_tpu.runtime.runner import ExtProcServerRunner
+
+    client = KubeClusterClient(NS, "pool", server=apiserver.url, token="t")
+    opts = Options(pool_name="pool", leader_elect=True)
+    runner = ExtProcServerRunner(opts, client)
+    assert isinstance(runner.elector, KubeLeaseElector)
+    runner.elector.lease_ttl_s = 0.6
+    runner.elector.renew_interval_s = 0.08
+    runner.elector.start()
+    try:
+        assert _wait(lambda: runner.elector.is_leader())
+        # Datastore not synced yet -> not ready even as leader.
+        assert runner.ready() is False
+        # A second contender stays follower -> its runner would stay
+        # NOT_SERVING on readiness (004 README:111-115).
+        b = _elector(apiserver, "b")
+        b.start()
+        try:
+            time.sleep(0.25)
+            assert not b.is_leader()
+        finally:
+            b.stop()
+    finally:
+        runner.elector.stop()

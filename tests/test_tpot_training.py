@@ -1,0 +1,171 @@
+"""TPOT-head training from the response stream (VERDICT r3 #7).
+
+The TTFT half of the two-headed latency predictor trains at the
+response-headers hop; these tests pin the OTHER half: token counts
+harvested from the response body (SSE frame counting, usage-block parse,
+transcoded Generate frames), the response-complete hook feeding
+TPOT-masked observations, and the trained TPOT column steering the
+pd decode pick.
+"""
+
+import json
+
+import numpy as np
+
+from gie_tpu.extproc import RoundRobinPicker, StreamingServer, pb
+from gie_tpu.extproc.server import RequestContext
+from gie_tpu.models.latency import (
+    NUM_FEATURES,
+    LatencyPredictor,
+    OnlineTrainer,
+)
+from tests.test_extproc import FakeStream, headers_msg, make_ds
+
+
+def _resp_body_msg(data: bytes, end: bool = False) -> pb.ProcessingRequest:
+    return pb.ProcessingRequest(
+        response_body=pb.HttpBody(body=data, end_of_stream=end)
+    )
+
+
+def _server(**kw) -> StreamingServer:
+    return StreamingServer(make_ds(), RoundRobinPicker(), **kw)
+
+
+def test_sse_frame_counting_with_split_marker():
+    srv = _server()
+    ctx = RequestContext()
+    # 3 data frames, one marker split across the chunk boundary.
+    srv._count_plain_tokens(ctx, b'data: {"c":1}\n\nda')
+    srv._count_plain_tokens(ctx, b'ta: {"c":2}\n\ndata: {"c":3}\n\n')
+    srv._finish_token_count(ctx)
+    assert ctx.resp_tokens == 3
+
+
+def test_done_sentinel_not_counted():
+    srv = _server()
+    ctx = RequestContext()
+    srv._count_plain_tokens(ctx, b'data: {"c":1}\n\ndata: {"c":2}\n\n')
+    srv._count_plain_tokens(ctx, b"data: [DONE]\n\n")
+    srv._finish_token_count(ctx)
+    assert ctx.resp_tokens == 2
+
+
+def test_usage_block_overrides_frame_count():
+    srv = _server()
+    ctx = RequestContext()
+    body = json.dumps(
+        {"choices": [{"text": "hi"}],
+         "usage": {"prompt_tokens": 5, "completion_tokens": 42}}
+    ).encode()
+    srv._count_plain_tokens(ctx, body)
+    srv._finish_token_count(ctx)
+    assert ctx.resp_tokens == 42
+
+
+def test_response_complete_hook_fires_with_timing():
+    seen = {}
+    srv = _server(on_response_complete=lambda ctx: seen.update(
+        tokens=ctx.resp_tokens, t0=ctx.resp_first_at, t1=ctx.resp_last_at))
+    stream = FakeStream([
+        headers_msg(end_of_stream=True),
+        _resp_body_msg(b'data: {"c":1}\n\n'),
+        _resp_body_msg(b'data: {"c":2}\n\n'),
+        _resp_body_msg(b'data: {"c":3}\n\n', end=True),
+    ])
+    srv.process(stream)
+    assert seen["tokens"] == 3
+    assert seen["t1"] >= seen["t0"] > 0
+
+
+def test_observe_response_complete_trains_tpot_head():
+    """End to end through the picker: the hook must deposit a TPOT-masked
+    observation whose weight vector trains ONLY the second head."""
+    from types import SimpleNamespace
+
+    from tests.test_batching_robustness import _stack
+
+    trainer = OnlineTrainer(LatencyPredictor(), batch_size=8)
+    sched, ds, ms, picker = _stack(n_pods=2)
+    picker.trainer = trainer
+    try:
+        feats = np.zeros((NUM_FEATURES,), np.float32)
+        ctx = SimpleNamespace(
+            pick_result=SimpleNamespace(
+                feedback=(feats, 1, 0.0, "10.9.0.2:8000")),
+            served_hostport="10.9.0.2:8000",
+            resp_tokens=11,
+            resp_first_at=10.0,
+            resp_last_at=10.5,   # 0.5 s over 10 intervals -> 50 ms/token
+        )
+        picker.observe_response_complete(ctx)
+        assert trainer._n == 1
+        np.testing.assert_allclose(trainer._targets[0], [0.0, 0.05])
+        np.testing.assert_allclose(trainer._weights[0], [0.0, 1.0])
+
+        # Failover guard: stream served by a different endpoint -> skip.
+        ctx.served_hostport = "10.9.0.1:8000"
+        picker.observe_response_complete(ctx)
+        assert trainer._n == 1
+        # Single-chunk response -> no interval -> skip.
+        ctx.served_hostport = "10.9.0.2:8000"
+        ctx.resp_tokens = 1
+        picker.observe_response_complete(ctx)
+        assert trainer._n == 1
+    finally:
+        picker.close()
+
+
+def test_trained_tpot_column_steers_pd_decode_pick():
+    """BASELINE configs[3] + pd: train the TPOT head so slot 0 is the
+    fast decoder, then the pd decode pick must prefer it for long-decode
+    requests (the latency column is live in the decode blend; prefix/
+    session are the only columns dropped there)."""
+    import functools
+
+    import jax
+
+    from gie_tpu.sched import constants as C
+    from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
+    from gie_tpu.sched.types import SchedState, Weights
+    from gie_tpu.models.latency import predictor_score_fn
+    from gie_tpu.utils.testing import make_endpoints, make_requests
+
+    predictor = LatencyPredictor()
+    trainer = OnlineTrainer(predictor, batch_size=64)
+    feats = np.zeros((NUM_FEATURES,), np.float32)
+    # Identical metrics everywhere: only the slot embedding can learn the
+    # difference. Slot 0 decodes at 10 ms/token, slot 1 at 200 ms/token.
+    rng = np.random.default_rng(0)
+    for _ in range(256):
+        trainer.observe(feats, ttft_s=0.1,
+                        tpot_s=0.01 + rng.normal(0, 1e-4), slot=0)
+        trainer.observe(feats, ttft_s=0.1,
+                        tpot_s=0.20 + rng.normal(0, 1e-4), slot=1)
+    for _ in range(60):
+        trainer.train(steps=5)
+    pred = np.asarray(predictor.predict(
+        trainer.params,
+        np.stack([feats, feats]),
+        np.asarray([0, 1], np.int32),
+    ))
+    assert pred[0, 1] < pred[1, 1], "TPOT head failed to separate slots"
+
+    cfg = ProfileConfig(pd_disaggregation=True, enable_prefix=False,
+                        enable_session=False)
+    fn = jax.jit(functools.partial(
+        scheduling_cycle, cfg=cfg,
+        predictor_fn=predictor_score_fn(predictor)))
+    eps = make_endpoints(
+        4, queue=[0, 0, 0, 0], kv=[0.1, 0.1, 0.1, 0.1],
+        role=[int(C.Role.DECODE), int(C.Role.DECODE),
+              int(C.Role.PREFILL), int(C.Role.PREFILL)],
+        m_slots=64)
+    reqs = make_requests(8, prompt_len=[2048.0] * 8, m_slots=64)
+    reqs = reqs.replace(decode_len=np.full((8,), 4096.0, np.float32))
+    weights = Weights.default().replace(latency=np.float32(2.0))
+    res, _ = fn(SchedState.init(m=64), reqs, eps, weights,
+                jax.random.PRNGKey(0), trainer.params)
+    decode_picks = np.asarray(res.indices[:, 0])
+    assert (decode_picks == 0).all(), (
+        f"decode pick ignored the live TPOT column: {decode_picks}")
