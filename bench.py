@@ -302,6 +302,15 @@ def main() -> None:
         lora_id=(rng.integers(-1, 12, n)).tolist(),
         m_slots=m,
     )
+    # Chunk-axis bucket, exactly as the live batching layer sizes it
+    # (sched/batching.py): prefix lanes cover the longest prompt, not
+    # MAX_CHUNKS.
+    from gie_tpu.sched.types import chunk_bucket_for
+
+    cb = chunk_bucket_for(int(np.asarray(reqs.n_chunks).max()))
+    reqs = reqs.replace(chunk_hashes=reqs.chunk_hashes[:, :cb])
+    _log(f"chunk bucket: {cb} lanes "
+         f"(max prompt chunks {int(np.asarray(reqs.n_chunks).max())})")
     cfg = ProfileConfig()
     cycle = functools.partial(scheduling_cycle, cfg=cfg, predictor_fn=None)
 

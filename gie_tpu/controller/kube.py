@@ -124,23 +124,25 @@ class KubeClusterClient:
         self.request_timeout_s = request_timeout_s
         self.watch_timeout_s = watch_timeout_s
         self.backoff_s = backoff_s
+        ca_data: Optional[str] = None
         if server is None:
             if kubeconfig:
-                server, token, ca_cert, client_cert, insecure_skip_verify = \
-                    _load_kubeconfig(kubeconfig)
+                (server, token, ca_cert, ca_data, client_cert,
+                 insecure_skip_verify) = _load_kubeconfig(kubeconfig)
             else:
                 server, token, ca_cert = _load_incluster()
         self._server = server.rstrip("/")
         self._token = token
-        self._ssl = self._make_ssl(ca_cert, client_cert,
+        self._ssl = self._make_ssl(ca_cert, ca_data, client_cert,
                                    insecure_skip_verify)
         self._subscribers: list[Callable[[WatchEvent], None]] = []
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
     @staticmethod
-    def _make_ssl(ca_cert, client_cert, insecure) -> Optional[ssl.SSLContext]:
-        ctx = ssl.create_default_context(cafile=ca_cert)
+    def _make_ssl(ca_cert, ca_data, client_cert,
+                  insecure) -> Optional[ssl.SSLContext]:
+        ctx = ssl.create_default_context(cafile=ca_cert, cadata=ca_data)
         if insecure:
             ctx.check_hostname = False
             ctx.verify_mode = ssl.CERT_NONE
@@ -360,9 +362,19 @@ def _load_incluster() -> tuple[str, Optional[str], Optional[str]]:
 
 
 def _load_kubeconfig(path: str):
-    """Minimal kubeconfig reader: current-context -> (server, token, CA,
-    client cert/key pair, skip-verify). Certificate *data* fields are not
-    materialized to disk — point the kubeconfig at files instead."""
+    """Minimal kubeconfig reader: current-context -> (server, token,
+    CA file, CA PEM data, client cert/key pair, skip-verify).
+
+    Handles BOTH kubeconfig shapes: file references
+    (certificate-authority / client-certificate / client-key) and the
+    inline base64 `*-data` fields kind/minikube/GKE emit. CA data stays
+    in memory (ssl cadata=); client cert/key data must become files for
+    load_cert_chain, so they are materialized 0600 in a private 0700
+    tempdir. Exec/auth-provider plugins are out of scope and raise a
+    clear error rather than silently failing every request."""
+    import base64
+    import tempfile
+
     try:
         import yaml
     except ImportError as e:  # pragma: no cover - env without pyyaml
@@ -389,13 +401,43 @@ def _load_kubeconfig(path: str):
     if not server:
         raise RuntimeError(
             f"kubeconfig {path}: current-context names no cluster server")
+
+    ca_data = None
+    if cluster.get("certificate-authority-data"):
+        ca_data = base64.b64decode(
+            cluster["certificate-authority-data"]).decode()
+
     client_cert = None
     if user.get("client-certificate") and user.get("client-key"):
         client_cert = (user["client-certificate"], user["client-key"])
+    elif (user.get("client-certificate-data")
+          and user.get("client-key-data")):
+        d = tempfile.mkdtemp(prefix="gie-kubeconfig-", dir=None)
+        os.chmod(d, 0o700)
+        paths = []
+        for fname, b64 in (("client.crt", user["client-certificate-data"]),
+                           ("client.key", user["client-key-data"])):
+            p = os.path.join(d, fname)
+            fd = os.open(p, os.O_CREAT | os.O_WRONLY | os.O_EXCL, 0o600)
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(base64.b64decode(b64))
+            paths.append(p)
+        client_cert = (paths[0], paths[1])
+
+    token = user.get("token")
+    if token is None and client_cert is None and (
+            user.get("exec") or user.get("auth-provider")):
+        raise RuntimeError(
+            f"kubeconfig {path}: user {ctx.get('user', '')!r} authenticates "
+            "via an exec/auth-provider plugin, which this stdlib adapter "
+            "does not run — use a token or client-certificate credential, "
+            "or pass server=/token= explicitly")
+
     return (
         server,
-        user.get("token"),
+        token,
         cluster.get("certificate-authority"),
+        ca_data,
         client_cert,
         bool(cluster.get("insecure-skip-tls-verify", False)),
     )

@@ -184,6 +184,10 @@ class RequestContext:
     sse_carry: bytes = b""   # split-"data:" guard across chunk boundaries
     resp_tail: bytes = b""   # last bytes kept for the usage-block parse
     last_frame: Optional[bytes] = None  # last decoded Generate frame
+    # True when the response chunk timing reflects GENERATION cadence
+    # (transcoded Generate frames, or >=2 SSE data frames) — a buffered
+    # JSON body split across network flushes must never train TPOT.
+    timing_is_generation: bool = False
 
 
 class Stream(Protocol):
@@ -593,6 +597,14 @@ class StreamingServer:
         frame count (minus the [DONE] sentinel) remains the floor."""
         if ctx.resp_tokens and b"data: [DONE]" in ctx.resp_tail:
             ctx.resp_tokens -= 1
+        # Timing provenance BEFORE any authoritative-count override: the
+        # transcoded path's chunks are upstream Generate frames (real
+        # generation cadence, streamed or buffered mode alike); the plain
+        # path's timing only means generation when the body actually was
+        # an SSE stream (>=2 data frames).
+        ctx.timing_is_generation = (
+            ctx.transcoding or ctx.resp_tokens >= 2
+        )
         if ctx.transcoding and ctx.last_frame is not None:
             from gie_tpu.extproc.pb import generate_pb2
 

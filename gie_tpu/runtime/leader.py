@@ -44,26 +44,14 @@ _LEASES = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
 
 
 def _microtime(t: Optional[float] = None) -> str:
-    """metav1.MicroTime wire format."""
+    """metav1.MicroTime wire format. (Written, never parsed: expiry is
+    judged by locally-observed record CHANGES, not by wall-clock
+    comparison — see KubeLeaseElector.)"""
     return (
         datetime.datetime.fromtimestamp(
             time.time() if t is None else t, datetime.timezone.utc)
         .strftime("%Y-%m-%dT%H:%M:%S.%fZ")
     )
-
-
-def _parse_microtime(s: str) -> float:
-    try:
-        return datetime.datetime.strptime(
-            s, "%Y-%m-%dT%H:%M:%S.%fZ"
-        ).replace(tzinfo=datetime.timezone.utc).timestamp()
-    except (TypeError, ValueError):
-        try:
-            return datetime.datetime.strptime(
-                s, "%Y-%m-%dT%H:%M:%SZ"
-            ).replace(tzinfo=datetime.timezone.utc).timestamp()
-        except (TypeError, ValueError):
-            return 0.0
 
 
 class KubeLeaseElector:

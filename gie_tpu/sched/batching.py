@@ -33,7 +33,7 @@ from gie_tpu.sched import constants as C
 from gie_tpu.sched.hashing import batch_chunk_hashes
 from gie_tpu.models.latency import host_features
 from gie_tpu.sched.profile import Scheduler, pd_costs_host, request_cost_host
-from gie_tpu.sched.types import RequestBatch, m_bucket_for
+from gie_tpu.sched.types import RequestBatch, chunk_bucket_for, m_bucket_for
 from gie_tpu.utils.lora import LoraRegistry
 
 import jax.numpy as jnp
@@ -322,6 +322,12 @@ class BatchingTPUPicker:
             # stream timing describes the fallback. Skip (same rule as
             # the TTFT hop).
             return
+        if not getattr(ctx, "timing_is_generation", False):
+            # Buffered JSON split across network flushes: chunk spacing
+            # measures the proxy's write cadence, not token generation —
+            # a 500-token body flushed in 2 ms would teach the TPOT head
+            # ~4 us/token and poison every later prediction.
+            return
         tokens = int(getattr(ctx, "resp_tokens", 0))
         t0 = getattr(ctx, "resp_first_at", 0.0)
         t1 = getattr(ctx, "resp_last_at", 0.0)
@@ -479,6 +485,11 @@ class BatchingTPUPicker:
         mb = self._pick_m_bucket(endpoints)
         prompts = [it.req.body or b"" for it in batch]
         hashes, counts = batch_chunk_hashes(prompts)
+        # Chunk-axis bucket: short-prompt waves run 8/16 prefix lanes per
+        # request instead of MAX_CHUNKS (the cycle is shape-polymorphic
+        # in C; lanes beyond a request's n_chunks were masked anyway).
+        cb = chunk_bucket_for(int(counts.max()) if n else 1)
+        hashes = hashes[:, :cb]
         lora = np.full((n,), -1, np.int32)
         crit = np.full((n,), C.Criticality.STANDARD, np.int32)
         plen = np.zeros((n,), np.float32)

@@ -68,6 +68,13 @@ CHARS_PER_TOKEN = 4.0
 # depth, reference docs/proposals/0602-prefix-cache/README.md:95-112).
 MAX_CHUNKS = 32
 
+# Chunk-axis buckets: a wave's chunk_hashes are sliced to the smallest
+# bucket covering its longest prompt's chunk count (the cycle is
+# shape-polymorphic in C). Short-prompt waves — chat traffic is a few
+# hundred bytes of shared system prefix — then run 8 prefix lanes per
+# request instead of 32, quartering the match gather and insert scatter.
+C_BUCKETS = (8, 16, MAX_CHUNKS)
+
 # Default character-chunk size for the rolling hash. The reference leaves the
 # chunk size to plugin config ("prefix plugin config",
 # docs/proposals/003-model-server-protocol/README.md:33); 64 chars balances

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.types import PrefixTable, RequestBatch
@@ -63,8 +64,9 @@ def match_scores(
     (m = the table's packed endpoint width, an M bucket)."""
     slots = _slots(reqs.chunk_hashes, table.keys.shape[0])     # i32[N, C]
     keys = table.keys[slots]                                   # u32[N, C]
+    cmax = reqs.chunk_hashes.shape[1]  # a C bucket, <= MAX_CHUNKS
     chunk_valid = (
-        jnp.arange(C.MAX_CHUNKS, dtype=jnp.int32)[None, :] < reqs.n_chunks[:, None]
+        jnp.arange(cmax, dtype=jnp.int32)[None, :] < reqs.n_chunks[:, None]
     )
     fresh = (tick - table.ages[slots]) <= jnp.uint32(max_age)  # [N, C]
     hit = (keys == reqs.chunk_hashes) & (reqs.chunk_hashes != 0) & chunk_valid & fresh
@@ -72,22 +74,38 @@ def match_scores(
     words = table.present[slots]                               # u32[N, C, W]
     words = words * hit[..., None].astype(jnp.uint32)
 
-    # Longest-prefix property: a chunk only counts if every earlier chunk
-    # also matched on that endpoint (reference 0602 README:107-112) —
-    # cumulative AND along the chunk axis, on packed words.
-    run = jax.lax.associative_scan(jnp.bitwise_and, words, axis=1)
-    # Bit-plane depth count: sum the unpacked bits over the chunk axis
-    # BEFORE flattening (word, bit) -> endpoint. The [N, C, W, 32] bit
-    # tensor then fuses straight into the reduction (nothing bigger than
-    # [N, W, 32] materializes); reshaping first would force XLA to write
-    # the full [N, C, M] unpack (64 MiB at 1024x32x512) to HBM.
+    # Longest-prefix property (a chunk only counts if every earlier chunk
+    # also matched, reference 0602 README:107-112) + per-endpoint depth
+    # count, in ONE sequential sweep over the chunk axis:
+    #
+    #   acc    [N, W] u32  running cumulative-AND of the packed words
+    #   planes [N, W] u32  x PLANES bit-sliced vertical counters — plane k
+    #                      holds bit k of every endpoint's running depth
+    #                      (max C=32 fits in 6 bits); adding acc is a
+    #                      ripple-carry of XOR/AND on whole words.
+    #
+    # Everything is elementwise on ~32 KiB operands, so XLA fuses the
+    # entire sweep into one pass that reads `words` (1 MiB) once. The
+    # alternatives both blow HBM: lax.associative_scan materializes
+    # log2(C) full [N, C, W] passes (~10+ MiB), and a naive
+    # unpack-then-reduce materializes the [N, C, W, 32] bit tensor
+    # (32 MiB at the 1024x32x256 north-star shape — ~60% of the whole
+    # cycle's traffic).
+    n_planes = max(cmax.bit_length(), 1)  # depth <= cmax fits these bits
+    acc = jnp.full_like(words[:, 0, :], jnp.uint32(0xFFFFFFFF))
+    planes = [jnp.zeros_like(acc) for _ in range(n_planes)]
+    for c in range(words.shape[1]):
+        acc = acc & words[:, c, :]
+        carry = acc
+        for k in range(n_planes):
+            planes[k], carry = planes[k] ^ carry, planes[k] & carry
+    # Unpack the PLANES small planes (never the [N, C, W] words).
     shifts = jnp.arange(32, dtype=jnp.uint32)
-    bits = (run[..., None] >> shifts) & jnp.uint32(1)          # [N, C, W, 32]
-    matched = (
-        jnp.sum(bits.astype(jnp.int32), axis=1)                # [N, W, 32]
-        .reshape(run.shape[0], -1)                             # [N, M]
-        .astype(jnp.float32)
-    )
+    matched = sum(
+        ((p[..., None] >> shifts) & jnp.uint32(1)).astype(jnp.float32)
+        * np.float32(1 << k)
+        for k, p in enumerate(planes)
+    ).reshape(words.shape[0], -1)                              # [N, M]
     denom = jnp.maximum(reqs.n_chunks.astype(jnp.float32), 1.0)
     return matched / denom[:, None]
 

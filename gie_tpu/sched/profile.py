@@ -498,7 +498,8 @@ class Scheduler:
             )
             self._min_bucket = 1
         self.mesh = mesh
-        self._warm_buckets: set[tuple[int, int]] = set()  # (n_bucket, m)
+        # Compiled-shape warm cache: (n_bucket, m, chunk_lanes).
+        self._warm_buckets: set[tuple[int, int, int]] = set()
         self._warm_lock = threading.Lock()
 
     def _warm(self, reqs: RequestBatch, eps: EndpointBatch) -> None:
@@ -531,7 +532,10 @@ class Scheduler:
             raise ValueError(
                 f"subset_mask width {reqs.subset_mask.shape[1]} != "
                 f"endpoint width {m}")
-        warm_key = (bucket, m)
+        # The chunk-axis width is a compiled shape too (C_BUCKETS): a wave
+        # with a longer prompt mix must warm its own executable, or the
+        # first long wave jit-compiles inside the state lock.
+        warm_key = (bucket, m, int(reqs.chunk_hashes.shape[1]))
         if warm_key not in self._warm_buckets:
             with self._warm_lock:
                 if warm_key not in self._warm_buckets:

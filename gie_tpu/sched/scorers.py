@@ -87,7 +87,7 @@ def session_affinity_score(
     """
     depth = jnp.clip(
         jnp.minimum(jnp.int32(key_chunks), reqs.n_chunks) - 1,
-        0, C.MAX_CHUNKS - 1,
+        0, reqs.chunk_hashes.shape[1] - 1,
     )                                                       # i32[N]
     key = jnp.take_along_axis(
         reqs.chunk_hashes, depth[:, None], axis=1

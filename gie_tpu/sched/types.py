@@ -232,6 +232,14 @@ def bucket_for(n: int) -> int:
     raise ValueError(f"batch of {n} exceeds max bucket {C.N_BUCKETS[-1]}")
 
 
+def chunk_bucket_for(count: int) -> int:
+    """Smallest chunk-axis bucket covering `count` chunk lanes."""
+    for b in C.C_BUCKETS:
+        if count <= b:
+            return b
+    return C.MAX_CHUNKS
+
+
 def m_bucket_for(count: int) -> int:
     """Smallest endpoint-axis bucket covering `count` slots (the HIGH-WATER
     slot index + 1, not the live count — slot ids must stay addressable)."""

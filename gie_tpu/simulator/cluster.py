@@ -304,9 +304,11 @@ class SimCluster:
                     # Release-what-was-charged: the cycle charged from the
                     # HINT (the only signal it had); completion must
                     # release the same amount, not one recomputed from the
-                    # true generated length.
-                    charge_log[(pod, rid)] = request_cost_host(
-                        float(len(prompt)), hint)
+                    # true generated length. Only the tpu policy charges
+                    # (and pops) — logging for baselines would just leak.
+                    if policy == "tpu" and scheduler is not None:
+                        charge_log[(pod, rid)] = request_cost_host(
+                            float(len(prompt)), hint)
                     if trainer is not None:
                         feature_log[(pod, rid)] = (
                             precomputed_rows[i]
@@ -451,7 +453,10 @@ class SimCluster:
                 queue[p] += 1.0
             return picks, None
         if policy == "tpu":
+            from gie_tpu.sched.types import chunk_bucket_for
+
             hashes, counts = batch_chunk_hashes(prompts)
+            hashes = hashes[:, :chunk_bucket_for(int(max(counts.max(), 1)))]
             lora_ids = np.asarray(
                 [self.lora_reg.id_for(x) if x else -1 for x in loras], np.int32
             )

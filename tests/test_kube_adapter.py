@@ -228,3 +228,67 @@ def test_status_controller_preserves_export_entry():
     assert "InferencePoolImport" in kinds
     names = [p.parentRef.name for p in captured["status"].parents]
     assert "gw" in names
+
+
+def test_kubeconfig_inline_data_fields(tmp_path):
+    """kind/minikube/GKE kubeconfigs embed base64 *-data instead of file
+    paths; the adapter must honor them (CA in memory, client pair
+    materialized 0600)."""
+    import base64
+    import os
+    import stat
+
+    import yaml
+
+    from gie_tpu.controller.kube import _load_kubeconfig
+
+    ca_pem = (
+        "-----BEGIN CERTIFICATE-----\nZmFrZQ==\n-----END CERTIFICATE-----\n")
+    cfg = {
+        "current-context": "c",
+        "contexts": [{"name": "c",
+                      "context": {"cluster": "cl", "user": "u"}}],
+        "clusters": [{"name": "cl", "cluster": {
+            "server": "https://1.2.3.4:6443",
+            "certificate-authority-data":
+                base64.b64encode(ca_pem.encode()).decode(),
+        }}],
+        "users": [{"name": "u", "user": {
+            "client-certificate-data":
+                base64.b64encode(b"CERTPEM").decode(),
+            "client-key-data": base64.b64encode(b"KEYPEM").decode(),
+        }}],
+    }
+    p = tmp_path / "kubeconfig"
+    p.write_text(yaml.safe_dump(cfg))
+    (server, token, ca_file, ca_data, client_cert,
+     insecure) = _load_kubeconfig(str(p))
+    assert server == "https://1.2.3.4:6443"
+    assert token is None and ca_file is None and insecure is False
+    assert ca_data == ca_pem
+    crt, key = client_cert
+    assert open(crt, "rb").read() == b"CERTPEM"
+    assert open(key, "rb").read() == b"KEYPEM"
+    for f in (crt, key):
+        assert stat.S_IMODE(os.stat(f).st_mode) == 0o600
+    assert stat.S_IMODE(os.stat(os.path.dirname(crt)).st_mode) == 0o700
+
+
+def test_kubeconfig_exec_plugin_is_a_clear_error(tmp_path):
+    import yaml
+
+    from gie_tpu.controller.kube import _load_kubeconfig
+
+    cfg = {
+        "current-context": "c",
+        "contexts": [{"name": "c",
+                      "context": {"cluster": "cl", "user": "u"}}],
+        "clusters": [{"name": "cl",
+                      "cluster": {"server": "https://1.2.3.4:6443"}}],
+        "users": [{"name": "u", "user": {
+            "exec": {"command": "gke-gcloud-auth-plugin"}}}],
+    }
+    p = tmp_path / "kubeconfig"
+    p.write_text(yaml.safe_dump(cfg))
+    with pytest.raises(RuntimeError, match="exec/auth-provider"):
+        _load_kubeconfig(str(p))

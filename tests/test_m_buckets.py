@@ -193,3 +193,44 @@ def test_event_ingest_grows_state():
     present = np.asarray(sched.state.prefix.present)
     word, bit = 80 // 32, np.uint32(1) << (80 % 32)
     assert (present[:, word] & bit).any()
+
+
+def test_chunk_bucket_equivalence():
+    """Slicing the chunk axis to a bucket covering every request's
+    n_chunks must not change any pick: the dropped lanes were masked."""
+    from gie_tpu.sched.types import chunk_bucket_for
+
+    rng = np.random.default_rng(3)
+    eps = make_endpoints(
+        8, queue=rng.integers(0, 9, 8).tolist(),
+        kv=rng.uniform(0, 0.5, 8).tolist(), m_slots=64)
+    prompts = [b"SYS %d " % (i % 4) * 6 + b"u%d" % i for i in range(16)]
+    reqs = make_requests(16, prompts=prompts, m_slots=64)
+    cmax = int(np.asarray(reqs.n_chunks).max())
+    cb = chunk_bucket_for(cmax)
+    assert cb < C.MAX_CHUNKS, "fixture prompts should fit a small bucket"
+    sliced = reqs.replace(chunk_hashes=reqs.chunk_hashes[:, :cb])
+
+    key = jax.random.PRNGKey(0)
+    results = []
+    for r in (reqs, sliced):
+        st = SchedState.init(m=64)
+        res, st2 = _cycle()(st, r, eps, Weights.default(), key, None)
+        results.append((np.asarray(res.indices),
+                        np.asarray(st2.assumed_load),
+                        np.asarray(st2.prefix.keys)))
+    np.testing.assert_array_equal(results[0][0], results[1][0])
+    np.testing.assert_allclose(results[0][1], results[1][1])
+    # The table state is identical too: lanes beyond n_chunks never
+    # inserted anything even at full width.
+    np.testing.assert_array_equal(results[0][2], results[1][2])
+
+
+def test_chunk_bucket_for():
+    from gie_tpu.sched.types import chunk_bucket_for
+
+    assert chunk_bucket_for(0) == C.C_BUCKETS[0]
+    assert chunk_bucket_for(8) == 8
+    assert chunk_bucket_for(9) == 16
+    assert chunk_bucket_for(32) == C.MAX_CHUNKS
+    assert chunk_bucket_for(99) == C.MAX_CHUNKS  # capped upstream

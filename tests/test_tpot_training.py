@@ -61,6 +61,16 @@ def test_usage_block_overrides_frame_count():
     srv._count_plain_tokens(ctx, body)
     srv._finish_token_count(ctx)
     assert ctx.resp_tokens == 42
+    # A buffered JSON body is NOT generation-cadenced chunking.
+    assert ctx.timing_is_generation is False
+
+
+def test_sse_stream_marks_generation_timing():
+    srv = _server()
+    ctx = RequestContext()
+    srv._count_plain_tokens(ctx, b'data: {"c":1}\n\ndata: {"c":2}\n\n')
+    srv._finish_token_count(ctx)
+    assert ctx.timing_is_generation is True
 
 
 def test_response_complete_hook_fires_with_timing():
@@ -97,6 +107,7 @@ def test_observe_response_complete_trains_tpot_head():
             resp_tokens=11,
             resp_first_at=10.0,
             resp_last_at=10.5,   # 0.5 s over 10 intervals -> 50 ms/token
+            timing_is_generation=True,
         )
         picker.observe_response_complete(ctx)
         assert trainer._n == 1
@@ -110,6 +121,12 @@ def test_observe_response_complete_trains_tpot_head():
         # Single-chunk response -> no interval -> skip.
         ctx.served_hostport = "10.9.0.2:8000"
         ctx.resp_tokens = 1
+        picker.observe_response_complete(ctx)
+        assert trainer._n == 1
+        # Buffered JSON split across flushes: usage says 500 tokens but
+        # the chunk spacing is network cadence -> must NOT train TPOT.
+        ctx.resp_tokens = 500
+        ctx.timing_is_generation = False
         picker.observe_response_complete(ctx)
         assert trainer._n == 1
     finally:
