@@ -1,0 +1,55 @@
+"""Shared HBM-traffic measurement for the compiled scheduling cycle.
+
+One workload recipe, two consumers: `hack/cost_analysis.py` (the
+developer-facing report) and `tests/test_cost_budget.py` (the CI gate) —
+the gate's ceilings were calibrated against this exact fixture, so the
+two must never drift apart.
+
+The <=50 us pick-latency target (BASELINE.md) is an HBM-bandwidth budget
+in disguise: one v5e moves ~819 GB/s, so bytes-accessed of the compiled
+HLO is the first-order latency model for this memory-bound program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+
+def cycle_cost(cfg, n: int = 1024, m: int = 256) -> dict[str, float]:
+    """-> {"flops": F, "bytes": B} of the jitted scheduling cycle on the
+    north-star workload (shared system prompts, mixed LoRA ids, bucketed
+    chunk axis — the same shaping the batching layer produces live).
+    Raises if the backend's cost analysis stops reporting either metric:
+    a silently-absent metric would turn the CI gate vacuous."""
+    from gie_tpu.sched.profile import scheduling_cycle
+    from gie_tpu.sched.types import SchedState, Weights, chunk_bucket_for
+    from gie_tpu.utils.testing import make_endpoints, make_requests
+
+    rng = np.random.default_rng(0)
+    eps = make_endpoints(
+        m, queue=rng.integers(0, 50, m).tolist(),
+        kv=rng.uniform(0, 0.95, m).tolist(), max_lora=8, m_slots=m)
+    base = b"SYSTEM: task %d. "
+    prompts = [(base % (i % 16)) * 6 + b"u%d" % i for i in range(n)]
+    reqs = make_requests(
+        n, prompts=prompts, lora_id=rng.integers(-1, 12, n).tolist(),
+        m_slots=m)
+    cb = chunk_bucket_for(int(np.asarray(reqs.n_chunks).max()))
+    reqs = reqs.replace(chunk_hashes=reqs.chunk_hashes[:, :cb])
+    fn = jax.jit(functools.partial(
+        scheduling_cycle, cfg=cfg, predictor_fn=None))
+    ca = fn.lower(
+        SchedState.init(m=m), reqs, eps, Weights.default(),
+        jax.random.PRNGKey(0), None,
+    ).compile().cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    if "bytes accessed" not in ca or "flops" not in ca:
+        raise RuntimeError(
+            "backend cost analysis no longer reports flops/bytes accessed "
+            f"(keys: {sorted(ca)[:20]}) — the HBM-budget gate would pass "
+            "vacuously; update gie_tpu/utils/costmodel.py for the new API")
+    return {"flops": float(ca["flops"]), "bytes": float(ca["bytes accessed"])}

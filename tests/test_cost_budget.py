@@ -1,0 +1,39 @@
+"""HBM-traffic budget regression gate for the compiled scheduling cycle.
+
+The <=50 us pick-latency target (BASELINE.md) is an HBM-bandwidth budget
+in disguise: one v5e moves ~819 GB/s, so the 1024x256 cycle must stay
+within ~40 MB of bytes accessed. Round 4 cut the cycle from 51.4 MB to
+~30 MB (fused prefix sweep + chunk-axis bucketing — docs/BENCH_NOTES.md
+round 4); this test pins the ceiling so a future change that reintroduces
+a materialized [N, C, W, 32] unpack or an unbucketed axis fails loudly
+instead of silently costing 2x on hardware.
+
+The measurement recipe lives in gie_tpu/utils/costmodel.py and is shared
+with hack/cost_analysis.py (the ceilings were calibrated against that
+exact fixture); cycle_cost raises if the backend stops reporting the
+metric, so the gate can never pass vacuously. Ceilings carry ~15% slack
+over measured values so legitimate small changes don't thrash the gate;
+a floor guards against the measurement collapsing to nonsense.
+"""
+
+import pytest
+
+from gie_tpu.sched.profile import ProfileConfig
+from gie_tpu.utils.costmodel import cycle_cost
+
+
+@pytest.mark.parametrize("name,cfg,ceiling_mb", [
+    # measured 30.5 MB on the round-4 HLO
+    ("default-topk", ProfileConfig(), 36.0),
+    # measured 58.5 MB (8 OT iterations re-read the transport kernel)
+    ("sinkhorn", ProfileConfig(picker="sinkhorn"), 68.0),
+])
+def test_cycle_hbm_budget(name, cfg, ceiling_mb):
+    got_mb = cycle_cost(cfg)["bytes"] / 1e6
+    assert got_mb >= 5.0, (
+        f"{name} cycle reports only {got_mb:.1f} MB — the cost analysis "
+        "is no longer measuring the real program")
+    assert got_mb <= ceiling_mb, (
+        f"{name} cycle now accesses {got_mb:.1f} MB (> {ceiling_mb} MB "
+        f"ceiling) — a shape/fusion regression that will show up as "
+        f"pick latency on hardware; run hack/cost_analysis.py to bisect")
