@@ -327,7 +327,17 @@ def _pd_cycle(
         jnp.float32,
     )
     d_wvec = wvec * keep
-    d_total = jnp.einsum("s,snm->nm", d_wvec, stacked) / jnp.maximum(
+    # Incremental de-blend: total already folded every column, so the
+    # decode blend = (total * sum(w) - the prefill-only columns) /
+    # sum(kept w) — two column reads instead of re-reducing the whole
+    # [S, N, M] stack (~7 MB at the north-star shape).
+    wsum = jnp.maximum(jnp.sum(wvec), jnp.float32(1e-6))
+    dropped = sum(
+        (w * named[k] for k, w in zip(named, wvec)
+         if k in _PREFILL_ONLY_COLUMNS),
+        start=jnp.float32(0.0),
+    )
+    d_total = (total * wsum - dropped) / jnp.maximum(
         jnp.sum(d_wvec), jnp.float32(1e-6)
     )
     # Same endpoint as the prefill pick = no KV transfer: bonus on that
