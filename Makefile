@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native generate test test-unit test-conformance bench bench-goodput release clean
+.PHONY: all native generate test test-unit test-conformance bench bench-goodput cost release clean
 
 all: native generate
 
@@ -28,6 +28,11 @@ test-conformance:
 # Headline TPU benchmark (driver metric).
 bench:
 	$(PY) bench.py
+
+# XLA cost analysis of the compiled cycle (the HBM-traffic perf model
+# behind the <=50us pick budget; gated in tests/test_cost_budget.py).
+cost:
+	$(PY) hack/cost_analysis.py
 
 # Cluster-goodput benchmark vs the least-kv baseline.
 bench-goodput:

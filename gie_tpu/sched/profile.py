@@ -331,14 +331,27 @@ def _pd_cycle(
     # decode blend = (total * sum(w) - the prefill-only columns) /
     # sum(kept w) — two column reads instead of re-reducing the whole
     # [S, N, M] stack (~7 MB at the north-star shape).
+    #
+    # Degeneracy guard: when the kept weights are negligible relative to
+    # the total mass (a locality-only tuning: prefix/session carry all
+    # the weight), the subtraction leaves pure float32 cancellation
+    # residue; dividing it by a tiny denominator would synthesize noise
+    # bigger than the co-location bonus and scatter decode picks away
+    # from the prefill worker. The honest value there is ZERO — no
+    # decode-side signal exists — and the relative threshold bounds the
+    # worst-case quotient at ~wsum*ulp/(1e-3*wsum) ~ 2e-4, far under
+    # the bonus.
     wsum = jnp.maximum(jnp.sum(wvec), jnp.float32(1e-6))
+    d_wsum = jnp.sum(d_wvec)
     dropped = sum(
         (w * named[k] for k, w in zip(named, wvec)
          if k in _PREFILL_ONLY_COLUMNS),
         start=jnp.float32(0.0),
     )
-    d_total = (total * wsum - dropped) / jnp.maximum(
-        jnp.sum(d_wvec), jnp.float32(1e-6)
+    d_total = jnp.where(
+        d_wsum > 1e-3 * wsum,
+        (total * wsum - dropped) / jnp.maximum(d_wsum, jnp.float32(1e-6)),
+        0.0,
     )
     # Same endpoint as the prefill pick = no KV transfer: bonus on that
     # column (only BOTH-role endpoints can win both picks).

@@ -236,3 +236,43 @@ def test_api_error_carries_status():
         assert exc.value.status == 404
     finally:
         srv.close()
+
+
+def test_malformed_watch_stream_recovers(stack):
+    """Garbage on the watch stream (truncated JSON, binary noise) must
+    not kill the watcher: the loop backs off, reconnects, and later
+    events still land."""
+    srv, client, ds = stack
+    srv.apply("pools", pool_manifest())
+    srv.apply("pods", pod_manifest("pod-a", "10.0.0.1"))
+
+    # Corrupt every watch stream once: prepend a garbage line to the
+    # first batch of events each connection sends.
+    original = srv._handle_watch
+    poisoned = {"n": 0}
+
+    def corrupting_watch(handler, resource, ns, q):
+        if poisoned["n"] < 3:
+            poisoned["n"] += 1
+            try:
+                garbage = b'{"type": "ADDED", "object": {truncated\n'
+                handler.send_response(200)
+                handler.send_header("Content-Type", "application/json")
+                handler.send_header("Transfer-Encoding", "chunked")
+                handler.end_headers()
+                handler.wfile.write(
+                    f"{len(garbage):x}\r\n".encode() + garbage + b"\r\n")
+                handler.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+            return
+        return original(handler, resource, ns, q)
+
+    srv._handle_watch = corrupting_watch
+    client.start()
+    # First three watch connections feed garbage; the adapter must keep
+    # retrying and converge once streams are healthy again.
+    assert _wait(lambda: len(ds.endpoints()) == 1, timeout_s=10.0), (
+        "watcher died on a malformed stream")
+    srv.apply("pods", pod_manifest("pod-b", "10.0.0.2"))
+    assert _wait(lambda: len(ds.endpoints()) == 2, timeout_s=10.0)

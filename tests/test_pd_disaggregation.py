@@ -223,3 +223,45 @@ def test_rejected_pd_requests_do_not_pollute_prefix_index():
     full = make_endpoints(4, role=[R.PREFILL, R.PREFILL, R.DECODE, R.DECODE])
     cols = s.explain(make_requests(1, prompts=[prompt]), full)
     assert float(cols["prefix"].max()) == 0.0
+
+
+def test_locality_only_weights_colocate_decode():
+    """Regression (round-4 review): with a locality-only blend (all
+    decode-kept weights zero) the decode side has NO signal, so the
+    co-location bonus must fully decide the decode pick — float32
+    cancellation residue from the incremental de-blend must not outvote
+    it and scatter decodes away from the prefill worker."""
+    import functools
+
+    import jax
+    import numpy as np
+
+    from gie_tpu.sched import constants as C
+    from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
+    from gie_tpu.sched.types import SchedState, Weights
+    from gie_tpu.utils.testing import make_endpoints, make_requests
+
+    cfg = ProfileConfig(pd_disaggregation=True)
+    fn = jax.jit(functools.partial(
+        scheduling_cycle, cfg=cfg, predictor_fn=None))
+    eps = make_endpoints(
+        8, queue=[0.0] * 8, kv=[0.1] * 8,
+        role=[int(C.Role.BOTH)] * 8, m_slots=64)
+    prompts = [b"shared system prompt " * 10 + b"u%d" % i
+               for i in range(16)]
+    reqs = make_requests(16, prompts=prompts, m_slots=64)
+    weights = Weights(
+        queue=np.float32(0.0), kv_cache=np.float32(0.0),
+        prefix=np.float32(7.7), lora=np.float32(0.0),
+        assumed_load=np.float32(0.0), latency=np.float32(0.0),
+        session=np.float32(2.2),
+    )
+    st = SchedState.init(m=64)
+    # Warm the prefix table so the prefill side has real affinity signal.
+    res, st = fn(st, reqs, eps, weights, jax.random.PRNGKey(0), None)
+    res, _ = fn(st, reqs, eps, weights, jax.random.PRNGKey(1), None)
+    prefill = np.asarray(res.prefill)
+    decode = np.asarray(res.indices[:, 0])
+    ok = prefill >= 0
+    assert ok.any()
+    np.testing.assert_array_equal(decode[ok], prefill[ok])
