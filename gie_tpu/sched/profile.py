@@ -338,9 +338,11 @@ def _pd_cycle(
     # residue; dividing it by a tiny denominator would synthesize noise
     # bigger than the co-location bonus and scatter decode picks away
     # from the prefill worker. The honest value there is ZERO — no
-    # decode-side signal exists — and the relative threshold bounds the
-    # worst-case quotient at ~wsum*ulp/(1e-3*wsum) ~ 2e-4, far under
-    # the bonus.
+    # decode-side signal exists. Threshold sizing: the residue is
+    # ~wsum * a-few-ulps (~1e-6 relative), so at d_wsum = 1e-4 * wsum
+    # the worst-case noise is ~1e-2 — 4% of the 0.25 bonus — while any
+    # deliberately-configured small weight (even 0.1% of the blend)
+    # stays live rather than being silently discarded.
     wsum = jnp.maximum(jnp.sum(wvec), jnp.float32(1e-6))
     d_wsum = jnp.sum(d_wvec)
     dropped = sum(
@@ -349,7 +351,7 @@ def _pd_cycle(
         start=jnp.float32(0.0),
     )
     d_total = jnp.where(
-        d_wsum > 1e-3 * wsum,
+        d_wsum > 1e-4 * wsum,
         (total * wsum - dropped) / jnp.maximum(d_wsum, jnp.float32(1e-6)),
         0.0,
     )

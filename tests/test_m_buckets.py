@@ -234,3 +234,30 @@ def test_chunk_bucket_for():
     assert chunk_bucket_for(9) == 16
     assert chunk_bucket_for(32) == C.MAX_CHUNKS
     assert chunk_bucket_for(99) == C.MAX_CHUNKS  # capped upstream
+
+
+def test_checkpoint_roundtrip_across_m_buckets(tmp_path):
+    """Warm restart saved at a small M bucket restores (the template loop
+    tries each bucket) and the next pick migrates it to whatever bucket
+    the new pool needs — affinity intact."""
+    sched = Scheduler()
+    eps = make_endpoints(4, queue=[1.0] * 4, kv=[0.2] * 4, m_slots=64)
+    prompt = b"persistent prefix " * 10
+    r = sched.pick(make_requests(
+        2, prompts=[prompt + b"a", prompt + b"b"], m_slots=64), eps)
+    home = int(np.asarray(r.indices)[0, 0])
+    assert sched.state.m == 64
+    ckpt = str(tmp_path / "m-bucket-state")
+    sched.save_state(ckpt)
+
+    s2 = Scheduler()
+    assert s2.restore_state(ckpt)
+    assert s2.state.m == 64
+    # Restart into a BIGGER pool: restore then grow-migrate on pick.
+    eps_big = make_endpoints(
+        100, queue=[0.5] * 100, kv=[0.2] * 100, m_slots=256)
+    r2 = s2.pick(make_requests(
+        2, prompts=[prompt + b"c", prompt + b"d"], m_slots=256), eps_big)
+    assert s2.state.m == 256
+    assert int(np.asarray(r2.indices)[0, 0]) == home, (
+        "prefix affinity lost across checkpoint + bucket migration")

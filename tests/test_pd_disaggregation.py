@@ -265,3 +265,44 @@ def test_locality_only_weights_colocate_decode():
     ok = prefill >= 0
     assert ok.any()
     np.testing.assert_array_equal(decode[ok], prefill[ok])
+
+
+def test_small_but_legit_decode_weight_is_honored():
+    """The degeneracy guard must not discard a deliberately small decode
+    weight: queue=0.008 against a ~10-mass locality blend is 0.08% of
+    the total — above the 1e-4 relative threshold — so the decode pick
+    must still prefer the emptier queue, not fall back to co-location."""
+    import functools
+
+    import jax
+    import numpy as np
+
+    from gie_tpu.sched import constants as C
+    from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
+    from gie_tpu.sched.types import SchedState, Weights
+    from gie_tpu.utils.testing import make_endpoints, make_requests
+
+    cfg = ProfileConfig(pd_disaggregation=True, pd_colocation_bonus=0.0)
+    fn = jax.jit(functools.partial(
+        scheduling_cycle, cfg=cfg, predictor_fn=None))
+    # Decode workers: slot 2 idle, slot 3 loaded. Prefill workers 0/1.
+    eps = make_endpoints(
+        4, queue=[0.0, 0.0, 0.0, 60.0], kv=[0.1] * 4,
+        role=[int(C.Role.PREFILL), int(C.Role.PREFILL),
+              int(C.Role.DECODE), int(C.Role.DECODE)],
+        m_slots=64)
+    prompts = [b"shared system prompt " * 10 + b"u%d" % i for i in range(8)]
+    reqs = make_requests(8, prompts=prompts, m_slots=64)
+    weights = Weights(
+        queue=np.float32(0.008), kv_cache=np.float32(0.0),
+        prefix=np.float32(7.7), lora=np.float32(0.0),
+        assumed_load=np.float32(0.0), latency=np.float32(0.0),
+        session=np.float32(2.2),
+    )
+    res, _ = fn(SchedState.init(m=64), reqs, eps, weights,
+                jax.random.PRNGKey(0), None)
+    decode = np.asarray(res.indices[:, 0])
+    ok = decode >= 0
+    assert ok.any()
+    assert (decode[ok] == 2).all(), (
+        f"small queue weight silently zeroed: decode picks {decode}")
