@@ -231,35 +231,19 @@ def test_locality_only_weights_colocate_decode():
     co-location bonus must fully decide the decode pick — float32
     cancellation residue from the incremental de-blend must not outvote
     it and scatter decodes away from the prefill worker."""
-    import functools
+    from gie_tpu.sched import Weights
 
-    import jax
-    import numpy as np
-
-    from gie_tpu.sched import constants as C
-    from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
-    from gie_tpu.sched.types import SchedState, Weights
-    from gie_tpu.utils.testing import make_endpoints, make_requests
-
-    cfg = ProfileConfig(pd_disaggregation=True)
-    fn = jax.jit(functools.partial(
-        scheduling_cycle, cfg=cfg, predictor_fn=None))
+    s = Scheduler(
+        ProfileConfig(pd_disaggregation=True),
+        weights=_locality_weights(queue=0.0),
+    )
     eps = make_endpoints(
-        8, queue=[0.0] * 8, kv=[0.1] * 8,
-        role=[int(C.Role.BOTH)] * 8, m_slots=64)
+        8, queue=[0.0] * 8, kv=[0.1] * 8, role=[R.BOTH] * 8, m_slots=64)
     prompts = [b"shared system prompt " * 10 + b"u%d" % i
                for i in range(16)]
-    reqs = make_requests(16, prompts=prompts, m_slots=64)
-    weights = Weights(
-        queue=np.float32(0.0), kv_cache=np.float32(0.0),
-        prefix=np.float32(7.7), lora=np.float32(0.0),
-        assumed_load=np.float32(0.0), latency=np.float32(0.0),
-        session=np.float32(2.2),
-    )
-    st = SchedState.init(m=64)
     # Warm the prefix table so the prefill side has real affinity signal.
-    res, st = fn(st, reqs, eps, weights, jax.random.PRNGKey(0), None)
-    res, _ = fn(st, reqs, eps, weights, jax.random.PRNGKey(1), None)
+    s.pick(make_requests(16, prompts=prompts, m_slots=64), eps)
+    res = s.pick(make_requests(16, prompts=prompts, m_slots=64), eps)
     prefill = np.asarray(res.prefill)
     decode = np.asarray(res.indices[:, 0])
     ok = prefill >= 0
@@ -267,40 +251,33 @@ def test_locality_only_weights_colocate_decode():
     np.testing.assert_array_equal(decode[ok], prefill[ok])
 
 
+def _locality_weights(queue: float):
+    from gie_tpu.sched import Weights
+
+    return Weights(
+        queue=np.float32(queue), kv_cache=np.float32(0.0),
+        prefix=np.float32(7.7), lora=np.float32(0.0),
+        assumed_load=np.float32(0.0), latency=np.float32(0.0),
+        session=np.float32(2.2),
+    )
+
+
 def test_small_but_legit_decode_weight_is_honored():
     """The degeneracy guard must not discard a deliberately small decode
     weight: queue=0.008 against a ~10-mass locality blend is 0.08% of
     the total — above the 1e-4 relative threshold — so the decode pick
     must still prefer the emptier queue, not fall back to co-location."""
-    import functools
-
-    import jax
-    import numpy as np
-
-    from gie_tpu.sched import constants as C
-    from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
-    from gie_tpu.sched.types import SchedState, Weights
-    from gie_tpu.utils.testing import make_endpoints, make_requests
-
-    cfg = ProfileConfig(pd_disaggregation=True, pd_colocation_bonus=0.0)
-    fn = jax.jit(functools.partial(
-        scheduling_cycle, cfg=cfg, predictor_fn=None))
+    s = Scheduler(
+        ProfileConfig(pd_disaggregation=True, pd_colocation_bonus=0.0),
+        weights=_locality_weights(queue=0.008),
+    )
     # Decode workers: slot 2 idle, slot 3 loaded. Prefill workers 0/1.
     eps = make_endpoints(
         4, queue=[0.0, 0.0, 0.0, 60.0], kv=[0.1] * 4,
-        role=[int(C.Role.PREFILL), int(C.Role.PREFILL),
-              int(C.Role.DECODE), int(C.Role.DECODE)],
+        role=[R.PREFILL, R.PREFILL, R.DECODE, R.DECODE],
         m_slots=64)
     prompts = [b"shared system prompt " * 10 + b"u%d" % i for i in range(8)]
-    reqs = make_requests(8, prompts=prompts, m_slots=64)
-    weights = Weights(
-        queue=np.float32(0.008), kv_cache=np.float32(0.0),
-        prefix=np.float32(7.7), lora=np.float32(0.0),
-        assumed_load=np.float32(0.0), latency=np.float32(0.0),
-        session=np.float32(2.2),
-    )
-    res, _ = fn(SchedState.init(m=64), reqs, eps, weights,
-                jax.random.PRNGKey(0), None)
+    res = s.pick(make_requests(8, prompts=prompts, m_slots=64), eps)
     decode = np.asarray(res.indices[:, 0])
     ok = decode >= 0
     assert ok.any()
