@@ -181,7 +181,9 @@ class RequestContext:
     resp_tokens: int = 0
     resp_first_at: float = 0.0
     resp_last_at: float = 0.0
-    sse_carry: bytes = b""   # split-"data:" guard across chunk boundaries
+    # Split-"data:" guard across chunk boundaries; seeded with a virtual
+    # newline so a frame at stream start (no preceding terminator) anchors.
+    sse_carry: bytes = b"\n"
     resp_tail: bytes = b""   # last bytes kept for the usage-block parse
     last_frame: Optional[bytes] = None  # last decoded Generate frame
     # True when the response chunk timing reflects GENERATION cadence
@@ -574,28 +576,52 @@ class StreamingServer:
     # Matches the OpenAI usage block's completion-token count in a JSON
     # response (or an SSE stream's final usage frame).
     _USAGE_RE = re.compile(rb'"completion_tokens"\s*:\s*(\d+)')
+    # SSE field lines start a line (WHATWG EventSource §9.2.5): a `data:`
+    # anywhere else is payload content, not a frame. The alternation keeps
+    # CRLF/CR/LF terminators each to one match.
+    _SSE_FRAME_RE = re.compile(rb"(?:\r\n|\r|\n)data:")
+    # [ \t]*, NOT \s*: \s matches newlines, which would let an empty data
+    # frame followed by a bare "[DONE]" payload line fire the decrement.
+    _SSE_DONE_RE = re.compile(rb"(?:\r\n|\r|\n)data:[ \t]*\[DONE\]")
 
     def _count_plain_tokens(self, ctx: RequestContext, data: bytes) -> None:
-        """Token-count harvest on the NON-transcoded response path: SSE
-        `data:` frames approximate one token-group each (counted with a
-        carry so a frame marker split across chunk boundaries still
-        counts); a rolling tail is kept so a final usage block — the
-        authoritative count — can override in _finish_token_count."""
+        """Token-count harvest on the NON-transcoded response path:
+        line-anchored SSE `data:` frames approximate one token-group each
+        (a completion whose *text* contains "data:" must not inflate the
+        count); the carry keeps enough tail bytes that a frame marker
+        split across chunk boundaries still counts exactly once. A
+        rolling tail is kept so a final usage block — the authoritative
+        count — can override in _finish_token_count."""
         if not data:
             return
-        buf = ctx.sse_carry + data
-        # Matches ENDING in this chunk only (the carry's own complete
-        # matches were counted when their chunk arrived).
-        ctx.resp_tokens += buf.count(b"data:") - ctx.sse_carry.count(b"data:")
-        ctx.sse_carry = buf[-4:]
+        carry = ctx.sse_carry
+        buf = carry + data
+        # Matches ENDING in this chunk only: any match wholly inside the
+        # carry was counted when its own chunk arrived (the carry spans
+        # the longest marker, `\r\ndata:`, so boundary splits land here).
+        ctx.resp_tokens += (
+            len(self._SSE_FRAME_RE.findall(buf))
+            - len(self._SSE_FRAME_RE.findall(carry))
+        )
+        ctx.sse_carry = buf[-7:]
         ctx.resp_tail = (ctx.resp_tail + data)[-4096:]
 
     def _finish_token_count(self, ctx: RequestContext) -> None:
         """End of response stream: prefer authoritative counts. Transcoded
         streams read completion_tokens from the final Generate frame;
         plain streams fall back to the usage block in the tail; the SSE
-        frame count (minus the [DONE] sentinel) remains the floor."""
-        if ctx.resp_tokens and b"data: [DONE]" in ctx.resp_tail:
+        frame count (minus the [DONE] sentinel) remains the floor. The
+        sentinel check is line-anchored too — "data: [DONE]" inside a
+        completion's text must not trigger the decrement. resp_tail
+        accumulates raw bytes across chunks, so a [DONE] frame split by
+        chunking is contiguous here; the startswith arm covers a stream
+        that begins with the sentinel (only trustworthy while the tail
+        was never truncated, i.e. it still IS the whole body)."""
+        if ctx.resp_tokens and (
+            self._SSE_DONE_RE.search(ctx.resp_tail)
+            or (len(ctx.resp_tail) < 4096
+                and self._SSE_DONE_RE.match(b"\n" + ctx.resp_tail))
+        ):
             ctx.resp_tokens -= 1
         # Timing provenance BEFORE any authoritative-count override: the
         # transcoded path's chunks are upstream Generate frames (real

@@ -51,6 +51,79 @@ def test_done_sentinel_not_counted():
     assert ctx.resp_tokens == 2
 
 
+def test_payload_containing_data_marker_not_counted():
+    """A completion whose *text* contains "data:" must not inflate the
+    frame count (VERDICT r4 #3): only line-anchored `data:` is a frame."""
+    srv = _server()
+    ctx = RequestContext()
+    srv._count_plain_tokens(
+        ctx,
+        b'data: {"text":"the data: field and more data: here"}\n\n'
+        b'data: {"text":"plain"}\n\n',
+    )
+    srv._finish_token_count(ctx)
+    assert ctx.resp_tokens == 2
+
+
+def test_payload_containing_done_sentinel_no_decrement():
+    """"data: [DONE]" inside a completion's text is payload, not the
+    stream-end sentinel — the decrement must not fire."""
+    srv = _server()
+    ctx = RequestContext()
+    srv._count_plain_tokens(
+        ctx, b'data: {"text":"say data: [DONE] verbatim"}\n\n'
+    )
+    srv._count_plain_tokens(ctx, b'data: {"text":"x"}\n\n')
+    srv._finish_token_count(ctx)
+    assert ctx.resp_tokens == 2
+
+
+def test_first_frame_at_stream_start_counts():
+    """The very first frame has no preceding newline; the virtual-anchor
+    seed must count it — including a stream that is ONLY the sentinel."""
+    srv = _server()
+    ctx = RequestContext()
+    srv._count_plain_tokens(ctx, b'data: {"c":1}\n\n')
+    srv._finish_token_count(ctx)
+    assert ctx.resp_tokens == 1
+
+    ctx2 = RequestContext()
+    srv._count_plain_tokens(ctx2, b"data: [DONE]\n\n")
+    srv._finish_token_count(ctx2)
+    assert ctx2.resp_tokens == 0
+
+
+def test_crlf_terminated_frames_count_once_each():
+    srv = _server()
+    ctx = RequestContext()
+    srv._count_plain_tokens(ctx, b'data: {"c":1}\r\n\r\ndata: {"c":2}\r')
+    srv._count_plain_tokens(ctx, b'\n\r\ndata: [DONE]\r\n\r\n')
+    srv._finish_token_count(ctx)
+    assert ctx.resp_tokens == 2
+
+
+def test_bare_done_line_after_empty_frame_no_decrement():
+    """An empty data frame followed by a bare "[DONE]" line (which an SSE
+    parser ignores) is not the sentinel — the decrement must not fire
+    across line boundaries."""
+    srv = _server()
+    ctx = RequestContext()
+    srv._count_plain_tokens(ctx, b'data: {"c":1}\n\ndata:\n\n[DONE]\n\n')
+    srv._finish_token_count(ctx)
+    assert ctx.resp_tokens == 2  # the real frame + the empty frame
+
+
+def test_split_done_sentinel_still_decrements():
+    """[DONE] split across chunk boundaries is contiguous in the rolling
+    tail, so the anchored decrement still fires."""
+    srv = _server()
+    ctx = RequestContext()
+    srv._count_plain_tokens(ctx, b'data: {"c":1}\n\ndata: [D')
+    srv._count_plain_tokens(ctx, b'ONE]\n\n')
+    srv._finish_token_count(ctx)
+    assert ctx.resp_tokens == 1
+
+
 def test_usage_block_overrides_frame_count():
     srv = _server()
     ctx = RequestContext()
