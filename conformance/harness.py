@@ -520,3 +520,19 @@ class ConformanceEnv:
             else "http",
             backend_received=body,  # every path records what the pod got
         )
+
+
+def build_base_env() -> ConformanceEnv:
+    """The suite's shared base environment (reference
+    conformance/resources/base.yaml: two gateways + echo model-server
+    deployments x3 + EPP service). Single source of truth used by BOTH the
+    pytest `env` fixture and the standalone runner (conformance/run.py) —
+    reference conformance.go:149-192 builds the same fixed base before
+    dispatching tests."""
+    e = ConformanceEnv()
+    e.apply_gateway(Gateway("primary-gateway"))
+    e.apply_gateway(Gateway("secondary-gateway"))
+    e.apply_service(Service("epp-svc"))
+    e.deploy_model_servers("primary-model-server", 3, {"app": "primary"})
+    e.deploy_model_servers("secondary-model-server", 3, {"app": "secondary"})
+    return e

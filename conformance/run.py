@@ -41,6 +41,7 @@ def main(argv=None) -> int:
 
     # The suite lives in tests/test_conformance.py; reuse its registry.
     sys.path.insert(0, ".")
+    from conformance.harness import build_base_env
     from conformance.report import ConformanceReport
     import tests.test_conformance as suite
 
@@ -58,8 +59,7 @@ def main(argv=None) -> int:
         try:
             params = inspect.signature(fn).parameters
             if params:
-                env = suite.env.__wrapped__()  # fixture body builds the env
-                fn(env)
+                fn(build_base_env())  # same base env as the pytest fixture
             else:
                 fn()  # self-contained test (builds its own environment)
             print(f"PASS {name}")

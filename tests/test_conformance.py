@@ -7,6 +7,7 @@ import collections
 import pytest
 
 from conformance import ConformanceEnv, ConformanceReport
+from conformance.harness import build_base_env
 from gie_tpu.api import types as api
 from gie_tpu.api.gateway import (
     ROUTE_ACCEPTED,
@@ -42,15 +43,9 @@ def make_pool(name, selector, ports=(8000,), epp="epp-svc", failure_mode=api.FAI
 
 @pytest.fixture
 def env():
-    """Base resources (reference conformance/resources/base.yaml: gateways +
-    echo model-server deployments x3 + EPP service)."""
-    e = ConformanceEnv()
-    e.apply_gateway(Gateway("primary-gateway"))
-    e.apply_gateway(Gateway("secondary-gateway"))
-    e.apply_service(Service("epp-svc"))
-    e.deploy_model_servers("primary-model-server", 3, {"app": "primary"})
-    e.deploy_model_servers("secondary-model-server", 3, {"app": "secondary"})
-    return e
+    """Base resources — shared with the standalone runner (conformance.run)
+    via conformance.harness.build_base_env."""
+    return build_base_env()
 
 
 def pool_condition(env, ns, name, parent, ctype):
