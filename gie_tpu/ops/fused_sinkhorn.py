@@ -3,7 +3,7 @@
 The OT picker alternates row normalization and column capping over the
 [N, M] transport plan `iters` times (gie_tpu/sched/sinkhorn.py). Under XLA
 each iteration's plan round-trips HBM; this kernel keeps the whole plan in
-VMEM (2 MB at the north-star 1024x512 f32 — well under the ~16 MB budget)
+VMEM (4 MB even at the full 1024x1024 f32 axis — under the ~16 MB budget)
 and runs the full loop on-chip, writing HBM once.
 
 Single-program kernel (no grid): the column cap couples every row, so the

@@ -35,11 +35,18 @@ class Metric(enum.IntEnum):
 NUM_METRICS = len(Metric)
 
 # Global endpoint-axis budget. The reference supports pods x up to 8 DP-rank
-# target ports (api/v1/inferencepool_types.go:72-81); 512 endpoint slots cover
-# the north-star 256-endpoint benchmark with headroom. All device state
-# (assumed load, prefix-table bitmasks) is laid out against a fixed axis so
-# pod churn never changes a compiled shape — rows are masked, not resized.
-M_MAX = 512
+# target ports (api/v1/inferencepool_types.go:72-81) with an unbounded
+# datastore (pkg/lwepp/datastore/datastore.go:181-193); 1024 endpoint slots
+# cover the north-star 256-endpoint benchmark with 4x headroom. All device
+# state (assumed load, prefix-table bitmasks) is laid out against a fixed
+# axis so pod churn never changes a compiled shape — rows are masked, not
+# resized. A fleet that outgrows M_MAX degrades GRACEFULLY, by design, to a
+# schedulable subset: the datastore refuses the slot (the endpoint simply
+# receives no traffic, re-entering via watch/resync when churn frees slots)
+# and counts the refusal, which the runner surfaces as the
+# endpoint_slot_overflow alert metric (runtime/metrics.py) — the compiled
+# pick path itself can never see a slot id >= M_MAX.
+M_MAX = 1024
 
 # Words of a uint32 bitmask spanning M_MAX endpoints.
 M_WORDS = M_MAX // 32
@@ -51,7 +58,7 @@ M_WORDS = M_MAX // 32
 # bucket is a multiple of 32 (the packed prefix-word width) and a distinct
 # compiled shape; crossing a boundary migrates state (types.resize_state),
 # it never recompiles mid-cycle.
-M_BUCKETS = (64, 256, 512)
+M_BUCKETS = (64, 256, 512, 1024)
 
 # Request-axis buckets: incoming micro-batches are padded up to the nearest
 # bucket so only a handful of shapes ever compile.

@@ -26,7 +26,7 @@ NEG = C.NEG_SCORE
 # a genuine (super-quantum) ordering, and above float32 ulp(1.0) so it is not
 # absorbed.
 _TIE_RESOLUTION = float(1.0 / 4096.0)            # ~2.4e-4
-_TIE_EPS = _TIE_RESOLUTION / float(C.M_MAX + 1)  # ~4.8e-7 > ulp(1.0)
+_TIE_EPS = _TIE_RESOLUTION / float(C.M_MAX + 1)  # ~2.4e-7 > ulp(1.0)~1.2e-7
 
 
 def _topk(masked: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:

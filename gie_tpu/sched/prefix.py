@@ -11,14 +11,14 @@ gather + cumulative-AND + popcount:
   hit(n,c)    = keys[slot(h_nc)] == h_nc           chunk known at all
   words(n,c,w)= present[slot(h_nc), w] * hit       packed endpoint bits
   run(n,c,w)  = AND_{c'<=c} words(n,c',w)          longest-prefix property
-                (cumulative bitwise AND — 512 endpoints advance per word op)
+                (cumulative bitwise AND — all M_MAX endpoints advance per row op)
   match(n,m)  = sum_c bit_m(run(n,c))              popcount-style unpack
   score       = match / n_chunks                   normalized [0, 1]
 
-The packed layout is the load-bearing TPU choice: the table is 2 MiB
-(u32[S, M_WORDS]) instead of 16 MiB (bool[S, M_MAX]), so the per-cycle
-gather of [N, C] rows moves 8x fewer bytes and the cumulative AND runs on
-16 words instead of 512 lanes.
+The packed layout is the load-bearing TPU choice: the table is 4 MiB
+(u32[S, M_WORDS] at 32768 x 1024) instead of 32 MiB (bool[S, M_MAX]), so
+the per-cycle gather of [N, C] rows moves 8x fewer bytes and the
+cumulative AND runs on 32 words instead of 1024 lanes.
 
 Staleness: every touched slot is stamped with the cycle tick; match ignores
 slots older than `max_age` ticks (the LRU-decay analogue of the reference's

@@ -101,8 +101,8 @@ class PrefixTable:
     32-bit chunk-hash key, a BITPACKED per-endpoint presence row (who
     plausibly has this chunk cached — bit m of word m//32), and an age tick
     for staleness decay. Packing the presence matrix into u32 words keeps
-    the whole table at S x M_WORDS x 4 B (2 MiB at 32768 x 512) instead of
-    S x M_MAX bytes (16 MiB as bools) — 8x less HBM traffic on every
+    the whole table at S x M_WORDS x 4 B (4 MiB at 32768 x 1024) instead of
+    S x M_MAX bytes (32 MiB as bools) — 8x less HBM traffic on every
     match gather and insert scatter, the ops that dominate the cycle.
     Collisions overwrite (the index is explicitly approximate in the
     reference design too); XLA sees only dense scatter/gather.

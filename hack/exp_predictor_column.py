@@ -1,0 +1,116 @@
+"""Experiment (VERDICT r4 #8): where does the latency COLUMN earn its keep?
+
+The flagship goodput bench is a HOMOGENEOUS fleet, where the predictor's
+per-endpoint embedding has nothing persistent to learn — queue/kv metrics
+already rank pods, so the trained column was goodput-neutral there
+(BENCH_NOTES round 4: 2320 vs 2328 tok/s). BASELINE configs[3] sells the
+column as a scorer signal, so this experiment builds the regime that
+signal was designed for: a heterogeneous fleet (half the pods degraded —
+slower prefill AND decode, as with mixed accelerator generations or
+noisy neighbors) under mixed decode lengths. Metric-only scoring sees a
+degraded pod only through its lagging queue; the per-endpoint embedding
+learns the pod IS slow and steers proportionally.
+
+Runs tpu (tuned scheduler, metric-only) vs tpu+column (same scheduler +
+online trainer feeding the confidence-gated latency column; SLO admission
+OFF so the column is the only delta). Also reports the homogeneous fleet
+for contrast. One JSON line; detail to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _force_platform() -> None:
+    import os
+
+    import jax
+
+    jax.config.update(
+        "jax_platforms", os.environ.get("GIE_GOODPUT_PLATFORM", "cpu"))
+
+
+def run_fleet(fleet_name, cfgs, with_column, seed=0, duration=20.0):
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from bench_goodput import HEADLINE_WORKLOAD
+    from gie_tpu.simulator.cluster import (
+        SimCluster,
+        WorkloadConfig,
+        tuned_scheduler,
+    )
+
+    # The headline workload already mixes decode lengths (exponential-ish
+    # draws around decode_tokens_mean) — the fleet, not the workload, is
+    # what this experiment perturbs.
+    wl = WorkloadConfig(**HEADLINE_WORKLOAD)
+    cluster = SimCluster(n_pods=len(cfgs), stub_cfg=cfgs, seed=seed)
+    kwargs = {}
+    if with_column:
+        from gie_tpu.models.latency import LatencyPredictor, OnlineTrainer
+
+        kwargs = dict(
+            trainer=OnlineTrainer(LatencyPredictor(), batch_size=64),
+            train_every_s=0.5,
+        )
+    stats = cluster.run("tpu", wl, duration_s=duration,
+                        scheduler=tuned_scheduler(), **kwargs)
+    tag = "column" if with_column else "metric-only"
+    print(
+        f"{fleet_name:12s} {tag:11s} goodput={stats.goodput_tokens_per_s:7.1f}"
+        f" tok/s slo={stats.slo_attainment:.2f}"
+        f" hit={stats.prefix_hit_rate:.2f} ttft_p50={stats.ttft_p50_s:.2f}s",
+        file=sys.stderr, flush=True,
+    )
+    return stats
+
+
+def main() -> None:
+    _force_platform()
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from bench_goodput import HEADLINE_STUB
+    from gie_tpu.simulator import StubConfig
+
+    base = HEADLINE_STUB
+    fast = StubConfig(**base)
+    degraded = StubConfig(**{
+        **base,
+        "prefill_tokens_per_s": 1500.0,
+        "decode_tokens_per_s": 20.0,
+    })
+
+    hetero = [fast] * 4 + [degraded] * 4
+    homog = [fast] * 8
+
+    results = {}
+    for fleet_name, cfgs in (("hetero", hetero), ("homogeneous", homog)):
+        for with_column in (False, True):
+            key = (fleet_name, "column" if with_column else "metric-only")
+            results[key] = run_fleet(fleet_name, cfgs, with_column)
+
+    het_ratio = (
+        results[("hetero", "column")].goodput_tokens_per_s
+        / max(results[("hetero", "metric-only")].goodput_tokens_per_s, 1e-9))
+    hom_ratio = (
+        results[("homogeneous", "column")].goodput_tokens_per_s
+        / max(results[("homogeneous", "metric-only")].goodput_tokens_per_s,
+              1e-9))
+    print(f"column lift: hetero={het_ratio:.3f}x homogeneous={hom_ratio:.3f}x",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "latency_column_goodput_lift_hetero_fleet",
+        "value": round(het_ratio, 3),
+        "unit": "ratio",
+        "vs_baseline": round(het_ratio, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

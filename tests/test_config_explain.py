@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 from gie_tpu.sched import ProfileConfig, Scheduler
+from gie_tpu.sched import constants as C
 from gie_tpu.sched.config import load_scheduler_config
 from gie_tpu.utils.testing import make_endpoints, make_requests
 
@@ -57,7 +58,7 @@ def test_explain_decomposes_the_pick():
     out = sched.explain(reqs, eps)
     assert set(out) >= {"queue", "kv_cache", "assumed_load", "prefix", "lora",
                         "total", "mask"}
-    assert out["total"].shape == (2, 512)
+    assert out["total"].shape == (2, C.M_MAX)
     # Queue column ranks endpoint 0 best; total agrees for request 0.
     assert out["queue"][0, 0] > out["queue"][0, 1] > out["queue"][0, 2]
     assert np.argmax(np.where(out["mask"][0], out["total"][0], -1e9)) == 0

@@ -3,7 +3,7 @@
 VERDICT r3 #2: device state and the compiled cycle are sized to the
 smallest M bucket covering the live endpoint slots (constants.M_BUCKETS),
 so the 256-endpoint north-star shape runs a 256-lane program instead of
-M_MAX=512. These tests pin (a) pick equivalence across bucket widths,
+M_MAX=1024; beyond M_MAX the datastore degrades to a schedulable subset (test_churn_stress). These tests pin (a) pick equivalence across bucket widths,
 (b) state-carrying correctness across grow/shrink migrations (the
 reference never resizes — its per-request maps are unbounded; the TPU
 design must prove churn across a boundary loses nothing live), and

@@ -14,6 +14,7 @@ from gie_tpu.models.latency import (
     predictor_score_fn,
 )
 from gie_tpu.sched import ProfileConfig, Scheduler, Weights
+from gie_tpu.sched import constants as C
 from gie_tpu.utils.testing import make_endpoints, make_requests
 
 
@@ -30,8 +31,8 @@ def test_predictor_forward_shapes_positive():
 def test_build_features_grid():
     reqs = make_requests(5, prompt_len=[100.0] * 5)
     eps = make_endpoints(3, queue=[1, 2, 3])
-    grid = build_features(reqs, eps, jnp.zeros((512,)))
-    assert grid.shape == (5, 512, NUM_FEATURES)
+    grid = build_features(reqs, eps, jnp.zeros((C.M_MAX,)))
+    assert grid.shape == (5, C.M_MAX, NUM_FEATURES)
 
 
 def test_online_trainer_reduces_loss():

@@ -352,10 +352,11 @@ def test_pallas_sinkhorn_matches_reference_path():
 
     rng = np.random.default_rng(0)
     eps = make_endpoints(8, queue=rng.integers(0, 40, 8).tolist())
-    k = np.where(rng.uniform(0, 1, (64, 512)) > 0.5,
-                 rng.uniform(0, 1, (64, 512)), 0.0).astype(np.float32)
-    k[:, 8:] = 0.0
     cap = capacities(eps, 64.0, queue_limit=128.0)
+    m = int(cap.shape[0])  # the endpoint batch's M bucket
+    k = np.where(rng.uniform(0, 1, (64, m)) > 0.5,
+                 rng.uniform(0, 1, (64, m)), 0.0).astype(np.float32)
+    k[:, 8:] = 0.0
     plan_pl = np.asarray(fused_sinkhorn_plan(
         np.asarray(k), cap, iters=8, interpret=True))
 
