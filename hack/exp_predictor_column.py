@@ -23,12 +23,6 @@ import json
 import sys
 
 
-def _jnp():
-    import jax.numpy as jnp
-
-    return jnp
-
-
 def _force_platform() -> None:
     import os
 
@@ -65,6 +59,8 @@ def run_fleet(fleet_name, cfgs, with_column, seed=0, duration=20.0,
             OnlineTrainer,
             predictor_score_fn,
         )
+        import jax.numpy as jnp
+
         from gie_tpu.sched import Scheduler
 
         # tuned_profile ships latency=0.0 (the column is off in the
@@ -76,7 +72,7 @@ def run_fleet(fleet_name, cfgs, with_column, seed=0, duration=20.0,
         trainer = OnlineTrainer(p, batch_size=64)
         sched = Scheduler(
             sched.cfg,
-            weights=sched.weights.replace(latency=_jnp().float32(1.5)),
+            weights=sched.weights.replace(latency=jnp.float32(1.5)),
             predictor_fn=predictor_score_fn(p),
             predictor_params=trainer.params,
         )
