@@ -30,21 +30,33 @@ _TIE_EPS = _TIE_RESOLUTION / float(C.M_MAX + 1)  # ~2.4e-7 > ulp(1.0)~1.2e-7
 
 
 def _topk(masked: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
-    """Iterative masked-argmax top-k.
+    """Iterative top-k by strict threshold DESCENT.
 
     lax.top_k lowers to a full sort on TPU (~850 us for [1024, 512]); k
-    rounds of (argmax, mask-out) are plain VPU reductions and two orders of
-    magnitude cheaper for the small k this pipeline needs.
+    rounds of masked reduction are plain VPU work and two orders of
+    magnitude cheaper for the small k this pipeline needs. Round j takes
+    the max over {x : x < bound_{j-1}} — an elementwise compare against a
+    per-row scalar that fuses INTO the reduction, so no round rewrites the
+    [N, M] operand (the round-5 rewrite: the previous mask-out-by-index
+    form materialized a fresh [N, M] array per round; 8.5 -> 4.3 MB at
+    1024x256, bit-identical picks).
+
+    Requires pairwise-distinct in-row values to enumerate ties as separate
+    entries — true for every caller (topk_picker's rotation makes equal
+    scores distinct; the sinkhorn/random paths add continuous Gumbel
+    noise). An exact float tie would skip the duplicate lane (its entry
+    gated at NEG, i.e. a shorter fallback list); the primary pick is the
+    true argmax regardless.
     """
-    m = masked.shape[-1]
-    lanes = jnp.arange(m, dtype=jnp.int32)[None, :]
     vals, idxs = [], []
-    x = masked
+    bound = jnp.full(masked.shape[:-1], jnp.inf, masked.dtype)
     for _ in range(k):
+        x = jnp.where(masked < bound[:, None], masked, NEG)
         i = jnp.argmax(x, axis=-1)
-        vals.append(jnp.max(x, axis=-1))
+        v = jnp.max(x, axis=-1)
+        vals.append(v)
         idxs.append(i.astype(jnp.int32))
-        x = jnp.where(lanes == i[:, None], NEG, x)
+        bound = v
     return jnp.stack(vals, axis=-1), jnp.stack(idxs, axis=-1)
 
 

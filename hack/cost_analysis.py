@@ -14,7 +14,12 @@ prefix.match_scores (fused cumulative-AND + bit-sliced vertical counters,
 replacing lax.associative_scan + a [N,C,W,32] unpack) plus chunk-axis
 bucketing cut the full default cycle from 51.4 MB (~63 us HBM-bound on
 one v5e) to 30.5 MB (~37 us); the dual-form Sinkhorn iteration trimmed
-that picker from 60.8 to 58.5 MB.
+that picker from 60.8 to 58.5 MB. Round 5's threshold-descent topk
+(pickers._topk no longer rewrites the [N, M] operand between rounds)
+took the default cycle to 29.6 MB (~36 us) and the pd dual pick from
+48.6 to 44.5 MB; a merged evict+OR insert scatter was prototyped and
+REJECTED — row-level last-wins drops concurrent different-endpoint bits
+on shared chunk rows, exactly the common shared-prefix wave.
 """
 import jax
 
