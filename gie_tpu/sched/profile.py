@@ -208,9 +208,11 @@ def _pick_stage(
     state: SchedState,
     key: jax.Array,
     cfg: ProfileConfig,
-) -> PickResult:
+) -> tuple[PickResult, dict]:
     """The configured picker over one (total, mask) pair — shared by the
-    classic single pick and the dual prefill/decode picks."""
+    classic single pick and the dual prefill/decode picks. The aux dict
+    carries picker state to thread into SchedState (today: the sinkhorn
+    column duals for the warm start); empty for stateless pickers."""
     if cfg.picker == "topk" and cfg.use_pallas_topk:
         from gie_tpu.ops import interpret_default
         from gie_tpu.ops.fused_topk import fused_blend_topk
@@ -218,24 +220,27 @@ def _pick_stage(
         vals, idxs = fused_blend_topk(
             stacked, wvec, mask, k=C.FALLBACKS, interpret=interpret_default()
         )
-        return pickers.finalize_from_topk(vals, idxs, mask, shed, reqs.valid)
+        return pickers.finalize_from_topk(
+            vals, idxs, mask, shed, reqs.valid), {}
     if cfg.picker == "random":
         return pickers.weighted_random_picker(
             total, mask, shed, reqs.valid, key,
             temperature=cfg.sample_temperature,
-        )
+        ), {}
     if cfg.picker == "sinkhorn":
         from gie_tpu.sched.sinkhorn import sinkhorn_picker
 
-        return sinkhorn_picker(
+        res, v_out = sinkhorn_picker(
             total, mask, shed, reqs.valid, eps, key,
             queue_limit=cfg.queue_limit,
             tau=cfg.sinkhorn_tau,
             iters=cfg.sinkhorn_iters,
             rounding_temp=cfg.sinkhorn_rounding_temp,
             use_pallas=cfg.use_pallas_sinkhorn,
+            v0=state.ot_v,
         )
-    return pickers.topk_picker(total, mask, shed, reqs.valid, state.rr)
+        return res, {"ot_v": v_out}
+    return pickers.topk_picker(total, mask, shed, reqs.valid, state.rr), {}
 
 
 def scheduling_cycle(
@@ -263,7 +268,7 @@ def scheduling_cycle(
         )
 
     # ---- Pick stage ------------------------------------------------------
-    result = _pick_stage(
+    result, pick_aux = _pick_stage(
         total, stacked, wvec, mask, shed, reqs, eps, state, key, cfg)
 
     # ---- State update ----------------------------------------------------
@@ -285,6 +290,7 @@ def scheduling_cycle(
         assumed_load=new_load,
         rr=state.rr + jnp.uint32(1),
         tick=state.tick + jnp.uint32(1),
+        ot_v=pick_aux.get("ot_v", state.ot_v),
     )
     return result, new_state
 
@@ -318,7 +324,10 @@ def _pd_cycle(
     decode_ok = mask & (eps.role != C.Role.PREFILL)[None, :]
     key_p, key_d = jax.random.split(key)
 
-    p_res = _pick_stage(
+    # pd runs two solves over different candidate masks; neither updates
+    # the carried sinkhorn dual (cross-contaminating one shared vector
+    # with two different capacity patterns would poison both warm starts).
+    p_res, _ = _pick_stage(
         total, stacked, wvec, prefill_ok, shed, reqs, eps, state, key_p, cfg)
     p_primary = p_res.indices[:, 0]
 
@@ -371,7 +380,7 @@ def _pd_cycle(
         dataclasses.replace(cfg, use_pallas_topk=False)
         if cfg.use_pallas_topk else cfg
     )
-    d_res = _pick_stage(
+    d_res, _ = _pick_stage(
         d_total, stacked, d_wvec, decode_ok, shed, reqs, eps, state, key_d,
         d_cfg)
     d_primary = d_res.indices[:, 0]
@@ -411,6 +420,7 @@ def _pd_cycle(
         assumed_load=new_load,
         rr=state.rr + jnp.uint32(1),
         tick=state.tick + jnp.uint32(1),
+        ot_v=state.ot_v,
     )
     result = PickResult(
         indices=d_res.indices,
@@ -482,12 +492,14 @@ class Scheduler:
             donate_argnums=0,
         )
         self._evict = jax.jit(
-            # Clear the slot's prefix columns AND its assumed load: the
-            # endpoint (and its queue) is gone, and a reused slot must not
-            # inherit the previous owner's charge.
+            # Clear the slot's prefix columns, its assumed load, AND its
+            # sinkhorn dual: the endpoint (and its queue) is gone, and a
+            # reused slot must not inherit the previous owner's charge or
+            # capacity pressure.
             lambda st, slot: st.replace(
                 prefix=prefix.clear_endpoint(st.prefix, slot),
                 assumed_load=st.assumed_load.at[slot].set(0.0),
+                ot_v=st.ot_v.at[slot].set(1.0),
             ),
             donate_argnums=0,
         )

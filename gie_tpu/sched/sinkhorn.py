@@ -23,12 +23,28 @@ the problem feasible (best-effort overflow still lands somewhere).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.pickers import NEG, _finalize
 from gie_tpu.sched.types import EndpointBatch, PickResult
+
+
+def _headroom(eps: EndpointBatch, queue_limit: float) -> jax.Array:
+    """Raw per-endpoint free capacity (queue room x kv room, zero on
+    invalid slots) -> f32[M]. Single source for BOTH the wave caps and
+    the warm-start utilization gate — the gate must measure exactly the
+    quantity the caps are built from, or a tuning change to one silently
+    desynchronizes the other."""
+    queue = eps.metrics[:, C.Metric.QUEUE_DEPTH]
+    kv = eps.metrics[:, C.Metric.KV_CACHE_UTIL]
+    headroom = jnp.clip(queue_limit - queue, 0.0, queue_limit) * jnp.clip(
+        1.0 - kv, 0.05, 1.0
+    )
+    return jnp.where(eps.valid, headroom, 0.0)
 
 
 def capacities(
@@ -38,12 +54,8 @@ def capacities(
     EFFECTIVE request mass (valid, candidate-bearing rows — padded bucket
     rows carry no transport mass and must not inflate the caps, or small
     waves never bind them and the picker degenerates to argmax)."""
-    queue = eps.metrics[:, C.Metric.QUEUE_DEPTH]
-    kv = eps.metrics[:, C.Metric.KV_CACHE_UTIL]
-    headroom = jnp.clip(queue_limit - queue, 0.0, queue_limit) * jnp.clip(
-        1.0 - kv, 0.05, 1.0
-    )
-    headroom = jnp.where(eps.valid, headroom + 1e-3, 0.0)
+    headroom = jnp.where(
+        eps.valid, _headroom(eps, queue_limit) + 1e-3, 0.0)
     total = jnp.maximum(jnp.sum(headroom), 1e-6)
     return headroom * (n_requests / total) * 1.25  # 25% slack for feasibility
 
@@ -61,7 +73,8 @@ def sinkhorn_picker(
     iters: int,
     rounding_temp: float,
     use_pallas: bool = False,
-) -> PickResult:
+    v0: Optional[jax.Array] = None,  # f32[M] last wave's column duals
+) -> tuple[PickResult, jax.Array]:
     # Effective transport mass: valid rows that still have candidates
     # (padded rows and empty-subset rows contribute nothing).
     n_eff = jnp.maximum(
@@ -74,13 +87,42 @@ def sinkhorn_picker(
     row_max = jnp.where(jnp.isfinite(row_max), row_max, 0.0)
     k = jnp.where(mask, jnp.exp((scores - row_max) / tau), 0.0)
 
+    # Warm start (round 5): column duals are per-endpoint capacity
+    # pressure and traffic is wave-stable, so last wave's v is a better
+    # prior than ones — but only insofar as the fleet is actually
+    # LOADED. Caps are normalized to the wave mass (see capacities), so
+    # they bind even on an idle fleet; carrying duals there splits
+    # sessions off their warm home for no latency benefit (hit 0.866 ->
+    # 0.847 at the 75 qps point before this gate existed). Scale the
+    # retention exponent by fleet utilization u (1 - free queue x kv
+    # headroom / idle headroom): idle -> v^0 = ones (cold start),
+    # saturated -> v^0.5 (the sqrt blend that swept best contended —
+    # within one solve v only ever decreases, so a raw carry would
+    # collapse toward 0 over waves; the fractional power lets pressure
+    # decay while persistent binding re-sharpens every wave).
+    if v0 is None:
+        v_init = jnp.ones(k.shape[1:], jnp.float32)
+    else:
+        free = _headroom(eps, queue_limit)
+        idle_free = queue_limit * jnp.maximum(
+            jnp.sum(eps.valid.astype(jnp.float32)), 1.0)
+        u = jnp.clip(1.0 - jnp.sum(free) / idle_free, 0.0, 1.0)
+        v_init = jnp.clip(v0, 1e-6, 1.0) ** (0.5 * u)
+
     if use_pallas:
-        # VMEM-resident iteration loop (one HBM write for the whole solve).
+        # VMEM-resident iteration loop (one HBM write for the whole
+        # solve). The kernel solves from cold; the carried dual is left
+        # untouched (returned as given) rather than reset, so flipping
+        # the flag mid-run cannot wipe the XLA path's learned pressure.
         from gie_tpu.ops import interpret_default
         from gie_tpu.ops.fused_sinkhorn import fused_sinkhorn_plan
 
         plan = fused_sinkhorn_plan(
             k, cap, iters=iters, interpret=interpret_default())
+        # The carried dual passes through UNTRANSFORMED: storing v_init
+        # (v0 ** (0.5*u) < 1 exponent) every wave would monotonically
+        # decay the learned pressure toward ones without ever solving.
+        v_out = v_init if v0 is None else v0
     else:
         # DUAL-FORM iterations: the iterates of row-normalize-then-
         # column-cap compose into p_t = diag(u_t) K diag(v_t), so the
@@ -102,8 +144,7 @@ def sinkhorn_picker(
 
         (u, v), _ = jax.lax.scan(
             body,
-            (jnp.ones(k.shape[:1], jnp.float32),
-             jnp.ones(k.shape[1:], jnp.float32)),
+            (jnp.ones(k.shape[:1], jnp.float32), v_init),
             None, length=iters,
         )
         plan = k * u[:, None] * v[None, :]
@@ -111,6 +152,7 @@ def sinkhorn_picker(
         # distribution even where capacity clipped it.
         row = jnp.sum(plan, axis=1, keepdims=True)
         plan = jnp.where(row > 0, plan / row, plan)
+        v_out = v
 
     # Rounding: argmax of identical fractional rows would herd the whole
     # wave onto one endpoint again, so Gumbel noise (scaled by
@@ -120,4 +162,4 @@ def sinkhorn_picker(
     # goodput sweep preferred over true proportional rounding (temp=1).
     g = jax.random.gumbel(key, plan.shape, jnp.float32) * rounding_temp
     masked = jnp.where(mask & (plan > 0), jnp.log(plan + 1e-20) + g, NEG)
-    return _finalize(masked, mask, shed, valid)
+    return _finalize(masked, mask, shed, valid), v_out
