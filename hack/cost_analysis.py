@@ -17,8 +17,11 @@ one v5e) to 30.5 MB (~37 us); the dual-form Sinkhorn iteration trimmed
 that picker from 60.8 to 58.5 MB. Round 5's threshold-descent topk
 (pickers._topk no longer rewrites the [N, M] operand between rounds)
 took the default cycle to 29.6 MB (~36 us) and the pd dual pick from
-48.6 to 44.5 MB; a merged evict+OR insert scatter was prototyped and
-REJECTED — row-level last-wins drops concurrent different-endpoint bits
+48.6 to 44.5 MB; aligning the measurement with production donation
+semantics (the live Scheduler donates the state, so scatters update in
+place) puts the honest numbers at 27.5 MB default / 42.4 pd / 55.5
+sinkhorn (~33.6 us default). A merged evict+OR insert scatter was
+prototyped and REJECTED — row-level last-wins drops concurrent different-endpoint bits
 on shared chunk rows, exactly the common shared-prefix wave.
 """
 import jax
