@@ -18,19 +18,8 @@ Prints one JSON line (min ratio across the sweep); table to stderr.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import sys
-
-
-def _force_platform() -> None:
-    import os
-
-    import jax
-
-    platform = os.environ.get("GIE_GOODPUT_PLATFORM", "cpu")
-    jax.config.update("jax_platforms", platform)
-
 
 # (name, StubConfig overrides, run() overrides, qps multiplier).
 #
@@ -65,6 +54,8 @@ VARIANTS = [
 
 
 def main() -> None:
+    from bench_goodput import _force_platform
+
     _force_platform()
     from gie_tpu.simulator import StubConfig
     from gie_tpu.simulator.cluster import (
@@ -102,7 +93,9 @@ def main() -> None:
         ratio = tpu.goodput_tokens_per_s / max(
             adv.goodput_tokens_per_s, 1e-9)
         rows.append((name, adv, tpu, ratio))
-        qps_note = f" @{100.0 * qps_mult:.0f}qps" if qps_mult != 1.0 else ""
+        qps_note = (
+            f" @{HEADLINE_WORKLOAD['arrival_qps'] * qps_mult:.0f}qps"
+            if qps_mult != 1.0 else "")
         print(
             f"{name:16s} adv={adv.goodput_tokens_per_s:7.1f} "
             f"tpu={tpu.goodput_tokens_per_s:7.1f} tok/s  "

@@ -42,11 +42,13 @@ def _topk(masked: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     1024x256, bit-identical picks).
 
     Requires pairwise-distinct in-row values to enumerate ties as separate
-    entries — true for every caller (topk_picker's rotation makes equal
-    scores distinct; the sinkhorn/random paths add continuous Gumbel
-    noise). An exact float tie would skip the duplicate lane (its entry
-    gated at NEG, i.e. a shorter fallback list); the primary pick is the
-    true argmax regardless.
+    entries — true for every caller: topk_picker's rotation makes equal
+    scores distinct, and the sinkhorn/random paths add continuous Gumbel
+    noise whose temperature ProfileConfig validates as strictly positive
+    (a zero temperature would permit exact ties). An exact float tie
+    would skip the duplicate lane (its entry gated at NEG, i.e. a
+    shorter fallback list); the primary pick is the true argmax
+    regardless.
     """
     vals, idxs = [], []
     bound = jnp.full(masked.shape[:-1], jnp.inf, masked.dtype)

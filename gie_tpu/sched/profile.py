@@ -30,6 +30,7 @@ from gie_tpu.sched import filters, pickers, prefix, scorers
 from gie_tpu.sched.types import (
     EndpointBatch,
     PickResult,
+    PrefixTable,
     RequestBatch,
     SchedState,
     Weights,
@@ -89,6 +90,23 @@ class ProfileConfig:
     # VMEM-resident pallas loop for the sinkhorn iterations (same default-
     # off rationale).
     use_pallas_sinkhorn: bool = False
+
+    def __post_init__(self) -> None:
+        # The noise temperatures are what guarantee pairwise-distinct
+        # in-row scores for the random/sinkhorn pickers — the property
+        # the threshold-descent top-k needs to enumerate ties as separate
+        # fallback entries (pickers._topk). Zero would silently truncate
+        # fallback lists under exact ties; reject it at config time.
+        if self.sample_temperature <= 0.0:
+            raise ValueError(
+                f"sample_temperature must be > 0 (got "
+                f"{self.sample_temperature}): zero noise permits exact "
+                "score ties, which truncate the ordered fallback list")
+        if self.sinkhorn_rounding_temp <= 0.0:
+            raise ValueError(
+                f"sinkhorn_rounding_temp must be > 0 (got "
+                f"{self.sinkhorn_rounding_temp}): zero noise permits "
+                "exact score ties, which truncate the fallback list")
 
 
 def request_cost(reqs: RequestBatch) -> jax.Array:
@@ -715,7 +733,7 @@ class Scheduler:
         save_pytree(directory, host_state)
 
     def restore_state(self, directory: str) -> bool:
-        from gie_tpu.utils.checkpoint import restore_pytree
+        from gie_tpu.utils.checkpoint import restore_pytree, restore_pytree_raw
 
         # The saved state was laid out for whichever M bucket was live at
         # save time; try each template until one round-trips. The next
@@ -728,7 +746,38 @@ class Scheduler:
                 break
             restored = None
         if restored is None:
-            return False
+            # Legacy layout: a checkpoint written before a SchedState
+            # field existed fails the template restore above. Recover the
+            # raw field dict and fill defaults for whatever is missing
+            # (today: ot_v, round 5) — losing the prefix affinity the
+            # checkpoint exists to preserve just because a new field
+            # appeared would defeat warm restarts on every upgrade.
+            raw = restore_pytree_raw(directory)
+            if (not isinstance(raw, dict)
+                    or "assumed_load" not in raw
+                    or "prefix" not in raw):
+                return False
+            try:
+                load = jnp.asarray(raw["assumed_load"], jnp.float32)
+                m = int(load.shape[0])
+                if m not in C.M_BUCKETS:
+                    return False
+                px = raw["prefix"]
+                restored = SchedState(
+                    prefix=PrefixTable(
+                        keys=jnp.asarray(px["keys"], jnp.uint32),
+                        present=jnp.asarray(px["present"], jnp.uint32),
+                        ages=jnp.asarray(px["ages"], jnp.uint32),
+                    ),
+                    assumed_load=load,
+                    rr=jnp.asarray(raw["rr"], jnp.uint32),
+                    tick=jnp.asarray(raw["tick"], jnp.uint32),
+                    ot_v=(jnp.asarray(raw["ot_v"], jnp.float32)
+                          if "ot_v" in raw
+                          else jnp.ones((m,), jnp.float32)),
+                )
+            except (KeyError, TypeError, ValueError):
+                return False
         with self._lock:
             self.state = restored
         return True

@@ -36,3 +36,20 @@ def restore_pytree(directory: str, template):
             return ckptr.restore(path, item=template)
     except Exception:
         return None
+
+
+def restore_pytree_raw(directory: str):
+    """Restore WITHOUT a template: returns the checkpoint's own nested
+    dict (field-name keyed), or None when missing/unreadable. The
+    migration hook for checkpoints whose saved structure predates a new
+    state field — the caller inspects the dict and fills defaults."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(directory)
+    if not os.path.isdir(path):
+        return None
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            return ckptr.restore(path)
+    except Exception:
+        return None

@@ -23,22 +23,13 @@ import json
 import sys
 
 
-def _force_platform() -> None:
-    import os
-
-    import jax
-
-    jax.config.update(
-        "jax_platforms", os.environ.get("GIE_GOODPUT_PLATFORM", "cpu"))
-
-
-def run_fleet(fleet_name, cfgs, with_column, seed=0, duration=20.0,
+def run_fleet(fleet_name, cfgs, with_column, seed=0, duration=None,
               wl_over=None):
     import os
     import sys as _sys
 
     _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-    from bench_goodput import HEADLINE_WORKLOAD
+    from bench_goodput import HEADLINE_DURATION_S, HEADLINE_WORKLOAD
     from gie_tpu.simulator.cluster import (
         SimCluster,
         WorkloadConfig,
@@ -50,6 +41,7 @@ def run_fleet(fleet_name, cfgs, with_column, seed=0, duration=20.0,
     # what this experiment perturbs (wl_over builds the cache-affinity-free
     # variant).
     wl = WorkloadConfig(**{**HEADLINE_WORKLOAD, **(wl_over or {})})
+    duration = HEADLINE_DURATION_S if duration is None else duration
     cluster = SimCluster(n_pods=len(cfgs), stub_cfg=cfgs, seed=seed)
     kwargs = {}
     sched = tuned_scheduler()
@@ -90,12 +82,13 @@ def run_fleet(fleet_name, cfgs, with_column, seed=0, duration=20.0,
 
 
 def main() -> None:
-    _force_platform()
     import os
     import sys as _sys
 
     _sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-    from bench_goodput import HEADLINE_STUB
+    from bench_goodput import HEADLINE_STUB, _force_platform
+
+    _force_platform()
     from gie_tpu.simulator import StubConfig
 
     base = HEADLINE_STUB

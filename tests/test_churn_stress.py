@@ -150,6 +150,35 @@ def test_fleet_grows_past_512_then_past_m_max():
         picker.close()
 
 
+def test_legacy_checkpoint_without_ot_v_restores(tmp_path):
+    """A warm-restart checkpoint written BEFORE the round-5 ot_v field
+    must still restore (affinity preserved; the missing dual defaults to
+    cold ones) — upgrades must not silently cold-start the scheduler."""
+    import numpy as np
+
+    from gie_tpu.sched.types import SchedState
+    from gie_tpu.utils.checkpoint import save_pytree
+
+    st = SchedState.init(m=64)
+    st = st.replace(assumed_load=st.assumed_load.at[3].set(7.5))
+    legacy = {  # exactly the pre-ot_v field set
+        "prefix": {"keys": np.asarray(st.prefix.keys),
+                   "present": np.asarray(st.prefix.present),
+                   "ages": np.asarray(st.prefix.ages)},
+        "assumed_load": np.asarray(st.assumed_load),
+        "rr": np.asarray(st.rr),
+        "tick": np.asarray(st.tick),
+    }
+    ckpt = str(tmp_path / "legacy-state")
+    save_pytree(ckpt, legacy)
+
+    s = Scheduler(ProfileConfig())
+    assert s.restore_state(ckpt)
+    assert float(s.state.assumed_load[3]) == 7.5
+    assert s.state.m == 64
+    assert (np.asarray(s.state.ot_v) == 1.0).all()  # cold dual default
+
+
 def test_scheduler_state_checkpoint_roundtrip(tmp_path):
     """Warm-restart: prefix affinity survives a save/restore cycle."""
     from gie_tpu.sched import Weights
