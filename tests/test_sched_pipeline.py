@@ -341,6 +341,55 @@ def test_pallas_fused_topk_matches_default_path():
     assert (np.asarray(r_ref.status) == np.asarray(r_pl.status)).all()
 
 
+def test_sinkhorn_warm_start_inert_on_idle_fleet():
+    """The utilization gate (round 5): on an IDLE fleet the carried
+    column duals must not change picks — caps bind even at idle (they
+    are normalized to wave mass), so an ungated carry would split
+    sessions off warm endpoints for no latency benefit. A LOADED fleet
+    must actually use the prior (v_out differs from a cold solve)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gie_tpu.sched.sinkhorn import sinkhorn_picker
+
+    rng = np.random.default_rng(3)
+    n, m_live = 32, 6
+
+    def pick(eps, v0):
+        scores = jnp.asarray(
+            rng.uniform(0, 1, (n, eps.valid.shape[0])).astype(np.float32))
+        mask = jnp.broadcast_to(eps.valid[None, :], scores.shape)
+        res, v_out = sinkhorn_picker(
+            scores, mask, jnp.zeros((n,), bool), jnp.ones((n,), bool),
+            eps, jax.random.PRNGKey(0),
+            queue_limit=128.0, tau=0.02, iters=8, rounding_temp=0.05,
+            v0=v0)
+        return np.asarray(res.indices), np.asarray(v_out)
+
+    # Idle fleet: zero queues, zero kv -> utilization ~0 -> v0^0 = ones.
+    idle = make_endpoints(m_live, queue=[0] * m_live, kv=[0.0] * m_live,
+                          m_slots=64)
+    biased = jnp.ones((64,), jnp.float32).at[0].set(1e-3)
+    rng = np.random.default_rng(3)
+    cold_idx, _ = pick(idle, None)
+    rng = np.random.default_rng(3)
+    warm_idx, _ = pick(idle, biased)
+    assert (cold_idx == warm_idx).all(), (
+        "carried duals changed picks on an idle fleet — the utilization "
+        "gate is not neutralizing the prior")
+
+    # Loaded fleet: deep queues / high kv -> the prior must be live
+    # (the solve starts from a genuinely different v_init).
+    loaded = make_endpoints(
+        m_live, queue=[120] * m_live, kv=[0.9] * m_live, m_slots=64)
+    rng = np.random.default_rng(3)
+    _, v_cold = pick(loaded, None)
+    rng = np.random.default_rng(3)
+    _, v_warm = pick(loaded, biased)
+    assert not np.allclose(v_cold, v_warm), (
+        "loaded-fleet solve ignored the carried duals entirely")
+
+
 def test_pallas_sinkhorn_matches_reference_path():
     """The VMEM-resident sinkhorn loop (interpret mode on CPU) must agree
     with the lax.scan reference to float tolerance — identical picks on
