@@ -464,6 +464,49 @@ def main() -> None:
     except Exception as e:  # diagnostics only
         _log(f"stage split skipped: {type(e).__name__}: {e}")
 
+    # Host-path pipeline detail (ISSUE 1; stderr only, guarded): the
+    # vectorized wave-assembly cost and the implied dispatch/compute
+    # overlap. With the two-stage collector, steady-state device
+    # occupancy = cycle / max(assembly, cycle): occupancy 1.0 means the
+    # host keeps the TPU fed; < 1.0 means assembly is the bottleneck.
+    try:
+        from types import SimpleNamespace
+
+        from gie_tpu.extproc.server import PickRequest
+        from gie_tpu.sched.batching import _Pending, assemble_wave
+        from gie_tpu.utils.lora import LoraRegistry
+
+        cands = [SimpleNamespace(slot=j) for j in range(m)]
+        items = [
+            _Pending(
+                PickRequest(
+                    headers={}, body=prompts[i],
+                    model="adapter-%d" % (i % 12) if i % 3 else "",
+                    decode_tokens=float(i % 200),
+                ),
+                cands,
+            )
+            for i in range(n)
+        ]
+        reg = LoraRegistry()
+        assemble_wave(items, m, reg)  # warm numpy/jax dispatch paths
+        asm = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            assemble_wave(items, m, reg)
+            asm.append(time.perf_counter() - t0)
+        host_assembly_us = float(np.percentile(np.asarray(asm) * 1e6, 50))
+        pipeline_occupancy = min(1.0, p50 / max(host_assembly_us, 1e-9))
+        _log(
+            f"pipeline: host_assembly_us={host_assembly_us:.1f} "
+            f"pipeline_occupancy={pipeline_occupancy:.2f} "
+            f"(assembly of a {n}x{m} wave vs the {p50:.1f}us cycle; "
+            "occupancy = device-busy fraction when the two-stage collector "
+            "overlaps assembly with the cycle, docs/PIPELINE.md)"
+        )
+    except Exception as e:  # diagnostics only
+        _log(f"pipeline detail skipped: {type(e).__name__}: {e}")
+
     # Synchronous single-cycle round trip (includes host<->device latency +
     # tunnel RTT) — context only.
     try:

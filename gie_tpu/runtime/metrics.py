@@ -41,6 +41,35 @@ QUEUE_SHED = prom.Counter(
     ["reason", "band"],  # reason: depth|evicted|age
     registry=REGISTRY,
 )
+HOST_ASSEMBLY = prom.Histogram(
+    "gie_host_assembly_seconds",
+    "Pipeline stage-1 host work per wave: queue-drain decisions, vectorized "
+    "column assembly, and the async cycle dispatch (docs/PIPELINE.md)",
+    buckets=(1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1),
+    registry=REGISTRY,
+)
+DEVICE_WAIT = prom.Histogram(
+    "gie_device_wait_seconds",
+    "Pipeline stage-2 wait per wave: async dispatch until the device "
+    "results materialize on the host (the overlap window the two-stage "
+    "collector hides behind the next wave's assembly)",
+    buckets=(1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1),
+    registry=REGISTRY,
+)
+PIPELINE_DEPTH = prom.Gauge(
+    "gie_pipeline_waves_in_flight",
+    "Waves dispatched to the device but not yet fanned out (bounded by the "
+    "collector's pipeline depth); >0 under load means the overlap is live",
+    registry=REGISTRY,
+)
+PIPELINE_WAVES = prom.Counter(
+    "gie_pipeline_waves_total",
+    "Waves through the two-stage collector. Occupancy over a window = "
+    "rate(gie_device_wait_seconds_sum) /"
+    " (rate(gie_device_wait_seconds_sum) + dispatcher idle time); the "
+    "per-wave histograms above give both terms",
+    registry=REGISTRY,
+)
 SLOT_OVERFLOW = prom.Gauge(
     "gie_endpoint_slot_overflow_total",
     "Endpoint admissions refused because every scheduler slot (M_MAX) was "

@@ -14,7 +14,12 @@ from gie_tpu.sched.constants import (
     Metric,
     Status,
 )
-from gie_tpu.sched.profile import ProfileConfig, Scheduler, scheduling_cycle
+from gie_tpu.sched.profile import (
+    PendingWave,
+    ProfileConfig,
+    Scheduler,
+    scheduling_cycle,
+)
 from gie_tpu.sched.types import (
     EndpointBatch,
     PickResult,
@@ -33,6 +38,7 @@ __all__ = [
     "Criticality",
     "Metric",
     "Status",
+    "PendingWave",
     "ProfileConfig",
     "Scheduler",
     "scheduling_cycle",

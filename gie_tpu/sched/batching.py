@@ -9,10 +9,19 @@ concurrent Process threads enqueue here; a collector thread drains the queue
 every `max_wait_s` (or at `max_batch`) and runs ONE jitted scheduling cycle
 for the whole wave — decoupling stream cadence from batch cadence
 (SURVEY.md section 7.4 "latency discipline across the Go<->TPU boundary").
+
+The collector is a TWO-STAGE pipeline (docs/PIPELINE.md): the dispatcher
+drains the queue, assembles the wave with vectorized numpy column ops, and
+dispatches the cycle asynchronously (Scheduler.pick_async); a completer
+thread materializes results and fans them out. The device runs cycle k
+while the host assembles cycle k+1 — neither side idles waiting for the
+other, and a bounded in-flight depth caps the tail latency a dispatched
+wave can accumulate behind its predecessors.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Optional
@@ -59,20 +68,21 @@ def _band_for(headers: dict, registry=None) -> int:
                              int(C.Criticality.STANDARD))
 
 
-def _fair_order(items: list["_Pending"], registry=None) -> list["_Pending"]:
+def _fair_order(items: list["_Pending"]) -> list["_Pending"]:
     """Criticality bands first, round-robin by fairness ID within a band.
 
     Proposal 1199 scopes fairness within a priority band: CRITICAL drains
     before STANDARD before SHEDDABLE, and inside each band tenants
     (x-gateway-inference-fairness-id) interleave round-robin with per-tenant
-    FIFO preserved. O(n) via deques.
+    FIFO preserved. O(n) via deques. Bands come from the value CACHED on
+    each item at enqueue time — never a header re-parse per drain.
     """
     from collections import deque
 
     bands: dict[int, dict[str, deque]] = {}
     band_order: dict[int, list[str]] = {}
     for it in items:
-        band = _band_for(it.req.headers, registry)
+        band = it.band
         fid = it.req.headers.get(mdkeys.FLOW_FAIRNESS_ID_KEY, [""])[0]
         per = bands.setdefault(band, {})
         if fid not in per:
@@ -93,9 +103,9 @@ def _fair_order(items: list["_Pending"], registry=None) -> list["_Pending"]:
 
 class _Pending:
     __slots__ = ("req", "candidates", "event", "result", "error",
-                 "enqueued_at", "abandoned")
+                 "enqueued_at", "abandoned", "band", "cand_slots")
 
-    def __init__(self, req: PickRequest, candidates: list):
+    def __init__(self, req: PickRequest, candidates: list, band: Optional[int] = None):
         self.req = req
         self.candidates = candidates
         self.event = threading.Event()
@@ -106,6 +116,99 @@ class _Pending:
         # the item rather than schedule it — a scheduled pick charges assumed
         # load that no served feedback will ever release.
         self.abandoned = False
+        # Criticality band resolved ONCE, at enqueue (it was re-derived
+        # with a header parse up to 4x per request: fair ordering, the
+        # queue-age shed, the hold check, and wave assembly). pick()
+        # resolves through the objective registry; direct constructions
+        # (tests, benchmarks) fall back to literal band names.
+        self.band = _band_for(req.headers) if band is None else band
+        # Candidate slot ids as a dense vector: wave assembly and the hold
+        # check index numpy arrays instead of iterating endpoint objects.
+        self.cand_slots = np.fromiter(
+            (getattr(ep, "slot", -1) for ep in candidates),
+            np.int64, len(candidates))
+
+
+def assemble_wave(
+    batch: list["_Pending"], mb: int, lora_registry: LoraRegistry
+) -> tuple[RequestBatch, np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized host assembly of one wave: numpy COLUMN ops over the
+    pending items, not a per-request Python loop (the old path iterated
+    the batch once per column and the candidate list once per request —
+    ~N*M Python-level operations on the hottest host path in the repo).
+
+    Returns (RequestBatch, plen, dlen, lora): the device-ready wave plus
+    the host columns the completer's fan-out re-reads (costs, feedback).
+    """
+    n = len(batch)
+    prompts = [it.req.body or b"" for it in batch]
+    hashes, counts = batch_chunk_hashes(prompts)
+    # Chunk-axis bucket: short-prompt waves run 8/16 prefix lanes per
+    # request instead of MAX_CHUNKS (the cycle is shape-polymorphic
+    # in C; lanes beyond a request's n_chunks were masked anyway).
+    cb = chunk_bucket_for(int(counts.max()) if n else 1)
+    hashes = hashes[:, :cb]
+    # LoRA ids: one registry lookup (lock acquisition) per DISTINCT model.
+    # Dict insertion order = first occurrence, so new-adapter id assignment
+    # matches the old per-item loop exactly.
+    ids = {it.req.model: -1 for it in batch}
+    for name in ids:
+        ids[name] = lora_registry.id_for(name)
+    lora = np.fromiter((ids[it.req.model] for it in batch), np.int32, n)
+    crit = np.fromiter((it.band for it in batch), np.int32, n)
+    plen = np.fromiter((len(p) for p in prompts), np.float32, n)
+    # Decode-length hint per request (types.py RequestBatch.decode_len,
+    # in prompt-char-equivalents): the transport's token hint (decode-
+    # tokens header or the body's max_tokens cap, extproc/server.py
+    # _decode_tokens) scaled by CHARS_PER_TOKEN. Charge and release
+    # share this one array: the device cycle charges from the
+    # RequestBatch value and every host-side release derives from the
+    # same dlen, so the hint cannot desync accounting.
+    dlen = np.float32(C.CHARS_PER_TOKEN) * np.fromiter(
+        (it.req.decode_tokens or 0.0 for it in batch), np.float32, n)
+    # Subset mask via one flat scatter: rows repeated by candidate count,
+    # columns from the cached per-item slot vectors.
+    n_cands = np.fromiter((it.cand_slots.size for it in batch), np.intp, n)
+    rows = np.repeat(np.arange(n), n_cands)
+    cols = (np.concatenate([it.cand_slots for it in batch])
+            if n else np.zeros((0,), np.int64))
+    ok = (cols >= 0) & (cols < mb)
+    mask = np.zeros((n, mb), bool)
+    mask[rows[ok], cols[ok]] = True
+
+    reqs = RequestBatch(
+        valid=jnp.ones((n,), bool),
+        lora_id=jnp.asarray(lora),
+        criticality=jnp.asarray(crit),
+        prompt_len=jnp.asarray(plen),
+        decode_len=jnp.asarray(dlen),
+        chunk_hashes=jnp.asarray(hashes),
+        n_chunks=jnp.asarray(counts),
+        subset_mask=jnp.asarray(mask),
+    )
+    return reqs, plen, dlen, lora
+
+
+class _Wave:
+    """One dispatched wave in flight between dispatcher and completer."""
+
+    __slots__ = ("batch", "pending", "endpoints", "eps_metrics",
+                 "plen", "dlen", "lora")
+
+    def __init__(self, batch, pending, endpoints, eps_metrics,
+                 plen, dlen, lora):
+        self.batch = batch            # list[_Pending], waiters to wake
+        self.pending = pending        # profile.PendingWave (device arrays)
+        self.endpoints = endpoints    # datastore endpoints at dispatch time
+        self.eps_metrics = eps_metrics  # wave's metrics tensor (trainer rows)
+        self.plen = plen
+        self.dlen = dlen
+        self.lora = lora
+
+
+# Sentinel the dispatcher pushes on close(): the completer drains every
+# wave queued BEFORE it, then exits — in-flight picks complete, never hang.
+_CLOSE = object()
 
 
 class BatchingTPUPicker:
@@ -127,6 +230,7 @@ class BatchingTPUPicker:
         pick_timeout_s: float = 60.0,
         queue_bound: int = 0,
         queue_max_age_s: float = 0.0,
+        pipeline_depth: int = 2,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
@@ -180,8 +284,20 @@ class BatchingTPUPicker:
         self._pending: list[_Pending] = []
         self._cond = threading.Condition()
         self._closed = False
+        # Two-stage pipeline (docs/PIPELINE.md): the dispatcher assembles
+        # and async-dispatches waves; the completer materializes and fans
+        # out. The bounded queue is the backpressure seam — depth ~2 keeps
+        # the device fed (one wave running, one queued behind it) without
+        # letting a slow consumer stack unbounded tail latency onto every
+        # wave dispatched behind it.
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        self._waves: queue.Queue = queue.Queue(maxsize=pipeline_depth)
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
+        self._completer = threading.Thread(
+            target=self._completer_loop, daemon=True)
+        self._completer.start()
 
     # -- EndpointPicker interface -----------------------------------------
 
@@ -189,12 +305,24 @@ class BatchingTPUPicker:
         if not candidates:
             # Strict subsetting / no ready endpoints (004 README:77-79).
             raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "no endpoints available")
-        item = _Pending(req, candidates)
+        try:
+            band = _band_for(req.headers, self.objective_registry)
+        except Exception as e:
+            # Band resolution happens ONCE, here at enqueue (the cached
+            # value feeds fair ordering, the age shed, the hold check, and
+            # assembly). A malformed objective header therefore fails THIS
+            # request at its own call site — it can no longer poison the
+            # collector's pre-batch section and take the whole queue down
+            # with it.
+            raise ExtProcError(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"malformed objective header: {type(e).__name__}: {e}")
+        item = _Pending(req, candidates, band=band)
         with self._cond:
             if self._closed:
                 raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "picker shut down")
             if self.queue_bound > 0 and len(self._pending) >= self.queue_bound:
-                self._admit_into_full_queue(req)
+                self._admit_into_full_queue(band)
             self._pending.append(item)
             own_metrics.QUEUE_DEPTH.set(len(self._pending))
             self._cond.notify()
@@ -212,22 +340,21 @@ class BatchingTPUPicker:
         assert item.result is not None
         return item.result
 
-    def _admit_into_full_queue(self, req: PickRequest) -> None:
+    def _admit_into_full_queue(self, band: int) -> None:
         """Overload policy for a full flow-control queue (caller holds the
         lock): free a slot by dropping an abandoned waiter if one exists,
         else evict the newest waiter in the lowest-criticality band present
         (which must be strictly lower than the arrival's; it sheds with 429
         — within-band FIFO is preserved, and a band never evicts itself),
-        else shed the arrival. Raises ShedError when the arrival loses."""
+        else shed the arrival. Raises ShedError when the arrival loses.
+        `band` is the arrival's already-resolved criticality band."""
         for i in range(len(self._pending) - 1, -1, -1):
             if self._pending[i].abandoned:
                 del self._pending[i]
                 return
-        band = _band_for(req.headers, self.objective_registry)
         worst_i, worst_band = -1, band
         for i in range(len(self._pending) - 1, -1, -1):
-            b = _band_for(self._pending[i].req.headers,
-                          self.objective_registry)
+            b = self._pending[i].band
             if b > worst_band:
                 worst_i, worst_band = i, b
                 if b == int(C.Criticality.SHEDDABLE):
@@ -341,6 +468,34 @@ class BatchingTPUPicker:
             self._closed = True
             self._cond.notify()
         self._worker.join(timeout=5)
+        # DRAIN, don't abandon: every wave the dispatcher already pushed
+        # still materializes and wakes its waiters before the completer
+        # exits — the sentinel is FIFO-ordered behind the in-flight work.
+        try:
+            self._waves.put(_CLOSE, timeout=5)
+        except queue.Full:
+            pass  # completer wedged; it is a daemon thread
+        self._completer.join(timeout=5)
+        if not self._completer.is_alive():
+            # A dispatcher that outlived its join (wedged in a first-use
+            # jit compile) can push a wave AFTER the sentinel — the
+            # completer has already exited, so nobody would ever
+            # materialize it. Fail those waiters now rather than letting
+            # them hang to the pick timeout. (A merely-slow completer is
+            # still alive and keeps draining; only a dead one leaves
+            # orphans.)
+            while True:
+                try:
+                    wave = self._waves.get_nowait()
+                except queue.Empty:
+                    break
+                if wave is _CLOSE:
+                    continue
+                for item in wave.batch:
+                    if item.result is None and item.error is None:
+                        item.error = ExtProcError(
+                            grpc.StatusCode.UNAVAILABLE, "picker shut down")
+                    item.event.set()
 
     # -- collector ---------------------------------------------------------
 
@@ -365,9 +520,7 @@ class BatchingTPUPicker:
                         # cycle, interleave round-robin across fairness IDs
                         # (x-gateway-inference-fairness-id header, proposal
                         # 1199) so one tenant cannot monopolize a wave.
-                        self._pending = _fair_order(
-                            self._pending, self.objective_registry
-                        )
+                        self._pending = _fair_order(self._pending)
                     batch = self._pending[: self.max_batch]
                     self._pending = self._pending[self.max_batch :]
                     own_metrics.QUEUE_DEPTH.set(len(self._pending))
@@ -425,6 +578,11 @@ class BatchingTPUPicker:
         return self._m_bucket
 
     def _run_batch(self, batch: list[_Pending]) -> list["_Pending"]:
+        """Pipeline stage 1 (dispatcher): shed/hold decisions, vectorized
+        wave assembly, async cycle dispatch, handoff to the completer.
+        Returns the held items the collector should requeue. Blocks only
+        when `pipeline_depth` waves are already in flight — the bounded
+        queue is the backpressure seam that caps tail latency."""
         # Timed-out callers are gone: scheduling their items would charge
         # assumed load with no served feedback to ever release it.
         batch = [it for it in batch if not it.abandoned]
@@ -437,16 +595,15 @@ class BatchingTPUPicker:
             now = time.monotonic()
             kept: list[_Pending] = []
             for it in batch:
-                band = _band_for(it.req.headers, self.objective_registry)
                 if (
-                    band != int(C.Criticality.CRITICAL)
+                    it.band != int(C.Criticality.CRITICAL)
                     and now - it.enqueued_at > self.queue_max_age_s
                 ):
                     it.error = ShedError("queued beyond flow-control age bound")
                     it.event.set()
                     own_metrics.QUEUE_SHED.labels(
                         reason="age",
-                        band=_BAND_NAMES.get(band, "standard")).inc()
+                        band=_BAND_NAMES.get(it.band, "standard")).inc()
                 else:
                     kept.append(it)
             batch = kept
@@ -464,15 +621,12 @@ class BatchingTPUPicker:
             now = time.monotonic()
             runnable: list[_Pending] = []
             for it in batch:
-                band = _band_for(it.req.headers, self.objective_registry)
+                slots = it.cand_slots
+                slots = slots[(slots >= 0) & (slots < C.M_MAX)]
                 if (
-                    band != C.Criticality.CRITICAL
+                    it.band != C.Criticality.CRITICAL
                     and now - it.enqueued_at < self.hold_max_s
-                    and all(
-                        queues[ep.slot] >= self.hold_queue_limit
-                        for ep in it.candidates
-                        if 0 <= ep.slot < C.M_MAX
-                    )
+                    and bool(np.all(queues[slots] >= self.hold_queue_limit))
                 ):
                     held.append(it)
                 else:
@@ -480,62 +634,77 @@ class BatchingTPUPicker:
             batch = runnable
             if not batch:
                 return held
+        t0 = time.perf_counter()
         n = len(batch)
         endpoints = self.datastore.endpoints()
         mb = self._pick_m_bucket(endpoints)
-        prompts = [it.req.body or b"" for it in batch]
-        hashes, counts = batch_chunk_hashes(prompts)
-        # Chunk-axis bucket: short-prompt waves run 8/16 prefix lanes per
-        # request instead of MAX_CHUNKS (the cycle is shape-polymorphic
-        # in C; lanes beyond a request's n_chunks were masked anyway).
-        cb = chunk_bucket_for(int(counts.max()) if n else 1)
-        hashes = hashes[:, :cb]
-        lora = np.full((n,), -1, np.int32)
-        crit = np.full((n,), C.Criticality.STANDARD, np.int32)
-        plen = np.zeros((n,), np.float32)
-        # Decode-length hint per request (types.py RequestBatch.decode_len,
-        # in prompt-char-equivalents): the transport's token hint (decode-
-        # tokens header or the body's max_tokens cap, extproc/server.py
-        # _decode_tokens) scaled by CHARS_PER_TOKEN. Charge and release
-        # share this one array: the device cycle charges from the
-        # RequestBatch value and every host-side release below derives
-        # from the same dlen, so the hint cannot desync accounting.
-        dlen = np.zeros((n,), np.float32)
         own_metrics.BATCH_SIZE.observe(n)
-        mask = np.zeros((n, mb), bool)
-        for i, it in enumerate(batch):
-            lora[i] = self.lora_registry.id_for(it.req.model)
-            crit[i] = _band_for(it.req.headers, self.objective_registry)
-            plen[i] = float(len(prompts[i]))
-            dlen[i] = C.CHARS_PER_TOKEN * float(it.req.decode_tokens or 0.0)
-            for ep in it.candidates:
-                if 0 <= ep.slot < mb:
-                    mask[i, ep.slot] = True
-
-        reqs = RequestBatch(
-            valid=jnp.ones((n,), bool),
-            lora_id=jnp.asarray(lora),
-            criticality=jnp.asarray(crit),
-            prompt_len=jnp.asarray(plen),
-            decode_len=jnp.asarray(dlen),
-            chunk_hashes=jnp.asarray(hashes),
-            n_chunks=jnp.asarray(counts),
-            subset_mask=jnp.asarray(mask),
-        )
+        reqs, plen, dlen, lora = assemble_wave(batch, mb, self.lora_registry)
         eps = self.metrics_store.endpoint_batch(endpoints, m_slots=mb)
-        result = self.scheduler.pick(reqs, eps)
-        if self.trainer is not None:
-            # One bulk device->host transfer per wave, not one per request.
-            # Taken AFTER pick(): the state has been migrated to this
-            # wave's M bucket, so every picked slot is indexable (a
-            # pre-pick snapshot at the old width crashed on the first pick
-            # past a grow boundary) — and the simulator's feature twin
-            # snapshots post-schedule too, keeping the trained feature
-            # space identical.
-            load_snapshot = self.scheduler.snapshot_assumed_load()
-            metrics_np = np.asarray(eps.metrics)
+        # Async dispatch: the cycle is enqueued on the device stream and
+        # the host returns immediately — the snapshot_load copy replaces
+        # the old post-pick snapshot_assumed_load() (same post-schedule
+        # state; the copy is ordered after this cycle and before the next
+        # under the scheduler lock, and survives the next cycle's buffer
+        # donation).
+        pending = self.scheduler.pick_async(
+            reqs, eps, snapshot_load=self.trainer is not None)
+        own_metrics.HOST_ASSEMBLY.observe(time.perf_counter() - t0)
+        own_metrics.PIPELINE_DEPTH.inc()
+        own_metrics.PIPELINE_WAVES.inc()
+        self._waves.put(
+            _Wave(batch, pending, endpoints, eps.metrics, plen, dlen, lora))
+        return held
 
-        by_slot = {ep.slot: ep for ep in endpoints}
+    # -- completer (pipeline stage 2) --------------------------------------
+
+    def _completer_loop(self) -> None:
+        # Strictly dispatch-ordered (one thread, FIFO queue) and, like the
+        # dispatcher, it must NEVER die: a failure touches only its own
+        # wave's waiters, then the next wave is served regardless — device
+        # fault isolation at wave granularity.
+        while True:
+            wave = self._waves.get()
+            if wave is _CLOSE:
+                return
+            try:
+                self._complete_wave(wave)
+            except Exception as e:
+                for item in wave.batch:
+                    if item.result is None and item.error is None:
+                        # A fresh exception per waiter: handler threads
+                        # raise these concurrently, and a shared instance
+                        # would race on __traceback__/__context__.
+                        item.error = ExtProcError(
+                            grpc.StatusCode.INTERNAL,
+                            f"scheduler failure: {e}")
+                    item.event.set()
+            finally:
+                own_metrics.PIPELINE_DEPTH.dec()
+
+    def _complete_wave(self, wave: _Wave) -> None:
+        """Materialize one wave's device results and fan them out."""
+        batch, plen, dlen, lora = wave.batch, wave.plen, wave.dlen, wave.lora
+        t0 = time.perf_counter()
+        result = wave.pending.materialize()
+        own_metrics.DEVICE_WAIT.observe(time.perf_counter() - t0)
+        # One bulk device->host transfer per wave, not one per request.
+        # The load snapshot was captured on device right AFTER this wave's
+        # cycle: the state had been migrated to the wave's M bucket, so
+        # every picked slot is indexable (a pre-pick snapshot at the old
+        # width crashed on the first pick past a grow boundary) — and the
+        # simulator's feature twin snapshots post-schedule too, keeping
+        # the trained feature space identical. Guarded on the snapshot,
+        # not self.trainer: a trainer attached between dispatch and
+        # completion must not make the completer index a snapshot the
+        # dispatcher never requested.
+        load_snapshot = (
+            wave.pending.materialize_load()
+            if self.trainer is not None else None)
+        if load_snapshot is not None:
+            metrics_np = np.asarray(wave.eps_metrics)
+
+        by_slot = {ep.slot: ep for ep in wave.endpoints}
         indices = np.asarray(result.indices)
         status = np.asarray(result.status)
         # Disaggregated prefill/decode: the cycle's prefill picks (None in
@@ -591,7 +760,7 @@ class BatchingTPUPicker:
                         # else: the prefill pod vanished between the cycle
                         # and this wave — its eviction already cleared the
                         # slot's load, so there is nothing to release.
-                    if self.trainer is not None:
+                    if load_snapshot is not None:
                         slot = int(indices[i][0])
                         res.feedback = (
                             host_features(
@@ -615,7 +784,6 @@ class BatchingTPUPicker:
             if item.result is not None:
                 own_metrics.PICKS.labels(outcome="ok").inc()
             item.event.set()
-        return held
 
     def _slo_admission(self, batch: list[_Pending]) -> None:
         """Predictive SLO shedding (006 README:27-36 SLO dimension): after
@@ -644,8 +812,7 @@ class BatchingTPUPicker:
                 continue
             if slo_s <= 0:
                 continue
-            band = _band_for(item.req.headers, self.objective_registry)
-            if band == C.Criticality.CRITICAL:
+            if item.band == C.Criticality.CRITICAL:
                 continue
             features, slot, _, _ = item.result.feedback
             rows.append(features)

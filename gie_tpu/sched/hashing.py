@@ -85,9 +85,13 @@ def batch_chunk_hashes(
     hashes = np.zeros((n, max_chunks), np.uint32)
     counts = np.zeros((n,), np.int32)
     if _NATIVE is not None and n > 0:
+        # Vectorized offsets: cumulative prompt lengths, no Python loop
+        # (this runs per wave on the collector's hot host path).
         offsets = np.zeros((n + 1,), np.int64)
-        for i, p in enumerate(prompts):
-            offsets[i + 1] = offsets[i] + len(p)
+        np.cumsum(
+            np.fromiter((len(p) for p in prompts), np.int64, n),
+            out=offsets[1:],
+        )
         data = b"".join(prompts)
         _NATIVE(data, offsets, n, chunk_bytes, max_chunks, hashes, counts)
         return hashes, counts

@@ -449,6 +449,35 @@ def _pd_cycle(
     return result, new_state
 
 
+@dataclasses.dataclass
+class PendingWave:
+    """Handle to one async-dispatched scheduling cycle (the pipelined
+    collector's unit of work, docs/PIPELINE.md).
+
+    `result` holds the cycle's UN-materialized device arrays: XLA's async
+    dispatch returns them as soon as the computation is enqueued, so the
+    host can assemble and dispatch wave k+1 while wave k still runs on the
+    device stream. `materialize()` blocks until the device delivers and
+    returns exactly what the synchronous `Scheduler.pick` returns for the
+    same wave — the async path changes WHEN the host waits, never what the
+    cycle computes.
+    """
+
+    result: PickResult        # device arrays, rows [0, n) are live
+    n: int                    # pre-padding request count
+    load_snapshot: Optional[jax.Array] = None  # device COPY of post-cycle load
+
+    def materialize(self) -> PickResult:
+        return jax.tree.map(lambda x: np.asarray(x)[: self.n], self.result)
+
+    def materialize_load(self) -> Optional[np.ndarray]:
+        """Host view of the post-cycle assumed load (None unless the wave
+        was dispatched with snapshot_load=True)."""
+        if self.load_snapshot is None:
+            return None
+        return np.asarray(self.load_snapshot)
+
+
 def _complete_update(state: SchedState, slots: jax.Array, costs: jax.Array) -> SchedState:
     """Request-termination feedback: subtract reconciled assumed load.
 
@@ -576,6 +605,32 @@ class Scheduler:
         high-water slot) selects the compiled cycle; the device state is
         migrated across bucket boundaries in place, carrying assumed load
         and prefix affinity for every surviving slot."""
+        return self.pick_async(reqs, eps).materialize()
+
+    def pick_async(
+        self,
+        reqs: RequestBatch,
+        eps: EndpointBatch,
+        *,
+        snapshot_load: bool = False,
+    ) -> PendingWave:
+        """Dispatch one scheduling cycle WITHOUT waiting for its results.
+
+        Returns immediately after the cycle is enqueued on the device
+        stream; the caller materializes the PendingWave whenever it needs
+        host numbers. Back-to-back calls are safe — and this is the whole
+        point of the pipelined collector: the state pytree is device-
+        resident and donated, so cycle k+1's dispatch simply queues behind
+        cycle k via the state data dependency. Ordering is preserved by
+        construction, and the host is free to assemble the next wave while
+        the device works.
+
+        `snapshot_load=True` additionally enqueues a device-side COPY of
+        the post-cycle assumed load (trainer feature rows need the post-
+        schedule snapshot). It must be a copy: the live buffer is donated
+        by the NEXT cycle, so a bare reference would be deleted before the
+        completer reads it.
+        """
         n = int(np.asarray(reqs.valid).shape[0])
         bucket = bucket_for(max(n, self._min_bucket))
         reqs = pad_requests(reqs, bucket)
@@ -603,7 +658,11 @@ class Scheduler:
             result, self.state = self._jit(
                 self.state, reqs, eps, self.weights, sub, self.predictor_params
             )
-        return jax.tree.map(lambda x: np.asarray(x)[:n], result)
+            # Enqueued under the lock, i.e. after cycle k and before any
+            # cycle k+1 can dispatch — the copy observes exactly the
+            # post-cycle-k load even though nothing has synced yet.
+            snap = jnp.copy(self.state.assumed_load) if snapshot_load else None
+        return PendingWave(result=result, n=n, load_snapshot=snap)
 
     def complete(self, endpoint_slots: np.ndarray, costs: np.ndarray) -> None:
         """Terminated-request feedback (served-endpoint signal, reference

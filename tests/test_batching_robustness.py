@@ -6,6 +6,19 @@ collector and hang every future request; (2) pick() must not wait forever on
 a wedged collector; (3) served feedback must release the slot that was
 CHARGED (the primary pick), not the slot of the endpoint that happened to
 serve after data-plane failover.
+
+ISSUE 1 (pipelined collector) adds: (4) a device error materializing wave k
+fails only wave k's waiters while the pipeline keeps serving wave k+1;
+(5) close() drains dispatched waves instead of abandoning them; (6) the
+two-stage collector genuinely OVERLAPS host assembly/dispatch with the
+device cycle — W waves finish measurably faster than W x (assembly+cycle).
+
+Note on (1): since ISSUE 1 the criticality band is resolved ONCE at enqueue
+(cached on _Pending), so a malformed objective header now fails its own
+pick() with INVALID_ARGUMENT at the call site — it can no longer reach the
+collector's pre-batch section at all. The test keeps asserting the contract
+that matters: the poisoned picks fail with ExtProcError and the collector
+keeps serving.
 """
 
 import threading
@@ -120,6 +133,140 @@ def test_release_skipped_when_primary_was_evicted():
         picker.observe_served(res.endpoint, SimpleNamespace(pick_result=res))
         after = sched.snapshot_assumed_load()
         assert list(after) == list(before)  # no spurious release anywhere
+    finally:
+        picker.close()
+
+
+def test_device_error_isolated_to_single_wave():
+    """Pipeline fault isolation (ISSUE 1): a device failure materializing
+    wave k fails only wave k's waiters with INTERNAL; the completer keeps
+    serving wave k+1."""
+    sched, ds, ms, picker = _stack(max_batch=1)
+    try:
+        real = sched.pick_async
+        calls = {"n": 0}
+
+        class _Poisoned:
+            def materialize(self):
+                raise RuntimeError("device poisoned")
+
+            def materialize_load(self):
+                return None
+
+        def flaky(reqs, eps, **kw):
+            pw = real(reqs, eps, **kw)
+            calls["n"] += 1
+            return _Poisoned() if calls["n"] == 1 else pw
+
+        sched.pick_async = flaky
+        with pytest.raises(ExtProcError) as exc:
+            picker.pick(PickRequest(headers={}, body=b"wave-k"),
+                        ds.endpoints())
+        assert exc.value.code == grpc.StatusCode.INTERNAL
+        # Wave k+1 sails through the same dispatcher AND completer.
+        ok = picker.pick(PickRequest(headers={}, body=b"wave-k+1"),
+                         ds.endpoints())
+        assert ":" in ok.endpoint
+    finally:
+        picker.close()
+
+
+def test_close_drains_inflight_waves():
+    """close() must complete waves already dispatched to the device — the
+    completer drains FIFO up to the close sentinel, so in-flight picks get
+    their results instead of hanging until the pick() timeout."""
+    sched, ds, ms, picker = _stack(max_batch=1)
+    real = sched.pick_async
+
+    class _Slow:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def materialize(self):
+            time.sleep(0.25)
+            return self.inner.materialize()
+
+        def materialize_load(self):
+            return self.inner.materialize_load()
+
+    sched.pick_async = lambda reqs, eps, **kw: _Slow(real(reqs, eps, **kw))
+    results = []
+
+    def one():
+        try:
+            results.append(
+                picker.pick(PickRequest(headers={}, body=b"x"),
+                            ds.endpoints()))
+        except Exception as e:  # pragma: no cover - the failure mode
+            results.append(e)
+
+    threads = [threading.Thread(target=one) for _ in range(3)]
+    [t.start() for t in threads]
+    time.sleep(0.4)  # let the dispatcher push the waves in flight
+    picker.close()
+    [t.join(timeout=10) for t in threads]
+    assert len(results) == 3
+    assert all(hasattr(r, "endpoint") for r in results), results
+
+
+def test_pipeline_overlaps_assembly_with_device_cycle():
+    """The acceptance bar of ISSUE 1: with a stubbed slow cycle, W waves
+    through the two-stage collector finish measurably below the serial
+    W x (dispatch + materialize) wall time, while every wave's results
+    match the synchronous path (here: the stub's known pick)."""
+    import numpy as np
+
+    from gie_tpu.sched import constants as C
+    from gie_tpu.sched.types import PickResult as SchedPickResult
+
+    sched, ds, ms, picker = _stack(max_batch=1)
+    A, T, W = 0.06, 0.06, 4  # stage-1 dispatch cost, device wait, waves
+    try:
+        class _FakeWave:
+            def __init__(self, n):
+                self.n = n
+
+            def materialize(self):
+                time.sleep(T)  # the device cycle the pipeline hides
+                idx = np.full((self.n, C.FALLBACKS), -1, np.int32)
+                idx[:, 0] = 0
+                return SchedPickResult(
+                    indices=idx,
+                    status=np.zeros((self.n,), np.int32),
+                    scores=np.zeros((self.n, C.FALLBACKS), np.float32),
+                )
+
+            def materialize_load(self):
+                return None
+
+        def fake_pick_async(reqs, eps, **kw):
+            time.sleep(A)  # host-side assembly/dispatch cost
+            import numpy as _np
+            return _FakeWave(int(_np.asarray(reqs.valid).shape[0]))
+
+        sched.pick_async = fake_pick_async
+        slot0 = next(ep.hostport for ep in ds.endpoints() if ep.slot == 0)
+        results = []
+
+        def one():
+            results.append(
+                picker.pick(PickRequest(headers={}, body=b"x"),
+                            ds.endpoints()))
+
+        threads = [threading.Thread(target=one) for _ in range(W)]
+        t0 = time.perf_counter()
+        [t.start() for t in threads]
+        [t.join(timeout=10) for t in threads]
+        wall = time.perf_counter() - t0
+        serial = W * (A + T)
+        # Pipelined steady state ~ A + W*T (stage 1 of wave k+1 overlaps
+        # stage 2 of wave k); require a clear margin below serial.
+        assert wall < serial - 1.5 * T, (
+            f"no overlap: {W} waves took {wall:.3f}s, serial is {serial:.3f}s")
+        # Per-wave results identical to what the synchronous path would
+        # produce from the same (stubbed) cycle output.
+        assert len(results) == W
+        assert all(getattr(r, "endpoint", None) == slot0 for r in results), results
     finally:
         picker.close()
 

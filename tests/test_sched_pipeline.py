@@ -210,6 +210,71 @@ def test_large_batch_256x_all_ok():
     assert (np.asarray(res.indices[:, 0]) >= 0).all()
 
 
+def test_pick_async_bit_identical_to_sync_across_m_boundary():
+    """ISSUE 1 async-dispatch equivalence: pick_async + materialize must be
+    BIT-identical to the synchronous pick for the same wave sequence —
+    including an M-bucket grow (64 -> 256) and shrink (256 -> 64) mid-
+    sequence — and the assumed-load accounting must track exactly. The
+    async path changes WHEN the host waits, never what the cycle computes."""
+    rng = np.random.default_rng(42)
+    waves = []
+    for step, m_slots in enumerate([64, 64, 256, 256, 64]):
+        m_live = 8 if m_slots == 64 else 96
+        eps = make_endpoints(
+            m_live,
+            queue=rng.integers(0, 40, m_live).tolist(),
+            kv=rng.uniform(0, 0.9, m_live).tolist(),
+            m_slots=m_slots)
+        reqs = make_requests(
+            12,
+            prompts=[b"SYS %d | " % (i % 3) * 30 + b"q%d.%d" % (step, i)
+                     for i in range(12)],
+            m_slots=m_slots)
+        waves.append((reqs, eps))
+
+    sync = Scheduler(seed=9)
+    pipelined = Scheduler(seed=9)
+    for reqs, eps in waves:
+        ra = sync.pick(reqs, eps)
+        pw = pipelined.pick_async(reqs, eps, snapshot_load=True)
+        rb = pw.materialize()
+        np.testing.assert_array_equal(
+            np.asarray(ra.indices), np.asarray(rb.indices))
+        np.testing.assert_array_equal(
+            np.asarray(ra.status), np.asarray(rb.status))
+        np.testing.assert_array_equal(
+            np.asarray(ra.scores), np.asarray(rb.scores))
+        # The PendingWave's device-copy snapshot is the live post-cycle
+        # state (it must survive the next cycle's buffer donation), and
+        # both schedulers' accounting tracks bit-for-bit.
+        np.testing.assert_array_equal(
+            pw.materialize_load(), pipelined.snapshot_assumed_load())
+        np.testing.assert_array_equal(
+            sync.snapshot_assumed_load(), pipelined.snapshot_assumed_load())
+
+
+def test_pick_async_back_to_back_preserves_cycle_order():
+    """Two waves dispatched WITHOUT materializing between them must see
+    each other's state updates in order (cycle k+1 queues behind cycle k
+    via the donated state dependency) — materializing late changes
+    nothing about the state sequence."""
+    serial = Scheduler(ProfileConfig(load_decay=1.0))
+    deferred = Scheduler(ProfileConfig(load_decay=1.0))
+    eps = make_endpoints(4, queue=[0, 0, 0, 0])
+    w1 = make_requests(8, prompt_len=[4096.0] * 8)
+    w2 = make_requests(8, prompt_len=[1024.0] * 8)
+    r1 = serial.pick(w1, eps)
+    r2 = serial.pick(w2, eps)
+    p1 = deferred.pick_async(w1, eps)
+    p2 = deferred.pick_async(w2, eps)   # dispatched before p1 materializes
+    np.testing.assert_array_equal(
+        np.asarray(r1.indices), np.asarray(p1.materialize().indices))
+    np.testing.assert_array_equal(
+        np.asarray(r2.indices), np.asarray(p2.materialize().indices))
+    np.testing.assert_array_equal(
+        serial.snapshot_assumed_load(), deferred.snapshot_assumed_load())
+
+
 def test_concurrent_picks_thread_safe():
     """Analogue of the reference datastore concurrency tests
     (datastore_test.go:61,867): concurrent picks + completes must not race or

@@ -1,0 +1,109 @@
+"""Vectorized wave assembly (ISSUE 1): the collector's hot host path.
+
+Two contracts: (1) the numpy column assembly produces bit-identical wave
+tensors to the old per-request Python loop (kept below as the parity
+oracle); (2) assembling the north-star 1024-request wave stays within a
+LOOSE CPU wall-clock budget — a regression back to per-request Python
+looping (~N x M interpreted operations) blows straight through it.
+"""
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+
+from gie_tpu.extproc.server import PickRequest
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.batching import _Pending, assemble_wave
+from gie_tpu.sched.hashing import batch_chunk_hashes
+from gie_tpu.sched.types import chunk_bucket_for
+from gie_tpu.utils.lora import LoraRegistry
+
+
+def _items(n: int, m: int) -> list:
+    cands = [SimpleNamespace(slot=j) for j in range(m)]
+    base = b"SYSTEM: shared prefix for tier %d. "
+    return [
+        _Pending(
+            PickRequest(
+                headers={},
+                body=(base % (i % 16)) * 4 + b"user %d" % i,
+                model=("adapter-%d" % (i % 12)) if i % 3 else "",
+                decode_tokens=float(i % 200),
+            ),
+            cands,
+        )
+        for i in range(n)
+    ]
+
+
+def _reference_assembly(batch, mb, registry):
+    """The pre-ISSUE-1 per-request loop, verbatim: the parity oracle."""
+    n = len(batch)
+    prompts = [it.req.body or b"" for it in batch]
+    hashes, counts = batch_chunk_hashes(prompts)
+    cb = chunk_bucket_for(int(counts.max()) if n else 1)
+    hashes = hashes[:, :cb]
+    lora = np.full((n,), -1, np.int32)
+    crit = np.full((n,), C.Criticality.STANDARD, np.int32)
+    plen = np.zeros((n,), np.float32)
+    dlen = np.zeros((n,), np.float32)
+    mask = np.zeros((n, mb), bool)
+    for i, it in enumerate(batch):
+        lora[i] = registry.id_for(it.req.model)
+        crit[i] = it.band
+        plen[i] = float(len(prompts[i]))
+        dlen[i] = C.CHARS_PER_TOKEN * float(it.req.decode_tokens or 0.0)
+        for ep in it.candidates:
+            if 0 <= ep.slot < mb:
+                mask[i, ep.slot] = True
+    return lora, crit, plen, dlen, hashes, counts, mask
+
+
+def test_vectorized_assembly_matches_reference_loop():
+    items = _items(96, 48)
+    reqs, plen, dlen, lora = assemble_wave(items, 48, LoraRegistry())
+    r_lora, r_crit, r_plen, r_dlen, r_hashes, r_counts, r_mask = (
+        _reference_assembly(items, 48, LoraRegistry()))
+    np.testing.assert_array_equal(lora, r_lora)
+    np.testing.assert_array_equal(plen, r_plen)
+    np.testing.assert_array_equal(dlen, r_dlen)
+    np.testing.assert_array_equal(np.asarray(reqs.lora_id), r_lora)
+    np.testing.assert_array_equal(np.asarray(reqs.criticality), r_crit)
+    np.testing.assert_array_equal(np.asarray(reqs.prompt_len), r_plen)
+    np.testing.assert_array_equal(np.asarray(reqs.decode_len), r_dlen)
+    np.testing.assert_array_equal(np.asarray(reqs.chunk_hashes), r_hashes)
+    np.testing.assert_array_equal(np.asarray(reqs.n_chunks), r_counts)
+    np.testing.assert_array_equal(np.asarray(reqs.subset_mask), r_mask)
+    assert bool(np.asarray(reqs.valid).all())
+
+
+def test_assembly_respects_subset_hints_and_out_of_range_slots():
+    """Strict-subset hints survive vectorization: candidate slots outside
+    the wave's M bucket are dropped, in-range ones land exactly."""
+    items = [
+        _Pending(PickRequest(headers={}, body=b"x"),
+                 [SimpleNamespace(slot=s) for s in slots])
+        for slots in ([0, 3], [7, 400], [5], [])
+    ]
+    reqs, _, _, _ = assemble_wave(items, 8, LoraRegistry())
+    mask = np.asarray(reqs.subset_mask)
+    expect = np.zeros((4, 8), bool)
+    expect[0, [0, 3]] = True
+    expect[1, 7] = True   # 400 is beyond the bucket -> dropped
+    expect[2, 5] = True
+    np.testing.assert_array_equal(mask, expect)
+
+
+def test_assembly_1024_wave_within_budget():
+    """Guard: the north-star wave (1024 requests x 256 candidate slots)
+    assembles via numpy column ops within a loose CPU budget."""
+    items = _items(1024, 256)
+    reg = LoraRegistry()
+    assemble_wave(items[:8], 256, reg)  # warm numpy/jax dispatch paths
+    t0 = time.perf_counter()
+    reqs, plen, dlen, lora = assemble_wave(items, 256, reg)
+    dt = time.perf_counter() - t0
+    assert int(np.asarray(reqs.valid).shape[0]) == 1024
+    assert int(np.asarray(reqs.subset_mask).sum()) == 1024 * 256
+    assert dt < 0.25, f"1024-wave assembly took {dt * 1e3:.1f}ms (budget 250ms)"
