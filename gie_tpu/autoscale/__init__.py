@@ -1,0 +1,43 @@
+"""Autoscaling recommender: closed-loop capacity control from live
+scheduler signals.
+
+The reference ecosystem punts model-server autoscaling to generic HPA on
+aggregate gauges (reference README.md:111 roadmap item 4); HPA cannot see
+KV-cache pressure, criticality-band shed rates, or the latency predictor's
+SLO headroom — the signals this gateway already collects per pick. This
+subsystem closes the loop internally (docs/AUTOSCALE.md):
+
+  signals.py     — PoolSignals derived from MetricsStore + the runtime
+                   prometheus counters (shed/evict rates, pipeline
+                   occupancy, device-wait share, staleness)
+  model.py       — CapacityModel: online EWMA of per-replica goodput
+                   observed near saturation, cross-checked against the
+                   latency predictor's SLO headroom
+  recommender.py — hysteresis-bounded replica recommendations (fast
+                   scale-up on sustained shed, slow cooldown-gated
+                   scale-down, min/max bounds, flap damping)
+  actuator.py    — SSA replica patch on the workload's Deployment through
+                   the stdlib kube client; leader-gated; dry-run mode
+  controller.py  — the loop gluing the four together for the runner
+"""
+
+from gie_tpu.autoscale.actuator import ReplicaActuator
+from gie_tpu.autoscale.controller import AutoscaleController
+from gie_tpu.autoscale.model import CapacityModel
+from gie_tpu.autoscale.recommender import (
+    AutoscaleRecommender,
+    Recommendation,
+    RecommenderConfig,
+)
+from gie_tpu.autoscale.signals import PoolSignals, SignalCollector
+
+__all__ = [
+    "AutoscaleController",
+    "AutoscaleRecommender",
+    "CapacityModel",
+    "PoolSignals",
+    "Recommendation",
+    "RecommenderConfig",
+    "ReplicaActuator",
+    "SignalCollector",
+]

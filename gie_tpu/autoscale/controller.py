@@ -1,0 +1,97 @@
+"""AutoscaleController: the loop gluing signals -> model -> recommender ->
+actuator together.
+
+`step()` is one synchronous control cycle (the closed-loop simulator test
+drives it with a virtual clock); `start()/stop()` wrap it in the runner's
+background thread with a wall-clock interval.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from gie_tpu.autoscale.actuator import ReplicaActuator
+from gie_tpu.autoscale.recommender import AutoscaleRecommender, Recommendation
+from gie_tpu.autoscale.signals import SignalCollector
+from gie_tpu.runtime import metrics as own_metrics
+from gie_tpu.runtime.logging import get_logger
+
+
+class AutoscaleController:
+    def __init__(
+        self,
+        collector: SignalCollector,
+        recommender: AutoscaleRecommender,
+        actuator: ReplicaActuator,
+        *,
+        interval_s: float = 2.0,
+        ttft_probe=None,
+    ):
+        self.collector = collector
+        self.recommender = recommender
+        self.actuator = actuator
+        self.interval_s = interval_s
+        # Optional () -> (predicted_ttft_s, ttft_slo_s) | None: the latency
+        # predictor's pool-typical TTFT forecast (runner wiring). Feeds the
+        # capacity model's SLO derate so scale-up starts while answers are
+        # merely LATE, before hard shedding.
+        self.ttft_probe = ttft_probe
+        self.log = get_logger("autoscale")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def step(self, now: Optional[float] = None) -> Optional[Recommendation]:
+        """One control cycle; returns the recommendation (None while the
+        collector is still establishing its first rate window)."""
+        now = time.time() if now is None else now
+        signals = self.collector.sample(now)
+        if signals is None:
+            return None
+        # Recommend against the CONFIGURED replica count when a scale
+        # target exists (re-asking while pods come up would overshoot);
+        # fall back to the observed ready count in recommend-only mode.
+        current = self.actuator.current_replicas()
+        if current is None:
+            current = signals.ready_replicas
+        probe = None
+        if self.ttft_probe is not None:
+            try:
+                probe = self.ttft_probe()
+            except Exception as e:  # the probe must never stall the loop
+                self.log.v(3).info("autoscale ttft probe failed", err=str(e))
+        rec = self.recommender.observe(
+            signals, current, now,
+            predicted_ttft_s=probe[0] if probe else None,
+            ttft_slo_s=probe[1] if probe else None,
+        )
+        own_metrics.AUTOSCALE_CURRENT.set(current)
+        own_metrics.AUTOSCALE_DESIRED.set(rec.desired)
+        own_metrics.AUTOSCALE_CAPACITY.set(
+            self.recommender.model.per_replica())
+        own_metrics.AUTOSCALE_SHED_RATE.set(signals.shed_per_s)
+        own_metrics.AUTOSCALE_STALE.set(1.0 if signals.stale else 0.0)
+        own_metrics.AUTOSCALE_RECS.labels(direction=rec.direction).inc()
+        self.actuator.apply(rec)
+        return rec
+
+    # -- runner lifecycle --------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()  # restartable: a prior stop() must not leak in
+        self._thread = threading.Thread(
+            target=self._loop, name="autoscale", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception as e:  # the loop must never take the EPP down
+                self.log.error("autoscale step failed", err=e)

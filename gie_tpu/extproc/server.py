@@ -185,6 +185,12 @@ class RequestContext:
     # newline so a frame at stream start (no preceding terminator) anchors.
     sse_carry: bytes = b"\n"
     resp_tail: bytes = b""   # last bytes kept for the usage-block parse
+    # True once bytes have been dropped from resp_tail: the tail is no
+    # longer the whole body, so start-of-stream inferences (the leading
+    # [DONE] sentinel arm) must not fire. An explicit flag, not a length
+    # test — an exactly-4096-byte untruncated body is indistinguishable
+    # from a truncated one by length alone (ADVICE r5 #3).
+    resp_tail_truncated: bool = False
     last_frame: Optional[bytes] = None  # last decoded Generate frame
     # True when the response chunk timing reflects GENERATION cadence
     # (transcoded Generate frames, or >=2 SSE data frames) — a buffered
@@ -604,7 +610,10 @@ class StreamingServer:
             - len(self._SSE_FRAME_RE.findall(carry))
         )
         ctx.sse_carry = buf[-7:]
-        ctx.resp_tail = (ctx.resp_tail + data)[-4096:]
+        tail = ctx.resp_tail + data
+        if len(tail) > 4096:
+            ctx.resp_tail_truncated = True
+        ctx.resp_tail = tail[-4096:]
 
     def _finish_token_count(self, ctx: RequestContext) -> None:
         """End of response stream: prefer authoritative counts. Transcoded
@@ -616,10 +625,11 @@ class StreamingServer:
         accumulates raw bytes across chunks, so a [DONE] frame split by
         chunking is contiguous here; the startswith arm covers a stream
         that begins with the sentinel (only trustworthy while the tail
-        was never truncated, i.e. it still IS the whole body)."""
+        was never truncated, i.e. it still IS the whole body —
+        resp_tail_truncated tracks that explicitly)."""
         if ctx.resp_tokens and (
             self._SSE_DONE_RE.search(ctx.resp_tail)
-            or (len(ctx.resp_tail) < 4096
+            or (not ctx.resp_tail_truncated
                 and self._SSE_DONE_RE.match(b"\n" + ctx.resp_tail))
         ):
             ctx.resp_tokens -= 1

@@ -67,6 +67,52 @@ class MetricsStore:
         with self._lock:
             return self._metrics[:, C.Metric.QUEUE_DEPTH].copy()
 
+    def pool_rows(
+        self, slots: Sequence[int], now: Optional[float] = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Copies of the metric rows + scrape ages for the given slots
+        (autoscale signal derivation). Ages are +inf for slots that have
+        never been scraped: a fresh pod's row is zeros — optimistic for
+        ROUTING (cold-start admission), but a capacity decision must not
+        read 'no data yet' as 'idle'."""
+        idx = list(slots)
+        now = time.time() if now is None else now
+        with self._lock:
+            rows = self._metrics[idx].copy()
+            ages = np.where(
+                self._has_data[idx],
+                now - self._scraped_at[idx],
+                np.inf,
+            )
+        return rows, ages
+
+    def pool_aggregates(
+        self,
+        slots: Sequence[int],
+        *,
+        queue_limit: float,
+        kv_limit: float,
+        now: Optional[float] = None,
+    ) -> dict:
+        """Pool-saturation aggregates over the given slots — the ONE
+        derivation shared by the HPA pool gauges (runner._pool_snapshot)
+        and the autoscale SignalCollector, so the exported metrics and
+        the replica controller can never desynchronize on what
+        'saturated' means."""
+        rows, ages = self.pool_rows(slots, now=now)
+        if len(rows) == 0:
+            return {"queue_depth_total": 0.0, "kv_cache_util_mean": 0.0,
+                    "saturated_fraction": 0.0, "metrics_age_max_s": 0.0}
+        queue = rows[:, C.Metric.QUEUE_DEPTH]
+        kv = rows[:, C.Metric.KV_CACHE_UTIL]
+        saturated = (queue >= queue_limit) | (kv >= kv_limit)
+        return {
+            "queue_depth_total": float(queue.sum()),
+            "kv_cache_util_mean": float(kv.mean()),
+            "saturated_fraction": float(saturated.mean()),
+            "metrics_age_max_s": float(ages.max()),
+        }
+
     def remove(self, slot: int) -> None:
         """Forget a reclaimed slot (wired to Datastore.on_slot_reclaimed)."""
         with self._lock:

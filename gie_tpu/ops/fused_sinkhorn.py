@@ -27,38 +27,57 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _kernel(k_ref, cap_ref, out_ref, *, iters: int):
+def _kernel(k_ref, cap_ref, v_ref, out_ref, v_out_ref, *, iters: int):
     cap = cap_ref[0, :]                                   # [M]
 
-    def body(_, p):
+    def body(_, carry):
+        p, v = carry
         row = jnp.sum(p, axis=1, keepdims=True)
         p = jnp.where(row > 0, p / row, p)
         col = jnp.sum(p, axis=0)
         scale = jnp.where(col > cap, cap / jnp.maximum(col, 1e-9), 1.0)
-        return p * scale[None, :]
+        return p * scale[None, :], v * scale
 
-    plan = jax.lax.fori_loop(0, iters, body, k_ref[:])
+    # Warm start: seed the plan with the carried column duals (matrix form
+    # of the dual iteration — p_t = diag(u_t) K diag(v_t) with v_0 = v_init
+    # — so the iterates match sinkhorn.py's two-matvec reference exactly).
+    # The dual vector rides through the loop as the running product of
+    # column scales, giving the caller the same v_out the dual form yields.
+    plan, v = jax.lax.fori_loop(
+        0, iters, body, (k_ref[:] * v_ref[0, :][None, :], v_ref[0, :]))
     row = jnp.sum(plan, axis=1, keepdims=True)
     out_ref[:] = jnp.where(row > 0, plan / row, plan)
+    v_out_ref[0, :] = v
 
 
 @functools.partial(jax.jit, static_argnames=("iters", "interpret"))
 def fused_sinkhorn_plan(
     kernel_matrix: jax.Array,  # f32[N, M] masked Gibbs weights (0 = masked)
     cap: jax.Array,            # f32[M] per-endpoint wave capacity
+    v_init: jax.Array = None,  # f32[M] warm-start column duals (None = cold)
     *,
     iters: int = 8,
     interpret: bool = False,
-) -> jax.Array:
-    """-> row-normalized transport plan f32[N, M]."""
+) -> tuple[jax.Array, jax.Array]:
+    """-> (row-normalized transport plan f32[N, M], column duals f32[M])."""
     n, m = kernel_matrix.shape
-    return pl.pallas_call(
+    if v_init is None:
+        v_init = jnp.ones((m,), jnp.float32)
+    plan, v_out = pl.pallas_call(
         functools.partial(_kernel, iters=iters),
         in_specs=[
             pl.BlockSpec((n, m), lambda: (0, 0)),
             pl.BlockSpec((1, m), lambda: (0, 0)),
+            pl.BlockSpec((1, m), lambda: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((n, m), lambda: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, m), jnp.float32),
+        out_specs=[
+            pl.BlockSpec((n, m), lambda: (0, 0)),
+            pl.BlockSpec((1, m), lambda: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, m), jnp.float32),
+            jax.ShapeDtypeStruct((1, m), jnp.float32),
+        ],
         interpret=interpret,
-    )(kernel_matrix, cap.reshape(1, m))
+    )(kernel_matrix, cap.reshape(1, m), v_init.reshape(1, m))
+    return plan, v_out.reshape(m)

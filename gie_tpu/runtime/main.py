@@ -153,6 +153,15 @@ def main(argv=None) -> int:
         )
         return 2
 
+    if opts.autoscale_mode == "apply" and kube_client is None:
+        # The demo FakeCluster has no apiserver to patch; the actuator
+        # degrades to metrics-only and every apply counts as no_target.
+        log.error(
+            "--autoscale-mode apply needs --kube (an apiserver to patch "
+            "spec.replicas on); running recommend-only against the demo "
+            "cluster"
+        )
+
     runner = ExtProcServerRunner(opts, cluster)
     runner.setup()
     if kube_client is not None:

@@ -76,6 +76,49 @@ SLOT_OVERFLOW = prom.Gauge(
     "taken — the pool outgrew the compiled capacity",
     registry=REGISTRY,
 )
+# Autoscaling recommender (gie_tpu/autoscale, docs/AUTOSCALE.md): the
+# closed-loop replica controller's own observability. In recommend-only
+# mode these gauges ARE the product — operators compare the desired
+# series against their HPA before handing over actuation.
+AUTOSCALE_DESIRED = prom.Gauge(
+    "gie_autoscale_desired_replicas",
+    "Replica count the recommender currently wants for the pool workload",
+    registry=REGISTRY,
+)
+AUTOSCALE_CURRENT = prom.Gauge(
+    "gie_autoscale_current_replicas",
+    "Configured replica count the recommendation was made against",
+    registry=REGISTRY,
+)
+AUTOSCALE_CAPACITY = prom.Gauge(
+    "gie_autoscale_capacity_per_replica",
+    "Online per-replica capacity estimate (admitted picks/s near "
+    "saturation, EWMA, SLO-derated)",
+    registry=REGISTRY,
+)
+AUTOSCALE_SHED_RATE = prom.Gauge(
+    "gie_autoscale_shed_per_s",
+    "Windowed shed rate (all 429 sources) the last recommendation saw",
+    registry=REGISTRY,
+)
+AUTOSCALE_STALE = prom.Gauge(
+    "gie_autoscale_signals_stale",
+    "1 while the recommender is holding because pool metrics are stale "
+    "(scrape outage / never-scraped pods) — never scale on stale data",
+    registry=REGISTRY,
+)
+AUTOSCALE_RECS = prom.Counter(
+    "gie_autoscale_recommendations_total",
+    "Recommendations by direction",
+    ["direction"],  # up|down|hold
+    registry=REGISTRY,
+)
+AUTOSCALE_APPLIED = prom.Counter(
+    "gie_autoscale_apply_total",
+    "Actuation outcomes",
+    ["outcome"],  # patched|noop|dry_run|not_leader|no_target|error
+    registry=REGISTRY,
+)
 
 
 _POOL_SNAPSHOT = {"fn": lambda: {}, "registered": False,

@@ -61,6 +61,23 @@ class Options:
     # non-critical pick may queue before shedding 429 (0 = unbounded).
     queue_bound: int = 0
     queue_max_age_s: float = 0.0
+    # Autoscaling recommender (gie_tpu/autoscale, docs/AUTOSCALE.md):
+    # "off" disables the loop; "recommend" runs signals->recommendation
+    # and exports gie_autoscale_* metrics without writing; "apply"
+    # additionally SSA-patches spec.replicas on --autoscale-target
+    # (leader-gated when --leader-elect).
+    autoscale_mode: str = "off"
+    autoscale_target: Optional[str] = None  # Deployment name to scale
+    autoscale_min: int = 1
+    autoscale_max: int = 16
+    autoscale_interval_s: float = 2.0
+    autoscale_shed_high: float = 0.5       # sustained 429/s -> scale up
+    autoscale_down_cooldown_s: float = 60.0
+    # TTFT SLO for the capacity model's predictor cross-check (0 = off):
+    # with --enable-predictor, the controller probes the predicted TTFT of
+    # a pool-typical request and derates capacity when it exceeds this
+    # bound, so scale-up starts while answers are merely late.
+    autoscale_ttft_slo_ms: float = 0.0
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -128,6 +145,33 @@ class Options:
                             default=d.queue_max_age_s,
                             help="shed non-critical picks queued longer "
                                  "than this many seconds (0 = unbounded)")
+        parser.add_argument("--autoscale-mode", default=d.autoscale_mode,
+                            choices=["off", "recommend", "apply"],
+                            help="closed-loop replica control: recommend "
+                                 "(export gie_autoscale_* only) or apply "
+                                 "(SSA-patch the target Deployment)")
+        parser.add_argument("--autoscale-target", default=d.autoscale_target,
+                            help="Deployment to scale in apply mode")
+        parser.add_argument("--autoscale-min", type=int,
+                            default=d.autoscale_min)
+        parser.add_argument("--autoscale-max", type=int,
+                            default=d.autoscale_max)
+        parser.add_argument("--autoscale-interval-s", type=float,
+                            default=d.autoscale_interval_s,
+                            help="seconds between control cycles")
+        parser.add_argument("--autoscale-shed-high", type=float,
+                            default=d.autoscale_shed_high,
+                            help="sustained shed rate (429/s) that "
+                                 "triggers fast scale-up")
+        parser.add_argument("--autoscale-down-cooldown-s", type=float,
+                            default=d.autoscale_down_cooldown_s,
+                            help="min seconds between scaling actions "
+                                 "before one downward step (flap damping)")
+        parser.add_argument("--autoscale-ttft-slo-ms", type=float,
+                            default=d.autoscale_ttft_slo_ms,
+                            help="TTFT SLO for the capacity model's "
+                                 "latency-predictor cross-check (needs "
+                                 "--enable-predictor; 0 = off)")
         parser.add_argument("--objective", action="append", default=[],
                             dest="objectives", metavar="NAME=CRITICALITY",
                             help="register an InferenceObjective "
@@ -161,6 +205,14 @@ class Options:
             kv_events_token=args.kv_events_token,
             queue_bound=args.queue_bound,
             queue_max_age_s=args.queue_max_age_s,
+            autoscale_mode=args.autoscale_mode,
+            autoscale_target=args.autoscale_target,
+            autoscale_min=args.autoscale_min,
+            autoscale_max=args.autoscale_max,
+            autoscale_interval_s=args.autoscale_interval_s,
+            autoscale_shed_high=args.autoscale_shed_high,
+            autoscale_down_cooldown_s=args.autoscale_down_cooldown_s,
+            autoscale_ttft_slo_ms=args.autoscale_ttft_slo_ms,
         )
 
     def validate(self) -> None:
@@ -184,6 +236,21 @@ class Options:
             raise ValueError("--mesh-devices must be a power of two")
         if not (0 <= self.kv_events_port < 65536):
             raise ValueError("--kv-events-port out of range")
+        if self.autoscale_mode not in ("off", "recommend", "apply"):
+            raise ValueError(
+                f"--autoscale-mode {self.autoscale_mode!r} must be "
+                "off|recommend|apply")
+        if self.autoscale_mode == "apply" and not self.autoscale_target:
+            raise ValueError(
+                "--autoscale-mode apply requires --autoscale-target")
+        if self.autoscale_mode != "off":
+            if not (0 <= self.autoscale_min <= self.autoscale_max):
+                raise ValueError(
+                    "need 0 <= --autoscale-min <= --autoscale-max")
+            if self.autoscale_interval_s <= 0:
+                raise ValueError("--autoscale-interval-s must be > 0")
+            if self.autoscale_ttft_slo_ms < 0:
+                raise ValueError("--autoscale-ttft-slo-ms must be >= 0")
         for spec in self.objectives:
             name, sep, crit = spec.partition("=")
             if not sep or not name:

@@ -140,6 +140,10 @@ class ExtProcServerRunner:
             trainer=self.trainer,
             queue_bound=opts.queue_bound,
             queue_max_age_s=opts.queue_max_age_s,
+            # Production path: first contact with a new wave-shape lattice
+            # background-compiles its remaining N buckets, so a load spike
+            # never stalls the dispatcher on first-use jit (ROADMAP item).
+            background_warm=True,
         )
         own_metrics.register_pool_aggregates(self._pool_snapshot)
         self._train_stop = threading.Event()
@@ -177,6 +181,51 @@ class ExtProcServerRunner:
                 )
             )
         self.picker.objective_registry = self.objectives
+        # Closed-loop replica control (gie_tpu/autoscale, docs/AUTOSCALE.md)
+        # behind --autoscale-mode: the collector differentiates the pick
+        # path's own counters, the recommender sizes the pool, and the
+        # actuator SSA-patches the target Deployment (apply mode; leader-
+        # gated) or just exports gie_autoscale_* (recommend mode).
+        self.autoscaler = None
+        if opts.autoscale_mode != "off":
+            from gie_tpu.autoscale import (
+                AutoscaleController,
+                AutoscaleRecommender,
+                RecommenderConfig,
+                ReplicaActuator,
+                SignalCollector,
+            )
+
+            collector = SignalCollector(
+                self.metrics_store,
+                self.datastore.endpoints,
+                queue_limit=self.scheduler.cfg.queue_limit,
+                kv_limit=self.scheduler.cfg.kv_limit,
+                # Stale = several scrape periods missed, floored well above
+                # jitter so a slow scrape tick never freezes the loop.
+                staleness_s=max(10 * opts.scrape_interval_ms / 1000.0, 1.0),
+            )
+            recommender = AutoscaleRecommender(RecommenderConfig(
+                min_replicas=opts.autoscale_min,
+                max_replicas=opts.autoscale_max,
+                shed_high_per_s=opts.autoscale_shed_high,
+                down_cooldown_s=opts.autoscale_down_cooldown_s,
+            ))
+            actuator = ReplicaActuator(
+                cluster if hasattr(cluster, "_json") else None,
+                opts.pool_namespace,
+                opts.autoscale_target,
+                dry_run=opts.autoscale_mode != "apply",
+                is_leader=(self.elector.is_leader
+                           if self.elector is not None else None),
+            )
+            self.autoscaler = AutoscaleController(
+                collector, recommender, actuator,
+                interval_s=opts.autoscale_interval_s,
+                ttft_probe=(self._autoscale_ttft_probe
+                            if self.trainer is not None
+                            and opts.autoscale_ttft_slo_ms > 0 else None),
+            )
         self.streaming = StreamingServer(
             self.datastore, self.picker,
             on_served=self.picker.observe_served,
@@ -200,9 +249,10 @@ class ExtProcServerRunner:
 
     def _pool_snapshot(self) -> dict:
         """Aggregates for the HPA gauges (metrics.register_pool_aggregates)
-        — evaluated lazily at metrics-scrape time."""
-        import numpy as np
-
+        — evaluated lazily at metrics-scrape time. Saturation comes from
+        MetricsStore.pool_aggregates, the SAME derivation the autoscale
+        SignalCollector reads, so the exported series and the replica
+        controller cannot disagree on pool state."""
         from gie_tpu.sched import constants as C
 
         endpoints = self.datastore.endpoints()
@@ -210,11 +260,9 @@ class ExtProcServerRunner:
         n = len(slots)
         if n == 0:
             return {"ready_endpoints": 0.0}
-        metrics = self.metrics_store._metrics[slots]
-        queue = metrics[:, C.Metric.QUEUE_DEPTH]
-        kv = metrics[:, C.Metric.KV_CACHE_UTIL]
         cfg = self.scheduler.cfg
-        saturated = (queue >= cfg.queue_limit) | (kv >= cfg.kv_limit)
+        agg = self.metrics_store.pool_aggregates(
+            slots, queue_limit=cfg.queue_limit, kv_limit=cfg.kv_limit)
         load = self.scheduler.snapshot_assumed_load()
         # The assumed-load vector is sized to the scheduler's CURRENT M
         # bucket; a slot beyond it (endpoint registered but not yet picked
@@ -222,11 +270,47 @@ class ExtProcServerRunner:
         in_bucket = [s for s in slots if s < load.shape[0]]
         return {
             "ready_endpoints": float(n),
-            "queue_depth_total": float(queue.sum()),
-            "kv_cache_util_mean": float(kv.mean()),
+            "queue_depth_total": agg["queue_depth_total"],
+            "kv_cache_util_mean": agg["kv_cache_util_mean"],
             "assumed_load_total": float(load[in_bucket].sum()),
-            "saturated_fraction": float(saturated.mean()),
+            "saturated_fraction": agg["saturated_fraction"],
         }
+
+    def _autoscale_ttft_probe(self):
+        """-> (predicted_ttft_s, ttft_slo_s) for the autoscale capacity
+        model's SLO cross-check, or None while unusable. Predicts the TTFT
+        of a pool-TYPICAL request (nominal prompt/decode, no LoRA) on every
+        ready endpoint under the live metrics + assumed load, and reports
+        the median — the derate should reflect the pool's center, not one
+        hot pod the scheduler already steers around."""
+        import numpy as np
+
+        from gie_tpu.models.latency import host_features
+        from gie_tpu.sched import constants as C
+
+        if getattr(self.trainer, "last_loss", None) is None:
+            return None  # untrained predictor: forecasts are noise
+        slots = [ep.slot for ep in self.datastore.endpoints()
+                 if 0 <= ep.slot < C.M_MAX]
+        if not slots:
+            return None
+        rows, ages = self.metrics_store.pool_rows(slots)
+        rows[:, C.Metric.METRICS_AGE_S] = np.clip(
+            np.nan_to_num(ages, posinf=1e6), 0.0, 1e6)
+        load = self.scheduler.snapshot_assumed_load()
+        nominal_prompt = 2048.0                       # chars
+        nominal_decode = 128.0 * C.CHARS_PER_TOKEN
+        feats = np.stack([
+            host_features(
+                rows[i],
+                float(load[s]) if s < load.shape[0] else 0.0,
+                nominal_prompt, nominal_decode, False)
+            for i, s in enumerate(slots)
+        ])
+        pred = self.trainer.predict_ttft(
+            feats, np.asarray(slots, np.int32))
+        return (float(np.median(pred)),
+                self.opts.autoscale_ttft_slo_ms / 1000.0)
 
     # -- scrape lifecycle follows endpoint lifecycle -----------------------
 
@@ -336,6 +420,14 @@ class ExtProcServerRunner:
                 target=self._train_loop, daemon=True
             )
             self._train_thread.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
+            self.log.info(
+                "autoscale loop started",
+                mode=self.opts.autoscale_mode,
+                target=self.opts.autoscale_target,
+                bounds=(self.opts.autoscale_min, self.opts.autoscale_max),
+            )
         self.log.info(
             "ext-proc server started",
             port=port,
@@ -379,6 +471,8 @@ class ExtProcServerRunner:
         if self._stopped.is_set():
             return
         self._stopped.set()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self._train_stop.set()
         if self._train_thread is not None:
             self._train_thread.join(timeout=5)

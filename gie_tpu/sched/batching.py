@@ -231,6 +231,7 @@ class BatchingTPUPicker:
         queue_bound: int = 0,
         queue_max_age_s: float = 0.0,
         pipeline_depth: int = 2,
+        background_warm: bool = False,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
@@ -293,6 +294,17 @@ class BatchingTPUPicker:
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self._waves: queue.Queue = queue.Queue(maxsize=pipeline_depth)
+        # Background N-bucket lattice warming (ROADMAP follow-up): with
+        # background_warm=True the dispatcher's first contact with a new
+        # (m, chunk_lanes) lattice kicks Scheduler.warm_lattice_async for
+        # the REST of that lattice's request-count buckets, so a later
+        # load spike never stalls a wave on first-use jit. Opt-in (the
+        # runner enables it): the compile threads contend for CPU, which
+        # deterministic latency tests building this picker directly must
+        # not absorb. Collector-thread-only state.
+        self.background_warm = background_warm
+        self._warmed_lattices: set[tuple[int, int]] = set()
+        self._warm_threads: list[threading.Thread] = []
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
         self._completer = threading.Thread(
@@ -649,6 +661,11 @@ class BatchingTPUPicker:
         # donation).
         pending = self.scheduler.pick_async(
             reqs, eps, snapshot_load=self.trainer is not None)
+        lattice = (mb, int(reqs.chunk_hashes.shape[1]))
+        if self.background_warm and lattice not in self._warmed_lattices:
+            self._warmed_lattices.add(lattice)
+            self._warm_threads.append(
+                self.scheduler.warm_lattice_async(*lattice))
         own_metrics.HOST_ASSEMBLY.observe(time.perf_counter() - t0)
         own_metrics.PIPELINE_DEPTH.inc()
         own_metrics.PIPELINE_WAVES.inc()

@@ -585,6 +585,12 @@ class Scheduler:
         # Compiled-shape warm cache: (n_bucket, m, chunk_lanes).
         self._warm_buckets: set[tuple[int, int, int]] = set()
         self._warm_lock = threading.Lock()
+        # Inline first-use compiles the dispatcher had to wait for (each
+        # one is a wave stalled behind a jit compile — the stall the
+        # background lattice warmer exists to remove). Observability +
+        # test hook; reads are racy-but-monotonic, which is all the
+        # consumers need.
+        self.warm_inline_compiles = 0
 
     def _warm(self, reqs: RequestBatch, eps: EndpointBatch) -> None:
         """Compile a bucket shape OUTSIDE the state lock by running the cycle
@@ -595,6 +601,42 @@ class Scheduler:
             SchedState.init(m=int(eps.valid.shape[0])), reqs, eps,
             self.weights, jax.random.PRNGKey(0), self.predictor_params,
         )
+
+    def warm_lattice_async(
+        self, m: int, chunk_lanes: int
+    ) -> threading.Thread:
+        """Background-compile every still-cold N-bucket executable for the
+        (m, chunk_lanes) shape lattice (ROADMAP follow-up: the dispatcher
+        used to block on the first wave of each new request-count bucket —
+        tens of seconds of inline jit under load spikes, paid exactly when
+        the queue is deepest). Runs on a daemon thread with synthetic
+        all-invalid waves: compilation is shape-keyed, so a masked wave
+        compiles the same executable a live one would. Each bucket holds
+        `_warm_lock` only for its own compile, so a live cold-shape pick
+        interleaves per bucket instead of waiting for the whole lattice.
+        Returns the thread (callers that need warm-before-serve join it).
+        """
+        buckets = [b for b in C.N_BUCKETS if b >= self._min_bucket]
+
+        def _run() -> None:
+            for n in buckets:
+                key = (n, m, chunk_lanes)
+                if key in self._warm_buckets:
+                    continue
+                reqs = RequestBatch.empty(n, m).replace(
+                    chunk_hashes=jnp.zeros((n, chunk_lanes), jnp.uint32))
+                eps = EndpointBatch.empty(m)
+                with self._warm_lock:
+                    if key in self._warm_buckets:
+                        continue
+                    self._warm(reqs, eps)
+                    self._warm_buckets.add(key)
+
+        t = threading.Thread(
+            target=_run, name=f"warm-lattice-m{m}-c{chunk_lanes}",
+            daemon=True)
+        t.start()
+        return t
 
     def pick(self, reqs: RequestBatch, eps: EndpointBatch) -> PickResult:
         """Schedule a micro-batch; returns host-side PickResult rows for the
@@ -649,6 +691,10 @@ class Scheduler:
         if warm_key not in self._warm_buckets:
             with self._warm_lock:
                 if warm_key not in self._warm_buckets:
+                    # Inline stall: this wave waits for its own compile.
+                    # The background lattice warmer (warm_lattice_async)
+                    # exists to make this path unreachable in steady state.
+                    self.warm_inline_compiles += 1
                     self._warm(reqs, eps)
                     self._warm_buckets.add(warm_key)
         with self._lock:
@@ -837,6 +883,18 @@ class Scheduler:
                 )
             except (KeyError, TypeError, ValueError):
                 return False
+        # Cross-field shape consistency (ADVICE r5 #1), on BOTH paths —
+        # orbax's template restore hands back the checkpoint's own arrays,
+        # so a mixed-layout checkpoint (e.g. ot_v saved at a different M
+        # bucket than assumed_load) passes the width probe above. A
+        # corrupted checkpoint must fail HERE with False, not later inside
+        # the jitted cycle with an opaque shape error.
+        m = restored.m
+        px = restored.prefix
+        if (restored.ot_v.shape != (m,)
+                or px.present.shape != (int(px.keys.shape[0]), m // 32)
+                or px.ages.shape != px.keys.shape):
+            return False
         with self._lock:
             self.state = restored
         return True

@@ -111,18 +111,16 @@ def sinkhorn_picker(
 
     if use_pallas:
         # VMEM-resident iteration loop (one HBM write for the whole
-        # solve). The kernel solves from cold; the carried dual is left
-        # untouched (returned as given) rather than reset, so flipping
-        # the flag mid-run cannot wipe the XLA path's learned pressure.
+        # solve). The kernel consumes the SAME warm-started duals as the
+        # dual-form path below (ADVICE r5 #2): it seeds the plan with
+        # diag(v_init) and carries the running column-scale product, so
+        # its plan AND its returned duals match the XLA path's iterates —
+        # flipping the flag mid-run keeps the learned pressure.
         from gie_tpu.ops import interpret_default
         from gie_tpu.ops.fused_sinkhorn import fused_sinkhorn_plan
 
-        plan = fused_sinkhorn_plan(
-            k, cap, iters=iters, interpret=interpret_default())
-        # The carried dual passes through UNTRANSFORMED: storing v_init
-        # (v0 ** (0.5*u) < 1 exponent) every wave would monotonically
-        # decay the learned pressure toward ones without ever solving.
-        v_out = v_init if v0 is None else v0
+        plan, v_out = fused_sinkhorn_plan(
+            k, cap, v_init, iters=iters, interpret=interpret_default())
     else:
         # DUAL-FORM iterations: the iterates of row-normalize-then-
         # column-cap compose into p_t = diag(u_t) K diag(v_t), so the

@@ -11,6 +11,9 @@ Speaks just enough of the Kubernetes REST protocol to drive
 - 410 Gone when the requested resourceVersion predates the retained
   event window (`compact()` forces this — the relist path)
 - PATCH .../status (merge-patch recorded and applied)
+- PATCH on apps/v1 Deployments (the autoscale actuator's SSA replica
+  patch; applied as a recursive merge — JSON is valid YAML, so the
+  apply-patch+yaml body parses as-is)
 - coordination.k8s.io/v1 Lease GET/POST/PUT with resourceVersion
   optimistic concurrency (409 on mismatch) — the leader-election
   substrate (reference internal/runnable/leader_election.go uses the
@@ -27,6 +30,19 @@ import copy
 import http.server
 import json
 import threading
+
+
+def _merge(dst: dict, patch: dict) -> None:
+    """RFC 7386 merge-patch: objects merge recursively, null deletes,
+    everything else replaces. The autoscale actuator's single-field SSA
+    patch (spec.replicas) must not wipe the rest of a Deployment's spec."""
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _merge(dst[k], v)
+        elif v is None:
+            dst.pop(k, None)
+        else:
+            dst[k] = v
 
 
 class FakeKubeApiServer:
@@ -131,7 +147,8 @@ class FakeKubeApiServer:
         else:
             return None
         resource = {"pods": "pods", "services": "services",
-                    "inferencepools": "pools", "leases": "leases"}.get(kind)
+                    "inferencepools": "pools", "leases": "leases",
+                    "deployments": "deployments"}.get(kind)
         if resource is None:
             return None
         name = rest[0] if rest else None
@@ -257,9 +274,7 @@ class FakeKubeApiServer:
                 return self._send_404(handler)
             if sub == "status":
                 self.status_patches.append((ns, name, patch))
-            # merge-patch: top-level keys replace.
-            for k, v in patch.items():
-                obj[k] = v
+            _merge(obj, patch)
             self._bump(resource, "MODIFIED", obj)
             out = copy.deepcopy(obj)
         self._send_json(handler, 200, out)

@@ -320,3 +320,37 @@ def test_slo_admission_sheds_and_releases_charge():
         assert ":" in crit.endpoint
     finally:
         picker.close()
+
+
+def test_dispatcher_kicks_background_lattice_warm_once():
+    """With background_warm=True (the runner's production wiring), the
+    dispatcher's first wave at a new (M, chunk) lattice hands the REST of
+    that lattice's N buckets to Scheduler.warm_lattice_async — once per
+    lattice — so later load spikes never stall on first-use jit. Opt-in:
+    a picker built without the flag must kick nothing (deterministic
+    latency tests rely on that)."""
+    from gie_tpu.sched import constants as C
+    from gie_tpu.extproc.server import PickRequest
+
+    sched, ds, ms, picker = _stack(background_warm=True)
+    try:
+        picker.pick(PickRequest(headers={}, body=b"x"), ds.endpoints())
+        assert len(picker._warm_threads) == 1
+        picker._warm_threads[0].join(timeout=600)
+        assert not picker._warm_threads[0].is_alive()
+        # The whole N lattice for (M_BUCKETS[0], C_BUCKETS[0]) is warm.
+        lanes = C.C_BUCKETS[0]
+        for n in C.N_BUCKETS:
+            assert (n, C.M_BUCKETS[0], lanes) in sched._warm_buckets
+        # Same lattice again: no second kick.
+        picker.pick(PickRequest(headers={}, body=b"y"), ds.endpoints())
+        assert len(picker._warm_threads) == 1
+    finally:
+        picker.close()
+
+    sched2, ds2, ms2, picker2 = _stack()  # default: off
+    try:
+        picker2.pick(PickRequest(headers={}, body=b"x"), ds2.endpoints())
+        assert picker2._warm_threads == []
+    finally:
+        picker2.close()

@@ -179,6 +179,57 @@ def test_legacy_checkpoint_without_ot_v_restores(tmp_path):
     assert (np.asarray(s.state.ot_v) == 1.0).all()  # cold dual default
 
 
+def test_legacy_checkpoint_shape_mismatch_returns_false(tmp_path):
+    """Cross-field shape validation on the raw-restore path (ADVICE r5
+    #1): a corrupted/mixed-layout checkpoint must fail cleanly with
+    False, never construct an inconsistent SchedState that blows up
+    later inside the jitted cycle with an opaque shape error."""
+    import numpy as np
+
+    from gie_tpu.sched.types import SchedState
+    from gie_tpu.utils.checkpoint import save_pytree
+
+    st = SchedState.init(m=64)
+
+    def legacy(**overrides):
+        base = {
+            "prefix": {"keys": np.asarray(st.prefix.keys),
+                       "present": np.asarray(st.prefix.present),
+                       "ages": np.asarray(st.prefix.ages)},
+            "assumed_load": np.asarray(st.assumed_load),
+            "rr": np.asarray(st.rr),
+            "tick": np.asarray(st.tick),
+        }
+        for key, val in overrides.items():
+            if key.startswith("prefix_"):
+                base["prefix"][key[len("prefix_"):]] = val
+            else:
+                base[key] = val
+        return base
+
+    cases = {
+        # present width from a DIFFERENT m than assumed_load's (64//32=2)
+        "present-width": legacy(
+            prefix_present=np.zeros(
+                (int(st.prefix.keys.shape[0]), 256 // 32), np.uint32)),
+        # ages length disagreeing with keys
+        "ages-len": legacy(
+            prefix_ages=np.zeros((17,), np.uint32)),
+        # present row count disagreeing with keys
+        "present-rows": legacy(
+            prefix_present=np.zeros((17, 2), np.uint32)),
+        # ot_v present but laid out for a different m
+        "ot_v-len": legacy(ot_v=np.ones((256,), np.float32)),
+    }
+    for name, raw in cases.items():
+        ckpt = str(tmp_path / name)
+        save_pytree(ckpt, raw)
+        s = Scheduler(ProfileConfig())
+        before = s.state
+        assert not s.restore_state(ckpt), name
+        assert s.state is before, name  # live state untouched on failure
+
+
 def test_scheduler_state_checkpoint_roundtrip(tmp_path):
     """Warm-restart: prefix affinity survives a save/restore cycle."""
     from gie_tpu.sched import Weights

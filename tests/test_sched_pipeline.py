@@ -485,7 +485,9 @@ def test_sinkhorn_warm_start_inert_on_idle_fleet():
 def test_pallas_sinkhorn_matches_reference_path():
     """The VMEM-resident sinkhorn loop (interpret mode on CPU) must agree
     with the lax.scan reference to float tolerance — identical picks on
-    untied inputs, matching statuses."""
+    untied inputs, matching statuses — INCLUDING the warm start: the
+    kernel consumes v_init and returns the same evolved column duals the
+    dual-form path carries (ADVICE r5 #2)."""
     import jax
 
     from gie_tpu.ops.fused_sinkhorn import fused_sinkhorn_plan
@@ -498,31 +500,82 @@ def test_pallas_sinkhorn_matches_reference_path():
     k = np.where(rng.uniform(0, 1, (64, m)) > 0.5,
                  rng.uniform(0, 1, (64, m)), 0.0).astype(np.float32)
     k[:, 8:] = 0.0
-    plan_pl = np.asarray(fused_sinkhorn_plan(
-        np.asarray(k), cap, iters=8, interpret=True))
 
     import jax.numpy as jnp
 
-    def ref(kk, cap):
-        def body(p, _):
-            row = jnp.sum(p, axis=1, keepdims=True)
-            p = jnp.where(row > 0, p / row, p)
-            col = jnp.sum(p, axis=0)
-            scale = jnp.where(col > cap, cap / jnp.maximum(col, 1e-9), 1.0)
-            return p * scale[None, :], None
+    def ref(kk, cap, v_init):
+        # The dual-form iteration from sinkhorn.py: two matvecs carrying
+        # (u, v), seeded with the warm-start duals.
+        def body(carry, _):
+            u, v = carry
+            r = kk @ v
+            u = jnp.where(r > 0, 1.0 / r, u)
+            col = v * (u @ kk)
+            v = v * jnp.where(col > cap, cap / jnp.maximum(col, 1e-9), 1.0)
+            return (u, v), None
 
-        p, _ = jax.lax.scan(body, kk, None, length=8)
+        (u, v), _ = jax.lax.scan(
+            body, (jnp.ones(kk.shape[:1], jnp.float32), v_init),
+            None, length=8)
+        p = kk * u[:, None] * v[None, :]
         row = jnp.sum(p, axis=1, keepdims=True)
-        return jnp.where(row > 0, p / row, p)
+        return jnp.where(row > 0, p / row, p), v
 
-    plan_ref = np.asarray(ref(jnp.asarray(k), cap))
-    np.testing.assert_allclose(plan_pl, plan_ref, atol=1e-5)
+    for v_init in (
+        np.ones((m,), np.float32),                        # cold start
+        rng.uniform(0.05, 1.0, m).astype(np.float32),     # warm duals
+    ):
+        plan_pl, v_pl = fused_sinkhorn_plan(
+            np.asarray(k), cap, jnp.asarray(v_init), iters=8,
+            interpret=True)
+        plan_ref, v_ref = ref(jnp.asarray(k), cap, jnp.asarray(v_init))
+        np.testing.assert_allclose(
+            np.asarray(plan_pl), np.asarray(plan_ref), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(v_pl), np.asarray(v_ref), atol=1e-5)
 
     cfg_a = ProfileConfig(picker="sinkhorn", enable_prefix=False)
     cfg_b = ProfileConfig(picker="sinkhorn", enable_prefix=False,
                           use_pallas_sinkhorn=True)
     reqs = make_requests(16)
-    ra = Scheduler(cfg_a, seed=7).pick(reqs, eps)
-    rb = Scheduler(cfg_b, seed=7).pick(reqs, eps)
-    assert (np.asarray(ra.status) == np.asarray(rb.status)).all()
-    assert (np.asarray(ra.indices) == np.asarray(rb.indices)).all()
+    sched_a, sched_b = Scheduler(cfg_a, seed=7), Scheduler(cfg_b, seed=7)
+    # TWO sequential waves: the second consumes the ot_v duals the first
+    # wave carried, so this covers warm-start parity end to end (the old
+    # single-pick assertion only ever compared cold solves).
+    for _ in range(2):
+        ra = sched_a.pick(reqs, eps)
+        rb = sched_b.pick(reqs, eps)
+        assert (np.asarray(ra.status) == np.asarray(rb.status)).all()
+        assert (np.asarray(ra.indices) == np.asarray(rb.indices)).all()
+    np.testing.assert_allclose(
+        np.asarray(sched_a.state.ot_v), np.asarray(sched_b.state.ot_v),
+        atol=1e-5)
+
+
+def test_background_lattice_warm_removes_inline_stall():
+    """warm_lattice_async compiles every N bucket of an (m, chunk_lanes)
+    lattice off the dispatch path: a cold request-count bucket dispatched
+    AFTER warmup completes must not take the inline first-use-compile
+    stall (ROADMAP follow-up: the dispatcher blocked on first-use jit of
+    new wave shapes)."""
+    from gie_tpu.sched import constants as C
+
+    sched = Scheduler(ProfileConfig(enable_prefix=False))
+    t = sched.warm_lattice_async(64, C.MAX_CHUNKS)
+    t.join(timeout=600)
+    assert not t.is_alive(), "lattice warm thread did not finish"
+    assert sched.warm_inline_compiles == 0
+
+    eps = make_endpoints(4, queue=[0, 1, 2, 3], m_slots=64)
+    # Three waves landing in three DIFFERENT cold N buckets: all were
+    # pre-compiled by the warmer, so none may stall inline.
+    for n in (1, 5, 60):
+        res = sched.pick(make_requests(n, m_slots=64), eps)
+        assert res.status.tolist() == [Status.OK] * n
+    assert sched.warm_inline_compiles == 0
+
+    # A shape OUTSIDE the warmed lattice still takes (and counts) the
+    # inline path — the counter is the stall observability hook.
+    eps256 = make_endpoints(4, queue=[0, 1, 2, 3], m_slots=256)
+    sched.pick(make_requests(2, m_slots=256), eps256)
+    assert sched.warm_inline_compiles == 1

@@ -124,6 +124,28 @@ def test_split_done_sentinel_still_decrements():
     assert ctx.resp_tokens == 1
 
 
+def test_exact_4096_byte_untruncated_body_still_decrements():
+    """A body of EXACTLY 4096 bytes that starts with the [DONE] sentinel
+    is untruncated — the tail still IS the whole body, so the start-of-
+    stream decrement must fire (ADVICE r5 #3: the old `len < 4096` test
+    conflated this with a truncated tail)."""
+    srv = _server()
+    ctx = RequestContext()
+    body = b"data: [DONE]\n\n" + b"x" * (4096 - 14)
+    assert len(body) == 4096
+    srv._count_plain_tokens(ctx, body)
+    assert ctx.resp_tail_truncated is False
+    srv._finish_token_count(ctx)
+    assert ctx.resp_tokens == 0
+
+    # The truncated twin: one byte longer, sentinel pushed off the start
+    # of the retained tail window — the decrement must NOT fire on a
+    # leading match that is no longer the stream start.
+    ctx2 = RequestContext()
+    srv._count_plain_tokens(ctx2, b"y" + body)
+    assert ctx2.resp_tail_truncated is True
+
+
 def test_usage_block_overrides_frame_count():
     srv = _server()
     ctx = RequestContext()
