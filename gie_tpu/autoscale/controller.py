@@ -28,11 +28,19 @@ class AutoscaleController:
         *,
         interval_s: float = 2.0,
         ttft_probe=None,
+        is_leader=None,
     ):
         self.collector = collector
         self.recommender = recommender
         self.actuator = actuator
         self.interval_s = interval_s
+        # Optional () -> bool leadership gate. A FOLLOWER's pick counters
+        # never move (its ext-proc readiness is NOT_SERVING), so its view
+        # is "fresh metrics, zero traffic" — which the recommender reads
+        # as utilization 0 and turns into a standing scale-down export.
+        # Only the leader may recommend; followers keep sampling so their
+        # counter baselines stay windowed for the moment they promote.
+        self.is_leader = is_leader
         # Optional () -> (predicted_ttft_s, ttft_slo_s) | None: the latency
         # predictor's pool-typical TTFT forecast (runner wiring). Feeds the
         # capacity model's SLO derate so scale-up starts while answers are
@@ -48,6 +56,10 @@ class AutoscaleController:
         now = time.time() if now is None else now
         signals = self.collector.sample(now)
         if signals is None:
+            return None
+        if self.is_leader is not None and not self.is_leader():
+            # Follower: sample (baselines stay fresh for promotion) but
+            # never recommend/export/actuate on zero-traffic counters.
             return None
         # Recommend against the CONFIGURED replica count when a scale
         # target exists (re-asking while pods come up would overshoot);

@@ -22,6 +22,8 @@ from __future__ import annotations
 import math
 from typing import Optional
 
+import numpy as np
+
 from gie_tpu.autoscale.signals import PoolSignals
 
 
@@ -79,6 +81,60 @@ class CapacityModel:
     def converged(self) -> bool:
         """True once at least one near-saturation observation landed."""
         return self._ewma is not None
+
+    # -- persistence + replication (ROADMAP: a restarted EPP must not
+    # re-learn capacity from the default) ---------------------------------
+
+    def export_state(self) -> dict:
+        """Replication digest "autoscale" section: the raw capacity EWMA
+        (NaN while unconverged — the honest encoding of "no estimate",
+        distinct from any real capacity). The SLO derate is deliberately
+        NOT carried: it is recomputed from the live predictor every cycle,
+        and a follower inheriting a stale derate would double-count."""
+        return {"ewma": np.float32(
+            np.nan if self._ewma is None else self._ewma)}
+
+    def prepare_install(self, arrays: dict) -> Optional[float]:
+        """Validation half of install_state (NaN stands in for
+        "unconverged" so the staged value is never None on success)."""
+        try:
+            v = float(np.asarray(arrays["ewma"]).reshape(()))
+        except (KeyError, TypeError, ValueError):
+            return None
+        return v
+
+    def commit_install(self, staged: float) -> None:
+        """Non-finite or non-positive values install as "unconverged"
+        rather than poisoning replicas_for with a zero divisor."""
+        self._ewma = (
+            staged if np.isfinite(staged) and staged > 0.0 else None)
+
+    def install_state(self, arrays: dict) -> bool:
+        """Validated inverse of export_state; returns False (prior state
+        kept) on malformation."""
+        staged = self.prepare_install(arrays)
+        if staged is None:
+            return False
+        self.commit_install(staged)
+        return True
+
+    def save(self, directory: str) -> None:
+        """Persist the EWMA through the shared orbax helpers (leader
+        shutdown hook): a restarted single-replica EPP — no standby to
+        promote — seeds from the last converged estimate instead of
+        default_per_replica."""
+        from gie_tpu.utils.checkpoint import save_pytree
+
+        save_pytree(directory, self.export_state())
+
+    def restore(self, directory: str) -> bool:
+        from gie_tpu.utils.checkpoint import restore_pytree
+
+        restored = restore_pytree(
+            directory, {"ewma": np.float32(np.nan)})
+        if restored is None:
+            return False
+        return self.install_state(restored)
 
     def replicas_for(
         self, demand_per_s: float, *, target_utilization: float = 0.75

@@ -113,10 +113,22 @@ class AutoscaleRecommender:
 
         if signals.ready_replicas == 0 and current == 0:
             if cfg.min_replicas < 1:
+                if signals.wake_arrivals > 0:
+                    # Scale-FROM-zero: a request 503'd against the empty
+                    # pool — the one traffic signal a scaled-to-zero pool
+                    # can emit (nothing to scrape, nothing to pick).
+                    # Immediate 0->1, no sustain window: the sustain gate
+                    # exists to reject shed BLIPS on a serving pool, but
+                    # here every arrival is a hard failure until a
+                    # replica exists.
+                    self._last_scale_at = now
+                    return Recommendation(
+                        now, current, self._clamp(1),
+                        f"wake-from-zero ({signals.wake_arrivals} "
+                        "arrivals on empty pool)")
                 # Scale-to-zero configured: an empty pool at zero demand
                 # is the DESIRED state — bootstrapping to 1 here would
-                # flap the workload 0<->1 forever. (Scale-FROM-zero needs
-                # a wake-on-traffic signal; out of scope, see ROADMAP.)
+                # flap the workload 0<->1 forever.
                 return Recommendation(now, current, 0, "hold")
             # Empty pool bootstrap: nothing is serving and nothing is
             # scheduled to; bring up the floor.

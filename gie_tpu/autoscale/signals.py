@@ -53,6 +53,10 @@ class PoolSignals:
     device_wait_share: float   # device-wait seconds per wall second
     metrics_age_max_s: float   # oldest scrape age among live slots
     stale: bool                # hold recommendations when True
+    # Requests that 503'd against an EMPTY pool this window (the ext-proc
+    # layer records them in MetricsStore; scale-from-zero wake trigger).
+    # Defaulted so hand-built PoolSignals in tests keep their meaning.
+    wake_arrivals: int = 0
 
 
 def _counter_totals(registry) -> dict:
@@ -131,6 +135,11 @@ class SignalCollector:
         if prev is None:
             return None
         window = now - prev_at
+        # Drain AFTER the baseline gate: the first (None) sample must not
+        # swallow a wake arrival that should count toward the first real
+        # window. take_wake_arrivals is drain-and-reset, so each arrival
+        # is observed by exactly one sample.
+        wake = int(self.metrics_store.take_wake_arrivals())
 
         def rate(name: str, **labels) -> float:
             delta = (_sum_where(totals, name, **labels)
@@ -175,4 +184,5 @@ class SignalCollector:
             # bound (or never scraped: age +inf from pool_rows) must HOLD
             # — a scrape outage is indistinguishable from an idle fleet.
             stale=n > 0 and age_max > self.staleness_s,
+            wake_arrivals=wake,
         )

@@ -37,6 +37,11 @@ class MetricsStore:
         self._lora_waiting = np.full((C.M_MAX, C.LORA_SLOTS), -1, np.int32)
         self._scraped_at = np.zeros((C.M_MAX,), np.float64)
         self._has_data = np.zeros((C.M_MAX,), bool)
+        # Scale-from-zero wake signal (ROADMAP): arrivals that found an
+        # EMPTY pool (the ext-proc layer 503s them before any endpoint
+        # state exists to scrape). The autoscale SignalCollector drains
+        # this counter into PoolSignals.wake_arrivals each window.
+        self._wake_arrivals = 0
 
     def update(
         self,
@@ -112,6 +117,21 @@ class MetricsStore:
             "saturated_fraction": float(saturated.mean()),
             "metrics_age_max_s": float(ages.max()),
         }
+
+    def note_empty_pool_arrival(self) -> None:
+        """Record one request that 503'd against an empty pool — the only
+        traffic signal a scaled-to-zero pool can emit (there is no endpoint
+        to scrape and no pick to count). Feeds the recommender's
+        wake-from-zero trigger."""
+        with self._lock:
+            self._wake_arrivals += 1
+
+    def take_wake_arrivals(self) -> int:
+        """Drain-and-reset the empty-pool arrival count (one consumer: the
+        autoscale SignalCollector's window sampling)."""
+        with self._lock:
+            n, self._wake_arrivals = self._wake_arrivals, 0
+            return n
 
     def remove(self, slot: int) -> None:
         """Forget a reclaimed slot (wired to Datastore.on_slot_reclaimed)."""

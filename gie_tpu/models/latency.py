@@ -380,6 +380,71 @@ class OnlineTrainer:
         factor = min(1.0, self.confidence_loss_ok / max(self._loss_ema, 1e-9))
         return ramp * factor
 
+    # -- replication digest surface (gie_tpu/replication) ------------------
+
+    def export_state(self) -> dict:
+        """Flat array dict of the predictor for the replication digest's
+        "predictor" section: every param leaf keyed by its pytree path,
+        plus the confidence state (loss EMA + observed count) so a
+        promoted follower's phase-in gate resumes where the dead leader's
+        training left off instead of re-zeroing a converged column."""
+        from jax.tree_util import keystr, tree_flatten_with_path
+
+        leaves, _ = tree_flatten_with_path(self.params)
+        out = {f"param{keystr(path)}": np.asarray(leaf)
+               for path, leaf in leaves}
+        with self._lock:
+            out["loss_ema"] = np.float32(
+                np.nan if self._loss_ema is None else self._loss_ema)
+            out["observed_total"] = np.int64(self._observed_total)
+        return out
+
+    def prepare_install(self, arrays: dict):
+        """Validation half of install_state: every param leaf of THIS
+        build's architecture must be present with its exact shape (a
+        digest from a differently-configured predictor rejects whole — a
+        partially-transplanted MLP would predict garbage with full
+        confidence); extra keys are ignored for forward compat. Returns
+        an opaque staged tuple for commit_install, or None. Split so the
+        replication manager can validate a whole multi-section digest
+        before mutating any component."""
+        from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+
+        leaves, treedef = tree_flatten_with_path(self.params)
+        fresh = []
+        for path, leaf in leaves:
+            got = arrays.get(f"param{keystr(path)}")
+            if got is None:
+                return None
+            arr = np.asarray(got)
+            if arr.shape != leaf.shape:
+                return None
+            fresh.append(jnp.asarray(arr, leaf.dtype))
+        try:
+            ema = float(np.asarray(arrays["loss_ema"]).reshape(()))
+            observed = int(np.asarray(arrays["observed_total"]).reshape(()))
+        except (KeyError, TypeError, ValueError):
+            return None
+        return (tree_unflatten(treedef, fresh), ema, observed)
+
+    def commit_install(self, staged) -> None:
+        """Commit half: swap the validated params + confidence state in.
+        The optimizer state restarts fresh, as on checkpoint restore."""
+        params, ema, observed = staged
+        self.params = params
+        self.opt_state = self.tx.init(self.params)
+        with self._lock:
+            self._loss_ema = None if np.isnan(ema) else max(ema, 0.0)
+            self._observed_total = max(observed, 0)
+
+    def install_state(self, arrays: dict) -> bool:
+        """Validated inverse of export_state (single-component form)."""
+        staged = self.prepare_install(arrays)
+        if staged is None:
+            return False
+        self.commit_install(staged)
+        return True
+
     # -- durability (the system's ONLY durable state, SURVEY.md 5.4) -------
 
     def save(self, directory: str) -> None:

@@ -1,0 +1,50 @@
+"""HA state replication: warm-standby EPP followers via anti-entropy sync.
+
+The reference's HA story is leader election with IDLE followers (readiness
+NOT_SERVING, all EPP state a soft cache whose loss on restart is explicitly
+accepted — SURVEY 5.3/5.4). This subsystem closes the gap that acceptance
+opened as the EPP grew state that is expensive to re-learn: the prefix-cache
+table, the scheduler's assumed-load vector and sinkhorn warm-start duals,
+the learned TTFT/TPOT predictor parameters, and the autoscale per-replica
+capacity EWMA. A failover that serves prefix-cold, predictor-cold picks
+until everything re-converges is exactly the misrouting regime scheduling
+quality depends on avoiding — routing decisions are only as good as the
+state behind them.
+
+Shape (docs/REPLICATION.md):
+
+  codec.py      versioned, chunked, CRC-guarded digest wire format
+  publisher.py  leader-side: epoch-versioned digest snapshots over HTTP
+                (ETag = state epoch; delta frames since a known epoch)
+  follower.py   non-leader loop: discover the leader from the Lease holder
+                identity, poll with jittered backoff, validate, install
+  manager.py    role-transition wiring: promote warm on election win,
+                flip back to syncing on demotion
+"""
+
+from gie_tpu.replication.codec import (
+    Digest,
+    decode_digest,
+    encode_digest,
+    encode_section,
+)
+from gie_tpu.replication.follower import FollowerSync
+from gie_tpu.replication.manager import (
+    ReplicationManager,
+    advertise_from_identity,
+    replication_identity,
+)
+from gie_tpu.replication.publisher import ReplicationHTTPServer, StatePublisher
+
+__all__ = [
+    "Digest",
+    "decode_digest",
+    "encode_digest",
+    "encode_section",
+    "FollowerSync",
+    "ReplicationManager",
+    "ReplicationHTTPServer",
+    "StatePublisher",
+    "advertise_from_identity",
+    "replication_identity",
+]

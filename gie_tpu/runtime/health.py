@@ -23,9 +23,13 @@ HEALTH_SERVICE = "grpc.health.v1.Health"
 
 # Named sub-services per the endpoint-picker protocol (004 README:103-137):
 # liveness = process alive (no datastore/leader dependency); readiness and
-# the ext-proc service name = synced AND leading.
+# the ext-proc service name = synced AND leading. "replication" (when a
+# replication manager is wired) = this replica is a warm takeover target:
+# leading, or synced within the staleness bound (docs/REPLICATION.md) —
+# the probe a rollout controller asks before trusting a standby.
 LIVENESS_SERVICE = "liveness"
 READINESS_SERVICE = "readiness"
+REPLICATION_SERVICE = "replication"
 
 SERVING = health_pb2.HealthCheckResponse.SERVING
 NOT_SERVING = health_pb2.HealthCheckResponse.NOT_SERVING
@@ -34,12 +38,21 @@ NOT_SERVING = health_pb2.HealthCheckResponse.NOT_SERVING
 class HealthService:
     """Check/Watch backed by a ready-predicate per service name."""
 
-    def __init__(self, ready_fn: Callable[[], bool]):
+    def __init__(
+        self,
+        ready_fn: Callable[[], bool],
+        replication_fn: Callable[[], bool] | None = None,
+    ):
         self.ready_fn = ready_fn
+        self.replication_fn = replication_fn
 
     def _status(self, service: str) -> int:
         if service == LIVENESS_SERVICE:
             return SERVING  # answering at all == alive
+        if service == REPLICATION_SERVICE:
+            if self.replication_fn is None:
+                return health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+            return SERVING if self.replication_fn() else NOT_SERVING
         known = ("", READINESS_SERVICE, EXTPROC_SERVICE, HEALTH_SERVICE)
         if service not in known:
             return health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
@@ -78,7 +91,9 @@ class HealthService:
 
 
 def start_dedicated_health_server(
-    ready_fn: Callable[[], bool], port: int
+    ready_fn: Callable[[], bool],
+    port: int,
+    replication_fn: Callable[[], bool] | None = None,
 ) -> tuple[grpc.Server, int]:
     """The dedicated health listener, started BEFORE the manager/cache sync
     so probes get NOT_SERVING instead of connection refused (reference
@@ -88,7 +103,7 @@ def start_dedicated_health_server(
     # Watch handlers hold a worker for their stream's lifetime; size the
     # pool so long-lived watchers cannot starve Check probes.
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=10))
-    HealthService(ready_fn).add_to_server(server)
+    HealthService(ready_fn, replication_fn).add_to_server(server)
     bound = server.add_insecure_port(f"0.0.0.0:{port}")
     if bound == 0:
         raise OSError(f"failed to bind health port {port}")

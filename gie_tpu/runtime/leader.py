@@ -43,6 +43,31 @@ from typing import Optional
 _LEASES = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
 
 
+class _RoleCallbackBase:
+    """Shared role-transition plumbing for both electors.
+
+    `on_role_change` is an optional (is_leader: bool) -> None invoked
+    from the renew thread on every leadership flip (the replication
+    manager's promotion/demotion hook). Exceptions are swallowed: a
+    callback bug must not kill the election loop. All `_leader` writes
+    on the loop/stop paths go through `_set_leader` so observers can
+    never miss a flip."""
+
+    on_role_change = None
+    _leader = False
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def _set_leader(self, leader: bool) -> None:
+        was, self._leader = self._leader, leader
+        if leader != was and self.on_role_change is not None:
+            try:
+                self.on_role_change(leader)
+            except Exception:
+                pass
+
+
 def _microtime(t: Optional[float] = None) -> str:
     """metav1.MicroTime wire format. (Written, never parsed: expiry is
     judged by locally-observed record CHANGES, not by wall-clock
@@ -54,7 +79,7 @@ def _microtime(t: Optional[float] = None) -> str:
     )
 
 
-class KubeLeaseElector:
+class KubeLeaseElector(_RoleCallbackBase):
     """Distributed leader election on a coordination.k8s.io/v1 Lease.
 
     `client` is the stdlib kube adapter (controller/kube.py
@@ -98,6 +123,7 @@ class KubeLeaseElector:
         identity: Optional[str] = None,
         lease_ttl_s: float = 15.0,
         renew_interval_s: float = 2.0,
+        on_role_change=None,
     ):
         self.client = client
         self.path = _LEASES.format(ns=namespace) + f"/{lease_name}"
@@ -107,6 +133,7 @@ class KubeLeaseElector:
         self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
         self.lease_ttl_s = lease_ttl_s
         self.renew_interval_s = renew_interval_s
+        self.on_role_change = on_role_change  # see _RoleCallbackBase
         self._leader = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -137,10 +164,25 @@ class KubeLeaseElector:
                     self.client._json("PUT", self.path, lease)
             except Exception:
                 pass  # release is best-effort; the TTL backstops it
-        self._leader = False
+        self._set_leader(False)  # demotion observers fire on clean stop too
 
-    def is_leader(self) -> bool:
-        return self._leader
+    def holder_identity(self) -> Optional[str]:
+        """Lease holder identity as last OBSERVED by the renew loop —
+        the replication follower's leader-discovery channel: the holder
+        string carries the leader's advertised digest address
+        (replication.manager.replication_identity). Served from the
+        `_observed` record `_tick` already maintains (at most
+        renew_interval_s stale) instead of a fresh GET: the follower
+        polls this every sync interval, and doubling the apiserver's
+        lease-read QPS per follower just to re-learn what the elector
+        read moments ago would scale badly across pools and replicas."""
+        rec = self._observed
+        if rec is not None and rec[0]:
+            return rec[0]
+        # Before the loop's first successful GET (or while we hold the
+        # lease ourselves via the create path): our own leadership is
+        # authoritative locally.
+        return self.identity if self._leader else None
 
     # ------------------------------------------------------------------ #
 
@@ -210,7 +252,7 @@ class KubeLeaseElector:
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
-                self._leader = self._tick()
+                self._set_leader(self._tick())
                 if self._leader:
                     # The lease we just wrote blocks every other replica
                     # for ttl; transient failures inside that window keep
@@ -222,14 +264,14 @@ class KubeLeaseElector:
                 # leadership while our last written lease is still
                 # unexpired (no one else can hold it), then fail safe to
                 # follower. Followers stay followers.
-                self._leader = (
+                self._set_leader(
                     self._leader
                     and time.monotonic() < self._good_until
                 )
             self._stop.wait(self.renew_interval_s)
 
 
-class LeaseFileElector:
+class LeaseFileElector(_RoleCallbackBase):
     def __init__(
         self,
         lease_path: str,
@@ -237,11 +279,13 @@ class LeaseFileElector:
         identity: Optional[str] = None,
         lease_ttl_s: float = 5.0,
         renew_interval_s: float = 1.0,
+        on_role_change=None,
     ):
         self.lease_path = lease_path
         self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
         self.lease_ttl_s = lease_ttl_s
         self.renew_interval_s = renew_interval_s
+        self.on_role_change = on_role_change  # see _RoleCallbackBase
         self._leader = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -265,10 +309,17 @@ class LeaseFileElector:
                 os.unlink(self.lease_path)
             except OSError:
                 pass
-        self._leader = False
+        self._set_leader(False)  # demotion observers fire on clean stop too
 
-    def is_leader(self) -> bool:
-        return self._leader
+    def holder_identity(self) -> Optional[str]:
+        """Current live lease holder (None when absent/expired) — same
+        leader-discovery contract as KubeLeaseElector.holder_identity.
+        The file read is local and cheap, so no observation cache is
+        needed here."""
+        holder, ts = self._read_lease()
+        if holder is None or not self._lease_valid(ts, time.time()):
+            return None
+        return holder
 
     # ------------------------------------------------------------------ #
 
@@ -340,7 +391,7 @@ class LeaseFileElector:
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
-                self._leader = self._tick()
+                self._set_leader(self._tick())
             except Exception:
-                self._leader = False
+                self._set_leader(False)
             self._stop.wait(self.renew_interval_s)

@@ -119,6 +119,50 @@ AUTOSCALE_APPLIED = prom.Counter(
     ["outcome"],  # patched|noop|dry_run|not_leader|no_target|error
     registry=REGISTRY,
 )
+# HA state replication (gie_tpu/replication, docs/REPLICATION.md): the
+# warm-standby sync loop's own observability. On a leader the epoch is the
+# publisher's; on a follower it is the last INSTALLED epoch, and lag /
+# staleness quantify how cold a takeover would be right now.
+REPLICATION_ROLE = prom.Gauge(
+    "gie_replication_role",
+    "1 while this replica leads (publishes digests), 0 while it syncs",
+    registry=REGISTRY,
+)
+REPLICATION_EPOCH = prom.Gauge(
+    "gie_replication_epoch",
+    "State epoch: published (leader) or last installed (follower)",
+    registry=REGISTRY,
+)
+REPLICATION_EPOCH_LAG = prom.Gauge(
+    "gie_replication_epoch_lag",
+    "Leader epoch minus last installed epoch, as observed by the follower",
+    registry=REGISTRY,
+)
+REPLICATION_DIGEST_BYTES = prom.Gauge(
+    "gie_replication_digest_bytes",
+    "Encoded size of the current full state digest",
+    registry=REGISTRY,
+)
+REPLICATION_STALENESS = prom.Gauge(
+    "gie_replication_staleness_seconds",
+    "Seconds since the follower last confirmed the leader's state "
+    "(install or 304); -1 before first contact, 0 while leading",
+    registry=REGISTRY,
+)
+REPLICATION_INSTALL_SECONDS = prom.Histogram(
+    "gie_replication_install_seconds",
+    "Digest decode-to-installed latency on the follower",
+    buckets=(1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0),
+    registry=REGISTRY,
+)
+REPLICATION_SYNCS = prom.Counter(
+    "gie_replication_sync_total",
+    "Follower sync attempts by outcome",
+    # installed|not_modified|no_leader|fetch_error|corrupt|stale_epoch|
+    # delta_mismatch|rejected
+    ["outcome"],
+    registry=REGISTRY,
+)
 
 
 _POOL_SNAPSHOT = {"fn": lambda: {}, "registered": False,

@@ -78,6 +78,25 @@ class Options:
     # a pool-typical request and derates capacity when it exceeds this
     # bound, so scale-up starts while answers are merely late.
     autoscale_ttft_slo_ms: float = 0.0
+    # Persisted per-pool capacity estimate (ROADMAP): directory where the
+    # leader checkpoints the capacity EWMA on shutdown, and from which a
+    # restarting EPP seeds the model instead of default_per_replica.
+    autoscale_state_dir: Optional[str] = None
+    # HA state replication (gie_tpu/replication, docs/REPLICATION.md):
+    # warm-standby followers sync the leader's soft state (prefix table,
+    # assumed load + OT duals, predictor params, capacity EWMA) so a
+    # failover promotes warm instead of prefix-/predictor-cold. Port 0 =
+    # disabled. The digest listener is control-plane state (a forged
+    # digest steers routing): loopback bind by default; set --replication-
+    # bind/-advertise to the pod network explicitly for real deployments.
+    replication_port: int = 0
+    replication_bind: str = "127.0.0.1"
+    replication_advertise: str = ""   # host:port peers dial; default bind:port
+    replication_interval_s: float = 1.0
+    # Follower staleness bound for the "replication" health sub-service:
+    # a standby that has not confirmed the leader's state within this
+    # window reports NOT_SERVING (it would promote cold-ish).
+    replication_stale_after_s: float = 10.0
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -172,6 +191,35 @@ class Options:
                             help="TTFT SLO for the capacity model's "
                                  "latency-predictor cross-check (needs "
                                  "--enable-predictor; 0 = off)")
+        parser.add_argument("--autoscale-state-dir",
+                            default=d.autoscale_state_dir,
+                            help="directory persisting the per-pool "
+                                 "capacity EWMA across restarts (leader "
+                                 "writes on shutdown, startup seeds from "
+                                 "it)")
+        parser.add_argument("--replication-port", type=int,
+                            default=d.replication_port,
+                            help="HTTP port serving /replication/digest "
+                                 "for warm-standby state sync (0 = "
+                                 "disabled)")
+        parser.add_argument("--replication-bind", default=d.replication_bind,
+                            help="bind address for the replication "
+                                 "listener (default loopback; set the "
+                                 "pod-network address explicitly)")
+        parser.add_argument("--replication-advertise",
+                            default=d.replication_advertise,
+                            help="host:port peers reach this replica's "
+                                 "digest on (carried in the election "
+                                 "Lease holder identity; default "
+                                 "bind:port)")
+        parser.add_argument("--replication-interval-s", type=float,
+                            default=d.replication_interval_s,
+                            help="leader digest refresh / follower poll "
+                                 "interval")
+        parser.add_argument("--replication-stale-after-s", type=float,
+                            default=d.replication_stale_after_s,
+                            help="follower staleness bound for the "
+                                 "replication health sub-service")
         parser.add_argument("--objective", action="append", default=[],
                             dest="objectives", metavar="NAME=CRITICALITY",
                             help="register an InferenceObjective "
@@ -213,6 +261,12 @@ class Options:
             autoscale_shed_high=args.autoscale_shed_high,
             autoscale_down_cooldown_s=args.autoscale_down_cooldown_s,
             autoscale_ttft_slo_ms=args.autoscale_ttft_slo_ms,
+            autoscale_state_dir=args.autoscale_state_dir,
+            replication_port=args.replication_port,
+            replication_bind=args.replication_bind,
+            replication_advertise=args.replication_advertise,
+            replication_interval_s=args.replication_interval_s,
+            replication_stale_after_s=args.replication_stale_after_s,
         )
 
     def validate(self) -> None:
@@ -236,6 +290,25 @@ class Options:
             raise ValueError("--mesh-devices must be a power of two")
         if not (0 <= self.kv_events_port < 65536):
             raise ValueError("--kv-events-port out of range")
+        if not (0 <= self.replication_port < 65536):
+            raise ValueError("--replication-port out of range")
+        if self.replication_port > 0:
+            if self.replication_interval_s <= 0:
+                raise ValueError("--replication-interval-s must be > 0")
+            if self.replication_stale_after_s <= 0:
+                raise ValueError("--replication-stale-after-s must be > 0")
+            if self.replication_advertise and ":" not in self.replication_advertise:
+                raise ValueError(
+                    "--replication-advertise must be host:port")
+            if (not self.replication_advertise
+                    and self.replication_bind in ("0.0.0.0", "::", "")):
+                # A wildcard bind cannot default the advertise address:
+                # the Lease would carry "0.0.0.0:port" and every follower
+                # would dial ITSELF (and get 503 "not leader") — a
+                # standby that silently never syncs.
+                raise ValueError(
+                    "--replication-bind on a wildcard address requires "
+                    "an explicit --replication-advertise host:port")
         if self.autoscale_mode not in ("off", "recommend", "apply"):
             raise ValueError(
                 f"--autoscale-mode {self.autoscale_mode!r} must be "

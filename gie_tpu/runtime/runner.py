@@ -149,6 +149,17 @@ class ExtProcServerRunner:
         self._train_stop = threading.Event()
         self._train_thread: Optional[threading.Thread] = None
         self.elector = None
+        # With replication enabled, the elector's holder identity carries
+        # this replica's advertised digest address — the Lease doubles as
+        # the followers' leader-discovery channel (docs/REPLICATION.md).
+        repl_advertise = repl_identity = None
+        if opts.replication_port > 0:
+            from gie_tpu.replication import replication_identity
+
+            repl_advertise = (
+                opts.replication_advertise
+                or f"{opts.replication_bind}:{opts.replication_port}")
+            repl_identity = replication_identity(repl_advertise)
         if opts.leader_elect:
             # Kube deployments elect on a coordination.k8s.io Lease
             # (reference internal/runnable/leader_election.go) — any
@@ -159,11 +170,13 @@ class ExtProcServerRunner:
 
                 self.elector = KubeLeaseElector(
                     cluster, opts.pool_namespace,
-                    f"{opts.pool_name}-epp-leader")
+                    f"{opts.pool_name}-epp-leader",
+                    identity=repl_identity)
             else:
                 from gie_tpu.runtime.leader import LeaseFileElector
 
-                self.elector = LeaseFileElector(opts.leader_lease_path)
+                self.elector = LeaseFileElector(
+                    opts.leader_lease_path, identity=repl_identity)
         # Objective registry (proposal 1199): named objectives -> bands,
         # populated from --objective NAME=CRITICALITY declarations (the CRD
         # watch adapter feeds the same registry in a kube deployment).
@@ -187,15 +200,31 @@ class ExtProcServerRunner:
         # actuator SSA-patches the target Deployment (apply mode; leader-
         # gated) or just exports gie_autoscale_* (recommend mode).
         self.autoscaler = None
+        self.capacity_model = None
         if opts.autoscale_mode != "off":
             from gie_tpu.autoscale import (
                 AutoscaleController,
                 AutoscaleRecommender,
+                CapacityModel,
                 RecommenderConfig,
                 ReplicaActuator,
                 SignalCollector,
             )
 
+            # Persisted per-pool capacity estimate (ROADMAP): seed the
+            # EWMA from the last leader's checkpoint instead of
+            # default_per_replica, so a restarted EPP does not re-learn
+            # capacity from scratch. The replication digest carries the
+            # same state live between replicas; the checkpoint covers the
+            # single-replica restart where there is no leader to sync
+            # from.
+            self.capacity_model = CapacityModel()
+            if opts.autoscale_state_dir:
+                if self.capacity_model.restore(opts.autoscale_state_dir):
+                    self.log.info(
+                        "capacity estimate restored",
+                        dir=opts.autoscale_state_dir,
+                        per_replica=self.capacity_model.per_replica())
             collector = SignalCollector(
                 self.metrics_store,
                 self.datastore.endpoints,
@@ -210,7 +239,7 @@ class ExtProcServerRunner:
                 max_replicas=opts.autoscale_max,
                 shed_high_per_s=opts.autoscale_shed_high,
                 down_cooldown_s=opts.autoscale_down_cooldown_s,
-            ))
+            ), model=self.capacity_model)
             actuator = ReplicaActuator(
                 cluster if hasattr(cluster, "_json") else None,
                 opts.pool_namespace,
@@ -225,7 +254,33 @@ class ExtProcServerRunner:
                 ttft_probe=(self._autoscale_ttft_probe
                             if self.trainer is not None
                             and opts.autoscale_ttft_slo_ms > 0 else None),
+                # Followers sample but never recommend: their pick
+                # counters are zero by construction (NOT_SERVING), which
+                # would otherwise export a standing scale-down signal.
+                is_leader=(self.elector.is_leader
+                           if self.elector is not None else None),
             )
+        # HA state replication (gie_tpu/replication, docs/REPLICATION.md):
+        # the leader publishes its soft state, non-leaders sync it into
+        # their LIVE scheduler/predictor/capacity objects, and winning an
+        # election later promotes warm with no restore step.
+        self.replication = None
+        if opts.replication_port > 0:
+            from gie_tpu.replication import ReplicationManager
+
+            self.replication = ReplicationManager(
+                scheduler=self.scheduler,
+                trainer=self.trainer,
+                capacity_model=self.capacity_model,
+                elector=self.elector,
+                port=opts.replication_port,
+                bind=opts.replication_bind,
+                advertise=repl_advertise,
+                interval_s=opts.replication_interval_s,
+                stale_after_s=opts.replication_stale_after_s,
+            )
+            if self.elector is not None:
+                self.elector.on_role_change = self.replication.on_role_change
         self.streaming = StreamingServer(
             self.datastore, self.picker,
             on_served=self.picker.observe_served,
@@ -374,8 +429,17 @@ class ExtProcServerRunner:
         # during startup (reference main.go:104-109).
         if self.elector is not None:
             self.elector.start()
+        if self.replication is not None:
+            self.replication.start()
+            self.log.info(
+                "replication manager started",
+                advertise=self.replication.advertise,
+                interval_s=self.opts.replication_interval_s,
+            )
         self.health_server, _ = start_dedicated_health_server(
-            self.ready, self.opts.grpc_health_port
+            self.ready, self.opts.grpc_health_port,
+            self.replication.healthy if self.replication is not None
+            else None,
         )
         try:
             own_metrics.start_metrics_server(self.opts.metrics_port)
@@ -385,7 +449,11 @@ class ExtProcServerRunner:
         server = grpc.server(futures.ThreadPoolExecutor(max_workers=64))
         add_extproc_service(server, self.streaming)
         # Colocated health on the ext-proc port (runserver.go:117-123).
-        HealthService(self.ready).add_to_server(server)
+        HealthService(
+            self.ready,
+            self.replication.healthy if self.replication is not None
+            else None,
+        ).add_to_server(server)
         addr = f"0.0.0.0:{self.opts.grpc_port}"
         if self.opts.secure_serving:
             creds, self._cert_reloader = server_credentials(self.opts.cert_path)
@@ -473,6 +541,19 @@ class ExtProcServerRunner:
         self._stopped.set()
         if self.autoscaler is not None:
             self.autoscaler.stop()
+        if self.replication is not None:
+            self.replication.stop()
+        # Persist the capacity EWMA on LEADER shutdown (ROADMAP): the
+        # next single-replica start seeds from it instead of the default.
+        # Followers skip the write — their copy lags the leader's, and
+        # the last writer would win the directory.
+        if (self.capacity_model is not None
+                and self.opts.autoscale_state_dir
+                and (self.elector is None or self.elector.is_leader())):
+            try:
+                self.capacity_model.save(self.opts.autoscale_state_dir)
+            except Exception as e:  # shutdown must finish regardless
+                self.log.error("capacity checkpoint failed", err=e)
         self._train_stop.set()
         if self._train_thread is not None:
             self._train_thread.join(timeout=5)

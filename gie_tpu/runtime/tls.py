@@ -15,17 +15,23 @@ import threading
 from typing import Optional
 
 import grpc
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
-from cryptography.x509.oid import NameOID
 
 
 def create_self_signed_cert(
     common_name: str = "gie-tpu-epp", days: int = 3650
 ) -> tuple[bytes, bytes]:
     """(cert_pem, key_pem); RSA-4096, 10-year validity like the reference
-    (tls.go:38-52)."""
+    (tls.go:38-52).
+
+    cryptography imports lazily: only the self-signed path needs it, and
+    containers serving with mounted certs (or --insecure-serving) must not
+    fail to IMPORT the runtime because an optional generator dependency is
+    absent."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
     key = rsa.generate_private_key(public_exponent=65537, key_size=4096)
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
     now = datetime.datetime.now(datetime.timezone.utc)

@@ -315,6 +315,16 @@ class BatchingTPUPicker:
 
     def pick(self, req: PickRequest, candidates: list) -> PickResult:
         if not candidates:
+            # Scale-from-zero wake signal (ROADMAP): an arrival against an
+            # EMPTY pool is the only traffic evidence a scaled-to-zero
+            # pool produces — record it before 503ing so the autoscale
+            # recommender can wake the pool 0->1. Strict-subset misses
+            # against a NON-empty pool are routing failures, not demand
+            # for more replicas. getattr: latency tests stub the store.
+            note = getattr(self.metrics_store, "note_empty_pool_arrival", None)
+            eps = getattr(self.datastore, "endpoints", lambda: ())
+            if note is not None and not eps():
+                note()
             # Strict subsetting / no ready endpoints (004 README:77-79).
             raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "no endpoints available")
         try:

@@ -42,13 +42,12 @@ def _topk(masked: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     1024x256, bit-identical picks).
 
     Requires pairwise-distinct in-row values to enumerate ties as separate
-    entries — true for every caller: topk_picker's rotation makes equal
-    scores distinct, and the sinkhorn/random paths add continuous Gumbel
-    noise whose temperature ProfileConfig validates as strictly positive
-    (a zero temperature would permit exact ties). An exact float tie
-    would skip the duplicate lane (its entry gated at NEG, i.e. a
-    shorter fallback list); the primary pick is the true argmax
-    regardless.
+    entries — guaranteed for every caller: topk_picker's rotation makes
+    equal scores distinct, and the sinkhorn/random paths get the
+    _iota_tiebreak ulp nudge in _finalize (ADVICE r5 #4 — their Gumbel
+    noise is continuous but f32-granular, so duplicate-endpoint lanes
+    could still collide exactly and silently shorten the fallback list).
+    The primary pick is the true argmax regardless.
     """
     vals, idxs = [], []
     bound = jnp.full(masked.shape[:-1], jnp.inf, masked.dtype)
@@ -60,6 +59,35 @@ def _topk(masked: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
         idxs.append(i.astype(jnp.int32))
         bound = v
     return jnp.stack(vals, axis=-1), jnp.stack(idxs, axis=-1)
+
+
+def _iota_tiebreak(masked: jax.Array, mask: jax.Array) -> jax.Array:
+    """Per-lane iota*ulp tiebreak (ADVICE r5 #4): bitcast the f32 scores
+    to i32 and REPLACE the low ceil(log2(M)) mantissa bits with the lane
+    index. In-row values become pairwise distinct BY CONSTRUCTION —
+    lanes whose remaining high bits agree differ in the unique lane
+    field, and lanes whose high bits differ were already further apart
+    than the field can reach — so the threshold-descent _topk enumerates
+    every tied lane as its own fallback entry instead of gating
+    duplicates at NEG. (Merely ADDING the lane to the bits would relocate
+    the defect: two lanes i<j exactly j-i ulps apart would collide.)
+
+    Working in the bit domain makes the nudge magnitude-relative: a
+    fixed additive epsilon sized for [0, 1] blends would be absorbed
+    outright by the sinkhorn path's log-domain scores (ulp(-46) ~ 4e-6).
+    The worst-case reorder is between values already within 2*M ulps of
+    each other — far below any meaningful score difference, and strictly
+    better than silently truncating the fallback list. Rewriting low
+    mantissa bits cannot touch the exponent, so finite scores stay
+    finite. Ineligible lanes keep the exact NEG sentinel (the
+    ok-threshold compares against it)."""
+    m = masked.shape[-1]
+    low = jnp.int32((1 << max((m - 1).bit_length(), 1)) - 1)
+    lane = jnp.arange(m, dtype=jnp.int32)
+    bits = jax.lax.bitcast_convert_type(masked, jnp.int32)
+    bits = (bits & ~low) | lane[None, :]
+    return jnp.where(
+        mask, jax.lax.bitcast_convert_type(bits, jnp.float32), masked)
 
 
 def finalize_from_topk(
@@ -88,8 +116,18 @@ def _finalize(
     mask: jax.Array,
     shed: jax.Array,
     valid: jax.Array,
+    *,
+    lane_tiebreak: bool = True,
 ) -> PickResult:
-    """Shared pick postlude: top-k fallback list + status gating."""
+    """Shared pick postlude: top-k fallback list + status gating.
+
+    `lane_tiebreak` applies the iota*ulp nudge so exact in-row ties still
+    enumerate as separate fallback entries; topk_picker opts OUT because
+    its rotation already guarantees distinctness, and a nudge of up to
+    M_MAX ulps would overwhelm the _TIE_EPS-granular rotation ordering
+    (breaking the round-robin fairness it exists to provide)."""
+    if lane_tiebreak:
+        masked = _iota_tiebreak(masked, mask)
     top_scores, top_idx = _topk(masked, C.FALLBACKS)
     return finalize_from_topk(top_scores, top_idx, mask, shed, valid)
 
@@ -114,7 +152,9 @@ def topk_picker(
     lane = jnp.arange(m, dtype=jnp.uint32)
     rot = ((lane + rr) % jnp.uint32(m)).astype(jnp.float32)
     masked = jnp.where(mask, quantized + rot * _TIE_EPS, NEG)
-    return _finalize(masked, mask, shed, valid)
+    # The rotation already makes in-row values pairwise distinct; the
+    # iota nudge would scramble its _TIE_EPS-granular ordering.
+    return _finalize(masked, mask, shed, valid, lane_tiebreak=False)
 
 
 def weighted_random_picker(

@@ -223,6 +223,40 @@ def unpack_presence(present) -> "np.ndarray":
     return bits.reshape(p.shape[0], -1).astype(bool)
 
 
+def snapshot_table(table: PrefixTable) -> dict:
+    """Host-side arrays of the packed table (replication digest export:
+    key/presence/age columns exactly as laid out on device, so a follower
+    install is a bit-exact transplant, not a rebuild)."""
+    return {
+        "keys": np.asarray(table.keys),
+        "present": np.asarray(table.present),
+        "ages": np.asarray(table.ages),
+    }
+
+
+def table_from_arrays(arrays: dict) -> "PrefixTable | None":
+    """Validated inverse of snapshot_table -> PrefixTable, or None when the
+    arrays are not a coherent packed table (wrong rank, mismatched row
+    counts, or a presence width that is not whole 32-endpoint words). The
+    cross-field checks mirror profile.Scheduler.restore_state's: corrupt
+    input must fail HERE with None, not later inside the jitted cycle."""
+    try:
+        keys = np.asarray(arrays["keys"], np.uint32)
+        present = np.asarray(arrays["present"], np.uint32)
+        ages = np.asarray(arrays["ages"], np.uint32)
+    except (KeyError, TypeError, ValueError):
+        return None
+    if keys.ndim != 1 or present.ndim != 2 or ages.shape != keys.shape:
+        return None
+    if present.shape[0] != keys.shape[0] or present.shape[1] < 1:
+        return None
+    return PrefixTable(
+        keys=jnp.asarray(keys),
+        present=jnp.asarray(present),
+        ages=jnp.asarray(ages),
+    )
+
+
 def clear_endpoint(table: PrefixTable, slot: jax.Array) -> PrefixTable:
     """Invalidate one endpoint's presence bit across the table (pod
     evicted/replaced — reference analogue: per-pod index removal on

@@ -478,6 +478,32 @@ class PendingWave:
         return np.asarray(self.load_snapshot)
 
 
+def check_state_shapes(state: SchedState) -> bool:
+    """Cross-field shape consistency for a SchedState built from external
+    bytes (checkpoint restore, replication digest install — ADVICE r5 #1
+    generalized). A state that fails here would not crash immediately: it
+    would surface later inside the jitted cycle as an opaque shape error,
+    or worse, silently mis-index. Checks: the endpoint width is a real M
+    bucket shared by load and duals, the packed presence matrix matches
+    both the table's row count and the bucket's word width, scalars are
+    scalars."""
+    try:
+        m = int(state.assumed_load.shape[0])
+    except (TypeError, IndexError):
+        return False
+    px = state.prefix
+    return (
+        m in C.M_BUCKETS
+        and state.assumed_load.shape == (m,)
+        and state.ot_v.shape == (m,)
+        and px.keys.ndim == 1
+        and px.present.shape == (int(px.keys.shape[0]), m // 32)
+        and px.ages.shape == px.keys.shape
+        and tuple(state.rr.shape) == ()
+        and tuple(state.tick.shape) == ()
+    )
+
+
 def _complete_update(state: SchedState, slots: jax.Array, costs: jax.Array) -> SchedState:
     """Request-termination feedback: subtract reconciled assumed load.
 
@@ -888,13 +914,89 @@ class Scheduler:
         # so a mixed-layout checkpoint (e.g. ot_v saved at a different M
         # bucket than assumed_load) passes the width probe above. A
         # corrupted checkpoint must fail HERE with False, not later inside
-        # the jitted cycle with an opaque shape error.
-        m = restored.m
-        px = restored.prefix
-        if (restored.ot_v.shape != (m,)
-                or px.present.shape != (int(px.keys.shape[0]), m // 32)
-                or px.ages.shape != px.keys.shape):
+        # the jitted cycle with an opaque shape error. (Shared with the
+        # replication follower's digest install: check_state_shapes.)
+        if not check_state_shapes(restored):
             return False
         with self._lock:
             self.state = restored
+        return True
+
+    # -- replication digest surface (gie_tpu/replication) ------------------
+
+    def export_state(self) -> dict:
+        """Flat host-array dict of the full scheduler state for the
+        replication digest's "sched" section: the prefix table columns,
+        the assumed-load vector, the sinkhorn warm-start duals, and the
+        rr/tick counters.
+
+        The lock is held only to enqueue DEVICE copies (donation safety:
+        the live buffers are deleted by the next pick, so a bare
+        reference would race — but a copy's buffers are fresh and never
+        donated). The multi-MB device-to-host transfer then runs OUTSIDE
+        the lock, so the leader's periodic digest refresh never stalls
+        the pick hot path for the sync (unlike save_state, which is a
+        rare shutdown-time call and keeps the simple form)."""
+        from gie_tpu.sched.prefix import snapshot_table
+
+        with self._lock:
+            snap = jax.tree.map(jnp.copy, self.state)
+        host = jax.tree.map(np.asarray, snap)
+        table = snapshot_table(host.prefix)
+        return {
+            "prefix_keys": table["keys"],
+            "prefix_present": table["present"],
+            "prefix_ages": table["ages"],
+            "assumed_load": host.assumed_load,
+            "ot_v": host.ot_v,
+            "rr": host.rr,
+            "tick": host.tick,
+        }
+
+    def prepare_install(self, arrays: dict) -> Optional[SchedState]:
+        """Validation half of install_state: build a SchedState from
+        digest arrays and run the SAME cross-field checks as the
+        checkpoint restore path, WITHOUT touching live state. Returns
+        None on any malformation. Split from the commit so a multi-
+        section digest can validate every section before mutating
+        anything (replication manager: all-or-nothing installs)."""
+        from gie_tpu.sched.prefix import table_from_arrays
+
+        try:
+            table = table_from_arrays({
+                "keys": arrays["prefix_keys"],
+                "present": arrays["prefix_present"],
+                "ages": arrays["prefix_ages"],
+            })
+            if table is None:
+                return None
+            load = np.asarray(arrays["assumed_load"], np.float32)
+            ot_v = np.asarray(arrays["ot_v"], np.float32)
+            rr = np.asarray(arrays["rr"], np.uint32)
+            tick = np.asarray(arrays["tick"], np.uint32)
+        except (KeyError, TypeError, ValueError):
+            return None
+        restored = SchedState(
+            prefix=table,
+            assumed_load=jnp.asarray(load),
+            rr=jnp.asarray(rr.reshape(()) if rr.size == 1 else rr),
+            tick=jnp.asarray(tick.reshape(()) if tick.size == 1 else tick),
+            ot_v=jnp.asarray(ot_v),
+        )
+        return restored if check_state_shapes(restored) else None
+
+    def commit_install(self, state: SchedState) -> None:
+        """Commit half: atomic swap under the lock — never inside the
+        jitted cycle, and only ever with a prepare_install-validated
+        state."""
+        with self._lock:
+            self.state = state
+
+    def install_state(self, arrays: dict) -> bool:
+        """Validated inverse of export_state (single-component form).
+        Returns False (prior state kept) on any malformation."""
+        prepared = self.prepare_install(arrays)
+        if prepared is None:
+            return False
+        self.commit_install(prepared)
         return True

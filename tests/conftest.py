@@ -48,6 +48,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); bounded "
+        "multi-stack scenarios like the replication failover test",
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -280,6 +280,111 @@ def test_recommender_scale_to_zero_does_not_flap():
         assert rec.desired == 0 and rec.direction == "hold"
 
 
+def test_controller_follower_samples_but_never_recommends():
+    """A follower EPP's pick counters never move (ext-proc readiness is
+    NOT_SERVING), so its local view reads as utilization 0 — the loop
+    must keep sampling (fresh baselines for promotion) but never export
+    recommendations from that view."""
+    from gie_tpu.autoscale.actuator import ReplicaActuator
+
+    store = MetricsStore()
+    coll = SignalCollector(store, lambda: _eps(2), staleness_s=60.0)
+    rec = AutoscaleRecommender(RecommenderConfig(
+        min_replicas=1, max_replicas=8, down_cooldown_s=0.0))
+    leading = {"v": False}
+    ctrl = AutoscaleController(
+        coll, rec, ReplicaActuator(None, "default", None, dry_run=True),
+        is_leader=lambda: leading["v"])
+    for slot in range(2):
+        store.update(slot, {int(C.Metric.QUEUE_DEPTH): 1.0}, now=99.0)
+    assert ctrl.step(now=100.0) is None    # baseline window
+    assert ctrl.step(now=101.0) is None    # follower: sampled, no rec
+    assert ctrl.step(now=102.0) is None
+    # Promotion: the very next step recommends off a FRESH window, not a
+    # 3-cycle-old baseline.
+    leading["v"] = True
+    out = ctrl.step(now=103.0)
+    assert out is not None and out.at == 103.0
+
+
+def test_recommender_wake_from_zero_scales_one():
+    """Scale-FROM-zero (ROADMAP): a request 503'd against an empty pool is
+    the wake signal — immediate 0->1, no sustain window."""
+    r = _rec(RecommenderConfig(min_replicas=0, max_replicas=8))
+    rec = r.observe(
+        _signals(ready_replicas=0, wake_arrivals=1), 0, now=0.0)
+    assert rec.desired == 1 and rec.direction == "up"
+    assert "wake-from-zero" in rec.reason
+    # Quiet again next window -> stays wherever the actuator took it; an
+    # empty pool with NO arrivals still holds at 0 (no flap).
+    rec2 = r.observe(_signals(ready_replicas=0), 0, now=1.0)
+    assert rec2.desired == 0 and rec2.direction == "hold"
+
+
+def test_empty_pool_arrival_flows_store_to_recommendation():
+    """End-to-end wake path: ext-proc records the 503'd first arrival in
+    MetricsStore, the collector drains it into the next window's signals,
+    and the recommender turns it into a 0->1 recommendation."""
+    store = MetricsStore()
+    coll = SignalCollector(store, lambda: [], staleness_s=2.0)
+    assert coll.sample(now=100.0) is None      # baseline window
+    store.note_empty_pool_arrival()            # the 503'd request
+    sig = coll.sample(now=101.0)
+    assert sig is not None and sig.wake_arrivals == 1 and not sig.stale
+    rec = _rec(RecommenderConfig(min_replicas=0, max_replicas=8)).observe(
+        sig, 0, now=101.0)
+    assert rec.desired == 1 and "wake-from-zero" in rec.reason
+    # Drained: the arrival is observed by exactly one window.
+    sig2 = coll.sample(now=102.0)
+    assert sig2.wake_arrivals == 0
+
+
+def test_picker_records_empty_pool_arrival():
+    """BatchingTPUPicker.pick with no candidates (empty pool) must note
+    the arrival before raising UNAVAILABLE — that 503 IS the wake-from-
+    zero traffic signal."""
+    import grpc
+    import pytest as _pytest
+
+    from gie_tpu.datastore import Datastore
+    from gie_tpu.extproc.server import ExtProcError, PickRequest
+    from gie_tpu.sched.batching import BatchingTPUPicker
+    from gie_tpu.sched.profile import Scheduler
+
+    store = MetricsStore()
+    picker = BatchingTPUPicker(Scheduler(), Datastore(), store)
+    try:
+        with _pytest.raises(ExtProcError) as exc:
+            picker.pick(PickRequest(headers={}, body=None), [])
+        assert exc.value.code == grpc.StatusCode.UNAVAILABLE
+        assert store.take_wake_arrivals() == 1
+    finally:
+        picker.close()
+
+
+def test_capacity_model_save_restore_seeds_estimate(tmp_path):
+    """ROADMAP (persisted capacity): a converged EWMA written on leader
+    shutdown seeds a restarted EPP's model instead of the default."""
+    m = CapacityModel(default_per_replica=8.0)
+    m.update(_signals(saturated_fraction=0.9, admitted_per_s=20.0,
+                      ready_replicas=4))
+    assert m.converged
+    m.save(str(tmp_path / "cap"))
+    m2 = CapacityModel(default_per_replica=8.0)
+    assert m2.restore(str(tmp_path / "cap"))
+    assert m2.converged
+    assert m2.per_replica() == m.per_replica() == 5.0
+    # No checkpoint -> unconverged default behavior unchanged.
+    m3 = CapacityModel(default_per_replica=8.0)
+    assert not m3.restore(str(tmp_path / "missing"))
+    assert not m3.converged and m3.per_replica() == 8.0
+    # An UNCONVERGED model saves NaN and restores unconverged.
+    m3.save(str(tmp_path / "cold"))
+    m4 = CapacityModel(default_per_replica=8.0)
+    assert m4.restore(str(tmp_path / "cold"))
+    assert not m4.converged and m4.per_replica() == 8.0
+
+
 def test_recommender_stale_holds_exactly_current():
     r = _rec(RecommenderConfig(min_replicas=2, max_replicas=4))
     stale = _signals(ready_replicas=8, admitted_per_s=1000.0,

@@ -1,0 +1,121 @@
+"""Exact-tie fallback enumeration (ADVICE r5 #4).
+
+The threshold-descent _topk skips duplicate values: an exact float tie
+used to gate the tied lane at NEG, silently shortening the protocol's
+ordered fallback list. The iota*ulp tiebreak makes in-row values pairwise
+distinct for the noise-based pickers; these tests drive DUPLICATE-endpoint
+identical-score waves (noise forced to zero — the worst case the Gumbel
+temperature normally makes merely improbable) and pin that every tied lane
+now appears as its own fallback entry, while topk_picker's rotating
+tie-break semantics are untouched.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.pickers import (
+    _iota_tiebreak,
+    topk_picker,
+    weighted_random_picker,
+)
+from gie_tpu.sched.sinkhorn import sinkhorn_picker
+from gie_tpu.utils.testing import make_endpoints
+
+
+def _wave(n=4, m=16, live=8):
+    scores = jnp.full((n, m), 0.625, jnp.float32)  # all-identical scores
+    mask = jnp.zeros((n, m), bool).at[:, :live].set(True)
+    shed = jnp.zeros((n,), bool)
+    valid = jnp.ones((n,), bool)
+    return scores, mask, shed, valid
+
+
+def _assert_full_distinct_fallbacks(indices, live):
+    idx = np.asarray(indices)
+    assert (idx >= 0).all(), f"tied lanes dropped from fallback list: {idx}"
+    for row in idx:
+        assert len(set(row.tolist())) == C.FALLBACKS, row
+        assert all(0 <= s < live for s in row), row
+
+
+def test_random_picker_exact_ties_enumerate_fallbacks():
+    scores, mask, shed, valid = _wave()
+    # temperature=0 forces EXACT ties across the 8 duplicate lanes (the
+    # picker's config validation forbids 0 precisely because of the old
+    # truncation failure mode; calling the kernel directly is the test's
+    # way to make the improbable collision certain).
+    res = weighted_random_picker(
+        scores, mask, shed, valid, jax.random.PRNGKey(0), temperature=0.0)
+    _assert_full_distinct_fallbacks(res.indices, live=8)
+    assert (np.asarray(res.status) == C.Status.OK).all()
+
+
+def test_sinkhorn_picker_duplicate_endpoints_exact_ties():
+    n, m, live = 4, 16, 8
+    scores, mask, shed, valid = _wave(n, m, live)
+    # Identical metrics on every duplicate endpoint -> identical transport
+    # plan columns; rounding_temp=0 removes the symmetry-breaking noise.
+    eps = make_endpoints(
+        live, queue=[4.0] * live, kv=[0.2] * live, m_slots=m)
+    res, _v = sinkhorn_picker(
+        scores, mask, shed, valid, eps, jax.random.PRNGKey(1),
+        queue_limit=128.0, tau=0.02, iters=8, rounding_temp=0.0)
+    _assert_full_distinct_fallbacks(res.indices, live=live)
+
+
+def test_topk_picker_rotation_semantics_unchanged():
+    """topk_picker opts out of the iota nudge: its quantize-and-rotate
+    tie-break already guarantees distinctness, and the round-robin
+    ordering across cycles must stay exactly as before."""
+    # live == m so the rotating lane priority wraps within the tied set.
+    scores, mask, shed, valid = _wave(m=8, live=8)
+    primaries = set()
+    for rr in range(8):
+        res = topk_picker(scores, mask, shed, valid, jnp.uint32(rr))
+        idx = np.asarray(res.indices)
+        assert (idx >= 0).all()
+        primaries.add(int(idx[0, 0]))
+    # The rotation spreads the primary pick across tied lanes over cycles.
+    assert len(primaries) > 1
+
+
+def test_iota_tiebreak_preserves_order_and_neg_lanes():
+    """The nudge must (a) keep ineligible lanes at the exact NEG sentinel,
+    (b) never reorder scores separated by more than M ulps, and (c) make
+    exact ties strictly distinct — including in the log-domain magnitudes
+    the sinkhorn path produces, where a fixed epsilon would be absorbed."""
+    masked = jnp.asarray(
+        [[0.9, 0.1, 0.1, C.NEG_SCORE],
+         [-42.0, -42.0, -41.0, C.NEG_SCORE]], jnp.float32)
+    mask = jnp.asarray(
+        [[True, True, True, False], [True, True, True, False]])
+    out = np.asarray(_iota_tiebreak(masked, mask))
+    assert out[0, 3] == C.NEG_SCORE and out[1, 3] == C.NEG_SCORE
+    assert out[0, 0] > out[0, 1] and out[0, 0] > out[0, 2]  # order kept
+    assert out[0, 1] != out[0, 2]                           # tie broken
+    assert out[1, 0] != out[1, 1], "log-domain tie must split (ulp-relative)"
+    assert out[1, 2] > max(out[1, 0], out[1, 1])            # order kept
+
+
+def test_iota_tiebreak_near_ulp_ties_stay_distinct():
+    """The tiebreak must not MANUFACTURE collisions between distinct
+    near-equal scores: lanes i<j exactly (j-i) ulps apart would collide
+    under a naive bits+lane addition. The lane-field replacement keeps
+    every such pair distinct, so both lanes survive into the fallback
+    list."""
+    base = np.float32(1.5)
+    near = np.float32(base)
+    for _ in range(2):
+        near = np.float32(np.nextafter(near, np.float32(0.0)))
+    # lane 0 = 1.5, lane 2 = 1.5 - 2 ulps: the historical collision case.
+    masked = jnp.asarray([[base, 0.25, near, 0.25]], jnp.float32)
+    mask = jnp.ones((1, 4), bool)
+    out = np.asarray(_iota_tiebreak(masked, mask))
+    assert len(set(out[0].tolist())) == 4, out
+    res = weighted_random_picker(
+        masked, mask, jnp.zeros((1,), bool), jnp.ones((1,), bool),
+        jax.random.PRNGKey(0), temperature=0.0)
+    idx = np.asarray(res.indices)[0]
+    assert sorted(idx.tolist()) == [0, 1, 2, 3], idx

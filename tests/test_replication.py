@@ -1,0 +1,575 @@
+"""HA state replication: codec robustness, digest round-trips, sync loop.
+
+Three tiers of coverage, all tier-1 safe (single process, loopback only):
+
+  codec     fuzz/robustness — truncated frames, flipped bytes, unknown
+            future sections, bogus versions/flags, random junk: everything
+            malformed returns None, NOTHING raises into the follower loop
+            (extends the tests/test_protocol_fuzz.py posture to the
+            replication wire format).
+  state     export/install round-trips must be BIT-exact for the prefix
+            table, assumed load, sinkhorn duals, predictor params, and the
+            capacity EWMA — and installs must reject cross-field shape
+            corruption the same way profile.py's checkpoint restore does.
+  sync      publisher->follower smoke over the in-memory transport and the
+            real HTTP listener: full snapshot, ETag 304, delta frames,
+            epoch regression, era change (leader failover) forcing a full
+            resync, and the manager's promote/demote wiring.
+
+The two-process failover scenario lives in test_replication_failover.py
+(marked slow; bounded <30s).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from gie_tpu.replication import (
+    FollowerSync,
+    ReplicationHTTPServer,
+    ReplicationManager,
+    StatePublisher,
+    advertise_from_identity,
+    codec,
+    replication_identity,
+)
+from gie_tpu.replication import follower as fol_mod
+from gie_tpu.sched.profile import ProfileConfig, Scheduler
+from gie_tpu.utils.testing import make_endpoints, make_requests
+
+# ---------------------------------------------------------------------------
+# codec
+
+
+def _sections(rng: np.random.Generator) -> dict:
+    return {
+        "sched": {
+            "keys": rng.integers(0, 2**32, 64, dtype=np.uint32),
+            "load": rng.standard_normal(16).astype(np.float32),
+            "flag": np.bool_(True),
+            "scalar": np.float32(np.nan),
+        },
+        "extra": {
+            "i64": rng.integers(-5, 5, (3, 4), dtype=np.int64),
+            "empty": np.zeros((0,), np.float64),
+        },
+    }
+
+
+def test_codec_roundtrip_bit_exact(rng):
+    sections = _sections(rng)
+    blob = codec.encode_digest(42, sections)
+    d = codec.decode_digest(blob)
+    assert d is not None and d.epoch == 42 and not d.delta
+    for name, arrays in sections.items():
+        for key, arr in arrays.items():
+            got = d.sections[name][key]
+            assert got.dtype == np.asarray(arr).dtype
+            assert np.array_equal(got, np.asarray(arr), equal_nan=True)
+
+
+def test_codec_delta_header_roundtrip(rng):
+    blob = codec.encode_digest(
+        9, {"only": {"x": np.arange(3)}}, delta=True, base_epoch=7)
+    d = codec.decode_digest(blob)
+    assert d is not None and d.delta and d.base_epoch == 7 and d.epoch == 9
+
+
+def test_codec_rejects_truncation_at_every_boundary(rng):
+    blob = codec.encode_digest(3, _sections(rng))
+    assert codec.decode_digest(blob) is not None
+    # Every strict prefix must reject cleanly (sweep a stride plus the
+    # interesting first/last few bytes).
+    cuts = set(range(0, len(blob), 17)) | set(range(12)) | {
+        len(blob) - k for k in range(1, 6)}
+    for cut in sorted(cuts):
+        assert codec.decode_digest(blob[:cut]) is None, f"cut={cut}"
+    # Trailing junk is corruption too, not an extension point.
+    assert codec.decode_digest(blob + b"\x00") is None
+
+
+def test_codec_rejects_every_single_byte_flip(rng):
+    """The CRC net has no holes: the header CRC covers epoch/flags/counts,
+    each section CRC covers its name AND payload, and length-field flips
+    shift the parse onto bytes whose CRC cannot match. EVERY single-byte
+    corruption of a valid digest must reject whole."""
+    blob = codec.encode_digest(3, _sections(rng))
+    assert codec.decode_digest(blob) is not None
+    for pos in range(len(blob)):
+        mutated = bytearray(blob)
+        mutated[pos] ^= 0x5A
+        assert codec.decode_digest(bytes(mutated)) is None, f"pos={pos}"
+
+
+def test_codec_rejects_random_junk():
+    rng = random.Random(0)
+    for _ in range(200):
+        blob = rng.randbytes(rng.randint(0, 400))
+        assert codec.decode_digest(blob) is None
+    # Junk wearing the right magic must still reject.
+    for _ in range(100):
+        blob = codec.MAGIC + rng.randbytes(rng.randint(0, 200))
+        assert codec.decode_digest(blob) is None
+
+
+def test_codec_rejects_unknown_version_and_flags(rng):
+    blob = bytearray(codec.encode_digest(1, {"s": {"x": np.arange(2)}}))
+    v2 = bytearray(blob)
+    v2[4] = codec.VERSION + 1  # version u16 LE low byte
+    assert codec.decode_digest(bytes(v2)) is None
+    f2 = bytearray(blob)
+    f2[6] |= 0x80  # unknown flag bit
+    assert codec.decode_digest(bytes(f2)) is None
+
+
+def test_codec_unknown_future_section_decodes_and_installs_skip(rng):
+    """Forward compat: a newer leader's extra section decodes fine and the
+    manager's installer ignores it rather than failing the digest."""
+    sched = Scheduler(ProfileConfig())
+    blob = codec.encode_digest(1, {
+        "sched": sched.export_state(),
+        "from_the_future": {"mystery": rng.standard_normal(7)},
+    })
+    d = codec.decode_digest(blob)
+    assert d is not None and "from_the_future" in d.sections
+    mgr = ReplicationManager(scheduler=sched, port=0)
+    try:
+        assert mgr._install(d.sections, delta=False)
+    finally:
+        mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# state surfaces
+
+
+def _warm_scheduler(m_slots: int = 64) -> Scheduler:
+    sched = Scheduler(ProfileConfig())
+    eps = make_endpoints(8, queue=[2.0] * 8, kv=[0.2] * 8, m_slots=m_slots)
+    prompts = [b"SYS %d " % (i % 3) * 8 + b"user %d" % i for i in range(8)]
+    reqs = make_requests(8, prompts=prompts, m_slots=m_slots)
+    sched.pick(reqs, eps)
+    return sched
+
+
+def test_scheduler_digest_roundtrip_bit_exact():
+    a = _warm_scheduler()
+    exported = a.export_state()
+    # Through the full codec, not just the dicts.
+    d = codec.decode_digest(codec.encode_digest(1, {"sched": exported}))
+    b = Scheduler(ProfileConfig())
+    assert b.install_state(d.sections["sched"])
+    again = b.export_state()
+    for key, arr in exported.items():
+        assert np.array_equal(arr, again[key]), key
+    assert b.state.m == a.state.m
+
+
+def test_scheduler_install_rejects_cross_field_corruption():
+    a = _warm_scheduler()
+    good = a.export_state()
+    b = Scheduler(ProfileConfig())
+    assert b.install_state(good)
+    before = b.export_state()
+    corruptions = [
+        {"ot_v": good["ot_v"][:5]},                      # wrong dual width
+        {"assumed_load": np.zeros((63,), np.float32)},   # not an M bucket
+        {"prefix_present": good["prefix_present"][:100]},  # row mismatch
+        {"prefix_ages": good["prefix_ages"][:-1]},       # ages != keys
+        {"rr": np.zeros((4,), np.uint32)},               # non-scalar counter
+    ]
+    for patch in corruptions:
+        bad = {**good, **patch}
+        assert not b.install_state(bad), patch
+    for key in good:
+        missing = {k: v for k, v in good.items() if k != key}
+        assert not b.install_state(missing), f"missing {key}"
+    # Prior state survived every rejection (the follower's invariant).
+    after = b.export_state()
+    for key, arr in before.items():
+        assert np.array_equal(arr, after[key]), key
+
+
+def test_trainer_digest_roundtrip_and_rejects():
+    from gie_tpu.models.latency import LatencyPredictor, OnlineTrainer
+
+    a = OnlineTrainer(LatencyPredictor(), seed=1)
+    a._loss_ema = 0.03
+    a._observed_total = 500
+    exported = a.export_state()
+    b = OnlineTrainer(LatencyPredictor(), seed=2)
+    assert b.install_state(exported)
+    again = b.export_state()
+    for key, arr in exported.items():
+        assert np.array_equal(
+            np.asarray(arr), np.asarray(again[key]), equal_nan=True), key
+    assert b.confidence() == pytest.approx(a.confidence())
+    # A differently-shaped param leaf (other architecture) rejects whole.
+    some_param = next(k for k in exported if k.startswith("param"))
+    bad = dict(exported)
+    bad[some_param] = np.zeros(
+        tuple(s + 1 for s in np.asarray(exported[some_param]).shape),
+        np.float32)
+    assert not b.install_state(bad)
+    assert not b.install_state(
+        {k: v for k, v in exported.items() if k != some_param})
+
+
+def test_capacity_ewma_digest_and_checkpoint(tmp_path):
+    from gie_tpu.autoscale.model import CapacityModel
+
+    a = CapacityModel()
+    a._ewma = 6.25
+    b = CapacityModel()
+    assert b.install_state(a.export_state())
+    assert b.converged and b.per_replica() == pytest.approx(6.25)
+    # Unconverged exports NaN and installs as "no estimate", not zero.
+    c = CapacityModel()
+    assert b.install_state(c.export_state()) is True
+    assert not b.converged
+    # utils/checkpoint persistence (leader shutdown -> restarted seed).
+    a.save(str(tmp_path / "cap"))
+    d = CapacityModel()
+    assert d.restore(str(tmp_path / "cap"))
+    assert d.converged and d.per_replica() == pytest.approx(6.25)
+    assert not CapacityModel().restore(str(tmp_path / "nope"))
+    assert not b.install_state({"wrong": np.float32(1.0)})
+
+
+# ---------------------------------------------------------------------------
+# publisher / follower protocol
+
+
+class _MemFetch:
+    """In-memory transport: follower wired straight to publisher.serve."""
+
+    def __init__(self, publisher, leader=lambda: True):
+        self.publisher = publisher
+        self.leader = leader
+
+    def __call__(self, base_url, since, era, etag):
+        return self.publisher.serve(
+            since=since, era=era, if_none_match=etag, leader=self.leader())
+
+
+def test_publisher_epoch_bumps_only_on_change():
+    state = {"x": np.arange(4, dtype=np.float32)}
+    pub = StatePublisher({"s": lambda: dict(state)})
+    assert pub.refresh() == 1
+    assert pub.refresh() == 1
+    state["x"] = state["x"] + 1.0
+    assert pub.refresh() == 2
+    assert pub.digest_bytes > 0
+
+
+def test_publisher_delta_carries_only_changed_sections():
+    s1 = {"x": np.arange(4, dtype=np.float32)}
+    s2 = {"y": np.arange(8, dtype=np.float32)}
+    pub = StatePublisher({"a": lambda: dict(s1), "b": lambda: dict(s2)})
+    pub.refresh()                       # epoch 1: both sections
+    s2["y"] = s2["y"] * 2.0
+    assert pub.refresh() == 2           # only "b" changed
+    status, headers, body = pub.serve(since=1, era=pub.era)
+    assert status == 200
+    d = codec.decode_digest(body)
+    assert d.delta and d.base_epoch == 1 and set(d.sections) == {"b"}
+    # Wrong era cannot get a delta: full snapshot fallback.
+    _, _, full = pub.serve(since=1, era="someone-else")
+    df = codec.decode_digest(full)
+    assert not df.delta and set(df.sections) == {"a", "b"}
+
+
+def test_publisher_304_and_not_leader_and_empty():
+    pub = StatePublisher({"s": lambda: {"x": np.zeros(1)}})
+    status, _, _ = pub.serve()
+    assert status == 503                # nothing published yet
+    pub.refresh()
+    status, headers, _ = pub.serve()
+    assert status == 200
+    status, _, _ = pub.serve(if_none_match=headers["ETag"])
+    assert status == 304
+    status, _, _ = pub.serve(leader=False)
+    assert status == 503                # followers never serve digests
+
+
+def _install_into(target: dict):
+    def install(sections, *, delta):
+        target.update(sections)
+        return True
+    return install
+
+
+def test_follower_full_delta_regression_and_era_change():
+    state = {"x": np.arange(4, dtype=np.float32)}
+    pub = StatePublisher({"s": lambda: dict(state)}, era="era-A")
+    pub.refresh()
+    got: dict = {}
+    fol = FollowerSync(
+        lambda: "mem://", _install_into(got),
+        interval_s=0.0, fetch=_MemFetch(pub))
+    assert fol.poll_once() == fol_mod.INSTALLED
+    assert fol.installed_epoch == 1 and fol.installed_era == "era-A"
+    assert fol.poll_once() == fol_mod.NOT_MODIFIED
+    # Delta path: state changes -> the follower's next poll asks
+    # ?since=1 and installs the delta against its installed base.
+    state["x"] = state["x"] + 1.0
+    pub.refresh()
+    assert fol.poll_once() == fol_mod.INSTALLED
+    assert fol.installed_epoch == 2
+    assert fol.last_delta, "second install should ride the delta path"
+    assert np.array_equal(got["s"]["x"], state["x"])
+    # Epoch regression within one era: a replayed response must not move
+    # state backward.
+    old_status, old_headers, old_body = pub.serve()
+    fol2 = FollowerSync(
+        lambda: "mem://", _install_into({}), interval_s=0.0,
+        fetch=lambda *a: (old_status, old_headers, old_body))
+    fol2.installed_era = "era-A"
+    fol2.installed_epoch = 5
+    fol2._want_full = False
+    assert fol2.poll_once() == fol_mod.STALE_EPOCH
+    assert fol2.installed_epoch == 5
+    # Era change (new leader incarnation): epoch 1 of era-B must INSTALL
+    # even though 1 < 5 — epochs are only comparable within an era.
+    pub_b = StatePublisher({"s": lambda: {"x": np.ones(2)}}, era="era-B")
+    pub_b.refresh()
+    fol2._fetch = _MemFetch(pub_b)
+    fol2._next_poll = 0.0
+    assert fol2.poll_once() == fol_mod.INSTALLED
+    assert fol2.installed_era == "era-B" and fol2.installed_epoch == 1
+
+
+def test_follower_delta_against_unknown_base_refetches_full():
+    """A delta whose base is not the follower's installed epoch (stale
+    cache / raced response) must NOT install — it forces a full-snapshot
+    re-fetch on the immediate next poll."""
+    state = {"x": np.arange(4, dtype=np.float32)}
+    pub = StatePublisher({"s": lambda: dict(state)}, era="era-A")
+    pub.refresh()
+    # A canned delta frame claiming base epoch 5 (the follower is at 1).
+    rogue = codec.encode_digest(
+        6, {"s": {"x": np.zeros(4, np.float32)}}, delta=True, base_epoch=5)
+    mem = _MemFetch(pub)
+    mode = {"rogue": False}
+
+    def fetch(base_url, since, era, etag):
+        if mode["rogue"]:
+            status, headers, _ = pub.serve(since=since, era=era)
+            return status, headers, rogue
+        return mem(base_url, since, era, etag)
+
+    got: dict = {}
+    fol = FollowerSync(
+        lambda: "mem://", _install_into(got), interval_s=0.0, fetch=fetch)
+    assert fol.poll_once() == fol_mod.INSTALLED
+    assert fol.installed_epoch == 1
+    mode["rogue"] = True
+    assert fol.poll_once() == fol_mod.DELTA_MISMATCH
+    assert fol.installed_epoch == 1     # nothing installed
+    mode["rogue"] = False
+    state["x"] = state["x"] + 5.0
+    pub.refresh()
+    out = fol.poll_once()
+    assert out == fol_mod.INSTALLED and not fol.last_delta, (
+        "recovery fetch must be a full snapshot")
+    assert fol.installed_epoch == pub.epoch
+    assert np.array_equal(got["s"]["x"], state["x"])
+
+
+def test_follower_keeps_state_on_corrupt_and_rejected():
+    pub = StatePublisher({"s": lambda: {"x": np.arange(3)}})
+    pub.refresh()
+    good, headers, body = pub.serve()
+    corrupt = body[: len(body) // 2]
+    fol = FollowerSync(
+        lambda: "mem://", _install_into({}), interval_s=0.0,
+        fetch=lambda *a: (200, headers, corrupt))
+    assert fol.poll_once() == fol_mod.CORRUPT
+    assert fol.installed_epoch == 0 and fol.rejects == 1
+    # An installer rejection (validation failure) also keeps prior state.
+    fol3 = FollowerSync(
+        lambda: "mem://", lambda sections, *, delta: False,
+        interval_s=0.0, fetch=_MemFetch(pub))
+    assert fol3.poll_once() == fol_mod.REJECTED
+    assert fol3.installed_epoch == 0
+    # And an installer that RAISES is contained, never propagated.
+    def boom(sections, *, delta):
+        raise RuntimeError("installer bug")
+    fol4 = FollowerSync(
+        lambda: "mem://", boom, interval_s=0.0, fetch=_MemFetch(pub))
+    assert fol4.poll_once() == fol_mod.REJECTED
+
+
+def test_follower_backoff_on_no_leader_and_fetch_error():
+    fol = FollowerSync(
+        lambda: None, _install_into({}), interval_s=0.1, backoff_max_s=1.0)
+    assert fol.poll_once(now=100.0) == fol_mod.NO_LEADER
+    assert fol.poll_once(now=100.05) is None  # backoff window
+    def dead_fetch(*a):
+        raise OSError("connection refused")
+    # jitter=0 makes the schedule deterministic: doubling from the poll
+    # interval, capped at backoff_max (the jittered spread is a scalar on
+    # top of exactly this sequence).
+    fol2 = FollowerSync(
+        lambda: "http://127.0.0.1:1", _install_into({}),
+        interval_s=0.1, backoff_max_s=1.0, jitter=0.0, fetch=dead_fetch)
+    t = 100.0
+    delays = []
+    for _ in range(5):
+        assert fol2.poll_once(now=t) == fol_mod.FETCH_ERROR
+        delays.append(round(fol2._next_poll - t, 6))
+        t = fol2._next_poll
+    assert delays == [0.2, 0.4, 0.8, 1.0, 1.0]
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport + manager wiring (tier-1 smoke)
+
+
+def test_http_round_trip_smoke():
+    """Single-process publisher -> real HTTP listener -> follower install
+    into a second scheduler: the tier-1 guard that replication correctness
+    is exercised in every run (full snapshot AND 304 path)."""
+    a = _warm_scheduler()
+    pub = StatePublisher({"sched": a.export_state})
+    pub.refresh()
+    srv = ReplicationHTTPServer(pub, 0)
+    try:
+        b = Scheduler(ProfileConfig())
+        fol = FollowerSync(
+            lambda: f"http://127.0.0.1:{srv.port}",
+            lambda sections, *, delta: b.install_state(sections["sched"]),
+            interval_s=0.0)
+        assert fol.poll_once() == fol_mod.INSTALLED
+        assert fol.poll_once() == fol_mod.NOT_MODIFIED
+        exported, again = a.export_state(), b.export_state()
+        for key, arr in exported.items():
+            assert np.array_equal(arr, again[key]), key
+    finally:
+        srv.close()
+
+
+def test_manager_in_memory_sync_and_promotion():
+    from types import SimpleNamespace
+
+    a = _warm_scheduler()
+    mgr_a = ReplicationManager(scheduler=a, port=0, interval_s=0.0)
+    b = Scheduler(ProfileConfig())
+    leader_holder = replication_identity(mgr_a.advertise, base="stack-a")
+    role = {"leader": False}
+    elector_b = SimpleNamespace(
+        is_leader=lambda: role["leader"],
+        holder_identity=lambda: leader_holder,
+        identity="stack-b|127.0.0.1:1",
+    )
+    mgr_b = ReplicationManager(
+        scheduler=b, elector=elector_b, port=0, interval_s=0.0)
+    try:
+        assert mgr_a.is_leader()          # no elector = single leader
+        assert mgr_a.step() == "published"
+        assert not mgr_b.is_leader() and not mgr_b.healthy()
+        assert mgr_b.step() == fol_mod.INSTALLED
+        assert mgr_b.healthy()
+        exported, again = a.export_state(), b.export_state()
+        for key, arr in exported.items():
+            assert np.array_equal(arr, again[key]), key
+        # Promotion: the warm state is already live; the callback records
+        # the epoch it promoted with and the role gauge flips.
+        role["leader"] = True
+        mgr_b.on_role_change(True)
+        assert mgr_b.promoted_with_epoch == mgr_b.follower.installed_epoch > 0
+        assert mgr_b.is_leader() and mgr_b.healthy()
+        assert mgr_b.step() == "published"
+        # Demotion flips back to syncing on the next tick.
+        role["leader"] = False
+        mgr_b.on_role_change(False)
+        assert mgr_b.step() in (
+            fol_mod.INSTALLED, fol_mod.NOT_MODIFIED, None)
+    finally:
+        mgr_a.stop()
+        mgr_b.stop()
+
+
+def test_mixed_digest_rejects_without_partial_install():
+    """A digest whose 'predictor' section fails validation must leave the
+    scheduler UNTOUCHED too — installs are all-or-nothing, or a promotion
+    racing the next poll would serve a mixed-epoch state."""
+    from gie_tpu.models.latency import LatencyPredictor, OnlineTrainer
+
+    leader_sched = _warm_scheduler()
+    follower_sched = Scheduler(ProfileConfig())
+    trainer = OnlineTrainer(LatencyPredictor(), seed=3)
+    before = follower_sched.export_state()
+    mgr = ReplicationManager(
+        scheduler=follower_sched, trainer=trainer, port=0)
+    try:
+        sections = {
+            "sched": leader_sched.export_state(),          # valid
+            "predictor": {"param/bogus": np.zeros(3)},     # rejects
+        }
+        assert mgr._install(sections, delta=False) is False
+        after = follower_sched.export_state()
+        for key, arr in before.items():
+            assert np.array_equal(arr, after[key]), (
+                f"partial install leaked into scheduler state: {key}")
+        # And the valid-everything digest still installs both.
+        sections["predictor"] = trainer.export_state()
+        assert mgr._install(sections, delta=False) is True
+        leader_exp = leader_sched.export_state()
+        follower_exp = follower_sched.export_state()
+        for key, arr in leader_exp.items():
+            assert np.array_equal(arr, follower_exp[key]), key
+    finally:
+        mgr.stop()
+
+
+def test_options_reject_wildcard_bind_without_advertise():
+    from gie_tpu.runtime.options import Options
+
+    opts = Options(pool_name="p", replication_port=9005,
+                   replication_bind="0.0.0.0")
+    with pytest.raises(ValueError, match="advertise"):
+        opts.validate()
+    opts.replication_advertise = "10.0.0.7:9005"
+    opts.validate()  # explicit advertise makes the wildcard bind fine
+
+
+def test_identity_advertise_round_trip():
+    ident = replication_identity("10.0.0.7:9005")
+    assert advertise_from_identity(ident) == "10.0.0.7:9005"
+    assert advertise_from_identity("plain-pid-uuid") is None
+    assert advertise_from_identity("") is None
+    assert advertise_from_identity(None) is None
+    assert advertise_from_identity("x|not-an-addr") is None
+
+
+def test_runner_wires_replication(tmp_path):
+    """--replication-port wires the manager, embeds the advertise address
+    in the elector identity, and exposes replication health."""
+    import socket
+
+    from gie_tpu.runtime.options import Options
+    from gie_tpu.runtime.runner import ExtProcServerRunner
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    opts = Options(
+        pool_name="pool", leader_elect=True,
+        leader_lease_path=str(tmp_path / "lease"),
+        replication_port=port)
+    opts.validate()
+    runner = ExtProcServerRunner(opts, object())  # file elector path
+    try:
+        assert runner.replication is not None
+        assert advertise_from_identity(runner.elector.identity) == (
+            f"127.0.0.1:{port}")
+        assert runner.elector.on_role_change == (
+            runner.replication.on_role_change)
+        # Leader with no peer: healthy by definition once leading.
+        assert runner.replication.is_leader() is False  # not started
+    finally:
+        runner.replication.stop()
+        runner.picker.close()
+        runner.scraper.close()

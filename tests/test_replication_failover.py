@@ -1,0 +1,179 @@
+"""Two-EPP failover: the promoted follower takes over WARM.
+
+The scenario the subsystem exists for: two full election+replication
+stacks contend on a Lease through the fake apiserver while traffic with
+heavy prefix reuse warms the leader's state. The leader is then killed
+mid-traffic (crash semantics: renew loop stopped WITHOUT the graceful
+release). The follower must win the lease and serve its first waves from
+the replicated prefix table — hit-rate within a bound of the dead
+leader's — while a cold-takeover control (same traffic, fresh state)
+measurably underperforms.
+
+Marked slow (two jit-compiled scheduler stacks + real lease TTL waits);
+bounded well under 30s. The tier-1 replication guarantees live in
+tests/test_replication.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from gie_tpu.controller.kube import KubeClusterClient
+from gie_tpu.replication import ReplicationManager, replication_identity
+from gie_tpu.runtime.leader import KubeLeaseElector
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.profile import ProfileConfig, Scheduler
+from gie_tpu.utils.testing import make_endpoints, make_requests
+from tests.fakeapi import FakeKubeApiServer
+
+NS = "default"
+M_SLOTS = 64
+WAVE = 8          # requests per wave (N bucket 8)
+SESSIONS = 80
+
+
+def _wait(predicate, timeout_s: float = 6.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _session_prompt(i: int) -> bytes:
+    # ~300 bytes of per-session repeated prefix -> ~5 rolling-hash chunks
+    # shared by every request of session i.
+    return (b"SESSION %04d CONTEXT " % i) * 15 + b"turn"
+
+
+def _wave_reqs(session_ids):
+    return make_requests(
+        len(session_ids),
+        prompts=[_session_prompt(i) for i in session_ids],
+        m_slots=M_SLOTS)
+
+
+def _hit_rate(sched: Scheduler, eps, wave_sessions) -> float:
+    """Fraction of OK picks that landed on an endpoint the prefix index
+    already associates with the request's chain (explain runs the same
+    build_stages as the cycle, before the pick's own insert)."""
+    hits = total = 0
+    for sessions in wave_sessions:
+        reqs = _wave_reqs(sessions)
+        ex = sched.explain(reqs, eps)
+        res = sched.pick(reqs, eps)
+        idx = np.asarray(res.indices)[:, 0]
+        status = np.asarray(res.status)
+        for i in range(len(idx)):
+            if status[i] == C.Status.OK and idx[i] >= 0:
+                total += 1
+                if ex["prefix"][i, idx[i]] > 0.0:
+                    hits += 1
+    return hits / max(total, 1)
+
+
+def _groups(lo: int, hi: int):
+    ids = list(range(lo, hi))
+    return [ids[k:k + WAVE] for k in range(0, len(ids), WAVE)]
+
+
+class _Stack:
+    """One EPP's worth of failover machinery: scheduler + Lease elector +
+    replication manager, identity advertising the manager's digest port."""
+
+    def __init__(self, name: str, apiserver):
+        self.scheduler = Scheduler(ProfileConfig())
+        self.manager = ReplicationManager(
+            scheduler=self.scheduler, port=0, interval_s=0.1)
+        client = KubeClusterClient(NS, "pool", server=apiserver.url,
+                                   token="t")
+        self.elector = KubeLeaseElector(
+            client, NS, "pool-epp-leader",
+            identity=replication_identity(self.manager.advertise, base=name),
+            lease_ttl_s=0.6, renew_interval_s=0.08,
+            on_role_change=self.manager.on_role_change)
+        self.manager.attach_elector(self.elector)
+
+    def start(self):
+        self.elector.start()
+        self.manager.start()
+
+    def crash(self):
+        """Kill the renew loop WITHOUT the graceful release (a crash
+        cannot blank the holder) and tear the digest listener down."""
+        self.elector._stop.set()
+        if self.elector._thread is not None:
+            self.elector._thread.join(timeout=2)
+        self.manager.stop()
+
+    def stop(self):
+        self.manager.stop()
+        self.elector.stop()
+
+
+@pytest.mark.slow
+def test_leader_kill_promotes_warm_follower():
+    started = time.monotonic()
+    api = FakeKubeApiServer()
+    a = _Stack("stack-a", api)
+    b = _Stack("stack-b", api)
+    eps = make_endpoints(
+        8, queue=[2.0] * 8, kv=[0.2] * 8, m_slots=M_SLOTS)
+    try:
+        a.start()
+        assert _wait(a.elector.is_leader), "stack A never took the lease"
+        b.start()
+        time.sleep(0.2)
+        assert not b.elector.is_leader(), "two leaders"
+        assert a.manager.is_leader() and not b.manager.is_leader()
+
+        # -- warm traffic on the leader: every session inserted ---------
+        for sessions in _groups(0, SESSIONS):
+            a.scheduler.pick(_wave_reqs(sessions), eps)
+
+        # Pre-failover reference hit-rate over sessions the index knows.
+        pre_rate = _hit_rate(a.scheduler, eps, _groups(0, 40))
+        assert pre_rate > 0.9, f"leader itself is prefix-cold: {pre_rate}"
+
+        # -- anti-entropy: follower must reach the post-traffic epoch ---
+        target_epoch = a.manager.publisher.refresh()
+        assert _wait(
+            lambda: (b.manager.follower.installed_epoch >= target_epoch),
+            timeout_s=8.0,
+        ), (
+            f"follower never synced epoch {target_epoch} "
+            f"(at {b.manager.follower.installed_epoch})")
+        assert b.manager.healthy(), "synced follower should report healthy"
+
+        # -- kill the leader mid-traffic --------------------------------
+        a.scheduler.pick(_wave_reqs(list(range(8))), eps)  # in-flight wave
+        a.crash()
+        assert _wait(b.elector.is_leader, timeout_s=6.0), (
+            "no takeover after the leader crashed")
+        assert b.manager.promoted_with_epoch is not None
+        assert b.manager.promoted_with_epoch >= target_epoch
+
+        # -- first waves on the promoted follower -----------------------
+        # Sessions 40..79: warmed on A, replicated to B, never re-touched
+        # during measurement windows — the takeover must serve them from
+        # the transplanted index.
+        warm_rate = _hit_rate(b.scheduler, eps, _groups(40, SESSIONS))
+        assert warm_rate >= 0.8 * pre_rate, (
+            f"warm takeover lost the prefix table: warm {warm_rate:.3f} "
+            f"vs pre-failover {pre_rate:.3f}")
+
+        # -- cold-takeover control --------------------------------------
+        cold = Scheduler(ProfileConfig())
+        cold_rate = _hit_rate(cold, eps, _groups(40, SESSIONS))
+        assert cold_rate < warm_rate, (
+            f"cold takeover should underperform: cold {cold_rate:.3f} "
+            f"vs warm {warm_rate:.3f}")
+        assert cold_rate <= 0.5 * warm_rate, (
+            f"cold takeover barely underperforms: cold {cold_rate:.3f} "
+            f"vs warm {warm_rate:.3f}")
+        assert time.monotonic() - started < 30.0, "failover test overran"
+    finally:
+        b.stop()
+        api.close()

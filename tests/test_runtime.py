@@ -59,6 +59,7 @@ def test_logger_levels_and_structure():
 
 def test_self_signed_cert_valid():
     """reference tls.go:33-74."""
+    pytest.importorskip("cryptography")
     cert_pem, key_pem = create_self_signed_cert()
     from cryptography import x509
     from cryptography.hazmat.primitives.serialization import load_pem_private_key
@@ -73,6 +74,7 @@ def test_self_signed_cert_valid():
 
 def test_cert_reloader_hot_swap(tmp_path):
     """reference certs.go:35-103."""
+    pytest.importorskip("cryptography")
     c1, k1 = create_self_signed_cert("first")
     cert_f, key_f = tmp_path / "tls.crt", tmp_path / "tls.key"
     cert_f.write_bytes(c1)
