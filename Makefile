@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native generate test test-unit test-conformance bench bench-goodput cost release clean
+.PHONY: all native generate test test-unit test-conformance bench bench-goodput bench-scrape cost release clean
 
 all: native generate
 
@@ -37,6 +37,11 @@ cost:
 # Cluster-goodput benchmark vs the least-kv baseline.
 bench-goodput:
 	$(PY) bench_goodput.py
+
+# Scrape-path benchmark: multiplexed engine vs thread-per-endpoint
+# (docs/METRICSIO.md; sweep CPU + p99 row staleness at 16/64/256).
+bench-scrape:
+	$(PY) bench_scrape.py
 
 # Versioned release artifacts (CRDs, tuned profile, conformance report).
 release:

@@ -85,15 +85,23 @@ def _wait_for_backend(
     total_s: float = 570.0,
     probe_timeout_s: float = 75.0,
     sleep_s: float = 20.0,
-) -> None:
+) -> str:
     """Survive a transient relay outage (VERDICT r3 #1: rounds 1 and 3
     both lost their capture to a down tunnel and a fixed 180 s bail).
 
     jax backend init holds a process-wide lock while it hangs, so retrying
     in-process is impossible — each probe is a SUBPROCESS that attempts
     `jax.devices()`; the parent only initializes jax after a probe
-    succeeds. Probes retry with pauses for up to ~9.5 minutes before
-    giving up with exit 3.
+    succeeds. Probes retry with pauses for up to ~9.5 minutes.
+
+    Returns the platform tag for the JSON record. When every probe fails
+    (the BENCH_r01-r05 rc=3 "axon relay unreachable" aborts), the bench no
+    longer exits nonzero with an empty capture: it falls back to the CPU
+    backend ("cpu-fallback"), with reduced repetition counts so the run
+    stays bounded. A CPU number is NOT comparable to the TPU target — the
+    tag exists so the perf trajectory records the relay outage instead of
+    a hole — but the methodology (scan + slope + calibration guard) is
+    exercised end to end.
     """
     deadline = time.monotonic() + total_s
     attempt = 0
@@ -113,20 +121,40 @@ def _wait_for_backend(
         dt = time.monotonic() - t0
         if ok:
             _log(f"backend probe {attempt}: up after {dt:.1f}s ({detail})")
-            return
+            return detail or "tpu"
         remaining = deadline - time.monotonic()
         _log(
             f"backend probe {attempt}: DOWN after {dt:.1f}s ({detail}); "
             f"{remaining:.0f}s of retry budget left"
         )
         if remaining <= sleep_s:
-            _log(
-                "FATAL: JAX backend failed to initialize within "
-                f"{total_s:.0f}s across {attempt} probes (axon relay "
-                "unreachable?) — aborting instead of hanging"
-            )
-            sys.exit(3)
+            break
         time.sleep(sleep_s)
+    _log(
+        f"backend did not initialize within {total_s:.0f}s across "
+        f"{attempt} probes (axon relay unreachable?) — falling back to "
+        "JAX_PLATFORMS=cpu so the capture records a tagged number "
+        "instead of aborting empty"
+    )
+    os.environ["GIE_BENCH_PLATFORM"] = "cpu"
+    # One confirming probe on the CPU backend; if even that fails, the
+    # environment is broken beyond any fallback.
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True, text=True, timeout=probe_timeout_s,
+        )
+        ok = proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        _log("FATAL: CPU fallback backend failed to initialize too")
+        sys.exit(3)
+    # Bound the fallback's wall time: CPU cycles are ~100-1000x the TPU's,
+    # and the capture is a tagged trajectory marker, not a target check.
+    global PIPELINE, REPS
+    PIPELINE, REPS = 2, 5
+    return "cpu-fallback"
 
 
 def _in_process_watchdog(timeout_s: float = 180.0):
@@ -264,7 +292,7 @@ def _calibrate(jax, jnp):
 
 
 def main() -> None:
-    _wait_for_backend()
+    backend = _wait_for_backend()
     _in_process_watchdog()
     _preflight()
 
@@ -426,6 +454,10 @@ def main() -> None:
                 "value": round(p50, 1),
                 "unit": "us",
                 "vs_baseline": round(vs, 1),
+                # "cpu-fallback" = the TPU relay never came up and this
+                # number ran on the host backend: a trajectory marker,
+                # not comparable against the 50 us target.
+                "backend": backend,
             }
         ),
         flush=True,

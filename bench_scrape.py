@@ -1,0 +1,172 @@
+"""Scrape-path benchmark: multiplexed engine vs thread-per-endpoint.
+
+Measures the metrics-ingestion path (ISSUE 4, docs/METRICSIO.md) at
+16/64/256 endpoints on a 50 ms fast-poll cadence, for BOTH
+implementations:
+
+  engine   gie_tpu.metricsio.engine.ScrapeEngine — fixed worker-shard
+           pool, deadline min-heap, batched MetricsStore writes.
+  threads  gie_tpu.metricsio.scrape.ThreadPerEndpointScraper — the seed's
+           one-thread-one-connection-per-endpoint loop.
+
+Per (impl, n) configuration, one JSON line on stdout:
+
+  sweep_cpu_ms   CPU seconds consumed per INTERVAL of polling the whole
+                 pool (process CPU time x interval / wall) — the
+                 "scrape-path wall-time per sweep". This charges
+                 over-polling correctly: the legacy loop under GIL
+                 contention spins some pollers faster than the interval
+                 while starving others, burning MORE cpu for WORSE
+                 freshness.
+  staleness_p50_ms / staleness_p99_ms
+                 distribution of per-endpoint row refresh gaps — the
+                 quantity every picker decision actually depends on.
+  threads        threading.active_count() during the run (the engine
+                 stays at workers + constant regardless of pool size).
+  sweeps_per_s   median per-endpoint refresh rate (target = 1/interval).
+
+The fetcher is an in-process stub returning a fixed vLLM exposition
+(incl. a LoRA-info line), so the comparison isolates scheduling, GIL,
+parse, and store-write costs; network effects (keep-alive reuse vs
+per-scrape TCP) additionally favor the engine in production and are
+covered by the soak test's real-HTTP path.
+
+Run: `make bench-scrape` (or python bench_scrape.py [--duration S]).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from collections import defaultdict
+
+import numpy as np
+
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.metricsio.engine import ScrapeEngine
+from gie_tpu.metricsio.mappings import VLLM
+from gie_tpu.metricsio.scrape import ThreadPerEndpointScraper
+
+INTERVAL_S = 0.05
+SIZES = (16, 64, 256)
+
+STUB_TEXT = b"""# TYPE vllm:num_requests_waiting gauge
+vllm:num_requests_waiting 7
+# TYPE vllm:num_requests_running gauge
+vllm:num_requests_running 3
+# TYPE vllm:kv_cache_usage_perc gauge
+vllm:kv_cache_usage_perc 0.42
+# TYPE vllm:cache_config_info gauge
+vllm:cache_config_info{block_size="16",num_gpu_blocks="2048"} 1
+# TYPE vllm:lora_requests_info gauge
+vllm:lora_requests_info{max_lora="4",running_lora_adapters="a1, a2",waiting_lora_adapters="a3"} 100.0
+"""
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+class _RecordingStore(MetricsStore):
+    """MetricsStore that timestamps every row write (both the legacy
+    per-row path and the engine's batched path) for staleness stats."""
+
+    def __init__(self):
+        super().__init__()
+        self.times: dict[int, list] = defaultdict(list)
+        self._tlock = threading.Lock()
+
+    def update(self, slot, metrics, lora_active=(), lora_waiting=(),
+               now=None):
+        super().update(slot, metrics, lora_active, lora_waiting, now)
+        with self._tlock:
+            self.times[slot].append(time.monotonic())
+
+    def update_rows(self, rows, now=None):
+        super().update_rows(rows, now)
+        t = time.monotonic()
+        with self._tlock:
+            for row in rows:
+                self.times[row[0]].append(t)
+
+
+def _stub_fetcher(url: str) -> bytes:
+    return STUB_TEXT
+
+
+def run_one(impl: str, n: int, duration_s: float) -> dict:
+    store = _RecordingStore()
+    if impl == "engine":
+        scraper = ScrapeEngine(
+            store, interval_s=INTERVAL_S, fetcher=_stub_fetcher)
+    else:
+        scraper = ThreadPerEndpointScraper(
+            store, interval_s=INTERVAL_S, fetcher=_stub_fetcher)
+    for slot in range(n):
+        scraper.attach(
+            slot, f"http://10.0.{slot // 250}.{slot % 250}:8000/metrics",
+            VLLM)
+    time.sleep(min(0.5, duration_s / 4))  # settle past attach staggering
+    with store._tlock:
+        store.times.clear()
+    threads = threading.active_count()
+    cpu0, wall0 = time.process_time(), time.monotonic()
+    time.sleep(duration_s)
+    cpu = time.process_time() - cpu0
+    wall = time.monotonic() - wall0
+    scraper.close()
+
+    per_ep = [len(v) for v in store.times.values()] or [0]
+    gaps = [np.diff(v) for v in store.times.values() if len(v) > 2]
+    gaps = np.concatenate(gaps) if gaps else np.asarray([float("inf")])
+    sweeps = float(np.median(per_ep)) / wall
+    return {
+        "impl": impl,
+        "endpoints": n,
+        "interval_ms": INTERVAL_S * 1e3,
+        "sweep_cpu_ms": round(cpu / (wall / INTERVAL_S) * 1e3, 2),
+        "staleness_p50_ms": round(float(np.percentile(gaps, 50)) * 1e3, 1),
+        "staleness_p99_ms": round(float(np.percentile(gaps, 99)) * 1e3, 1),
+        "sweeps_per_s": round(sweeps, 1),
+        "threads": threads,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="seconds per (impl, size) measurement window")
+    args = ap.parse_args()
+
+    results = {}
+    for n in SIZES:
+        for impl in ("engine", "threads"):
+            r = run_one(impl, n, args.duration)
+            results[(impl, n)] = r
+            print(json.dumps(r), flush=True)
+
+    n = SIZES[-1]
+    eng, thr = results[("engine", n)], results[("threads", n)]
+    speedup = (thr["sweep_cpu_ms"] / eng["sweep_cpu_ms"]
+               if eng["sweep_cpu_ms"] > 0 else float("inf"))
+    _log(
+        f"summary @ {n} endpoints: engine {eng['sweep_cpu_ms']} ms/sweep "
+        f"p99={eng['staleness_p99_ms']} ms threads={eng['threads']} | "
+        f"legacy {thr['sweep_cpu_ms']} ms/sweep "
+        f"p99={thr['staleness_p99_ms']} ms threads={thr['threads']} | "
+        f"scrape-path speedup {speedup:.1f}x"
+    )
+    print(json.dumps({
+        "metric": f"scrape_sweep_cpu_speedup_{n}ep",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "engine_p99_staleness_ms": eng["staleness_p99_ms"],
+        "engine_threads": eng["threads"],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

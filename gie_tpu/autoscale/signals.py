@@ -108,6 +108,7 @@ class SignalCollector:
         kv_limit: float = 0.95,
         staleness_s: float = 2.0,
         registry=None,
+        scrape_engine=None,
     ):
         if registry is None:
             from gie_tpu.runtime.metrics import REGISTRY
@@ -119,6 +120,13 @@ class SignalCollector:
         self.kv_limit = kv_limit
         self.staleness_s = staleness_s
         self.registry = registry
+        # Optional metricsio ScrapeEngine: its staleness_seconds() (time
+        # since each endpoint's last SUCCESSFUL scrape, from the engine's
+        # own monotonic clocks) is a second input to the stale-hold. It
+        # covers ingestion outages the store's row ages miss — e.g. a
+        # slot whose age was reset by a detach/attach cycle while the
+        # pool is actually unreachable and backing off.
+        self.scrape_engine = scrape_engine
         self._prev: Optional[dict] = None
         self._prev_at = 0.0
 
@@ -153,6 +161,9 @@ class SignalCollector:
             slots, queue_limit=self.queue_limit, kv_limit=self.kv_limit,
             now=now)
         age_max = agg["metrics_age_max_s"]
+        if self.scrape_engine is not None and n > 0:
+            age_max = max(
+                age_max, float(self.scrape_engine.staleness_seconds()))
 
         band_prev = _band_sums(prev, _QUEUE_SHED)
         shed_by_band = {

@@ -37,8 +37,9 @@ class PoolNotSyncedError(RuntimeError):
 # Called with the freed slot whenever an endpoint is removed, so the
 # scheduler can invalidate per-slot device state (prefix presence, assumed
 # load) before the slot is reused. Invoked AFTER the datastore lock is
-# released: the callback may block (scraper thread joins, device dispatch)
-# and must not stall concurrent data-plane readers.
+# released: the callback may block (device dispatch; scrape-engine detach
+# itself is O(1) and non-blocking) and must not stall concurrent
+# data-plane readers.
 SlotReclaimedFn = Callable[[int], None]
 
 
@@ -262,8 +263,8 @@ class Datastore:
     def _drain_reclaims(self) -> None:
         """Deliver queued slot-reclaim callbacks, then return the slots to
         the free heap. Must be called WITHOUT the lock held: the runner's
-        callback joins scraper threads and dispatches to the device, either
-        of which would otherwise block every concurrent endpoints()/
+        callback dispatches to the device (and historically joined scraper
+        threads), which would otherwise block every concurrent endpoints()/
         endpoint_by_hostport() reader for seconds during churn."""
         with self._lock:
             pending, self._pending_reclaims = self._pending_reclaims, []

@@ -8,4 +8,14 @@ the TPU twist that the sink is a dense tensor view, not per-endpoint structs.
 
 from gie_tpu.metricsio.store import MetricsStore
 
-__all__ = ["MetricsStore"]
+__all__ = ["MetricsStore", "ScrapeEngine"]
+
+
+def __getattr__(name):
+    # Lazy: engine pulls in runtime.metrics (prometheus) — keep the bare
+    # store import light for the simulator/test paths that only need it.
+    if name == "ScrapeEngine":
+        from gie_tpu.metricsio.engine import ScrapeEngine
+
+        return ScrapeEngine
+    raise AttributeError(name)

@@ -17,6 +17,7 @@ from __future__ import annotations
 import ctypes
 import functools
 import os
+import threading
 from typing import Optional, Union
 
 import numpy as np
@@ -38,12 +39,12 @@ def _load_native():
     fn.argtypes = [
         ctypes.c_char_p, ctypes.c_long,           # text, n
         ctypes.c_char_p,                          # query spec
-        np.ctypeslib.ndpointer(np.float64),       # out values
-        np.ctypeslib.ndpointer(np.uint8),         # out found flags
+        ctypes.c_void_p,                          # out values (f64*)
+        ctypes.c_void_p,                          # out found flags (u8*)
         ctypes.c_long,                            # n queries
         ctypes.c_char_p,                          # extra families (or None)
-        np.ctypeslib.ndpointer(np.int64),         # out line offsets
-        np.ctypeslib.ndpointer(np.int64),         # out line lengths
+        ctypes.c_void_p,                          # out line offsets (i64*)
+        ctypes.c_void_p,                          # out line lengths (i64*)
         ctypes.c_long,                            # cap
     ]
     fn.restype = ctypes.c_long
@@ -81,6 +82,31 @@ def available() -> bool:
     return _NATIVE is not None
 
 
+# Per-thread reusable output buffers: the scrape engine calls extract()
+# thousands of times per second across its shards, and fresh np arrays
+# plus per-call ndpointer argtype validation cost tens of microseconds —
+# a measurable slice of the ~100 us scrape budget. The C side writes
+# values[i]/found[i] for every query on every call (promparse.cc:156-157
+# initializes them first), so reuse is safe; thread-local because shards
+# parse concurrently. The raw data pointers are cached WITH the arrays
+# (stable for a numpy array's lifetime) so a call passes plain ints.
+_BUFFERS = threading.local()
+
+
+def _thread_buffers(n_columns: int):
+    buf = getattr(_BUFFERS, "buf", None)
+    if buf is None or buf[0][0].shape[0] < n_columns:
+        arrays = (
+            np.full((max(n_columns, 8),), np.nan, np.float64),
+            np.zeros((max(n_columns, 8),), np.uint8),
+            np.zeros((_LORA_LINES_CAP,), np.int64),
+            np.zeros((_LORA_LINES_CAP,), np.int64),
+        )
+        buf = (arrays, tuple(a.ctypes.data for a in arrays))
+        _BUFFERS.buf = buf
+    return buf
+
+
 def extract(
     text: Union[str, bytes], mapping: ServerMapping
 ) -> Optional[tuple[dict[int, float], list[str]]]:
@@ -92,12 +118,9 @@ def extract(
         return None
     spec, columns, extras = _compiled_spec(mapping)
     raw = text if isinstance(text, bytes) else text.encode("utf-8", "replace")
-    values = np.full((len(columns),), np.nan, np.float64)
-    found = np.zeros((len(columns),), np.uint8)
-    offs = np.zeros((_LORA_LINES_CAP,), np.int64)
-    lens = np.zeros((_LORA_LINES_CAP,), np.int64)
-    n_lines = _NATIVE(raw, len(raw), spec, values, found, len(columns),
-                      extras, offs, lens, _LORA_LINES_CAP)
+    (values, found, offs, lens), ptrs = _thread_buffers(len(columns))
+    n_lines = _NATIVE(raw, len(raw), spec, ptrs[0], ptrs[1], len(columns),
+                      extras, ptrs[2], ptrs[3], _LORA_LINES_CAP)
     if n_lines < 0:
         return None  # malformed query spec — should be impossible
     out: dict[int, float] = {

@@ -1,13 +1,18 @@
-"""Prometheus scrape parsing + the fast-poll scraper.
+"""Prometheus scrape parsing + the fast-poll scraper front ends.
 
 Data-layer ingestion per reference docs/proposals/1023-data-layer-
 architecture/README.md:59-60 (goroutine-per-endpoint fast poll) and the
-metric semantics of proposal 003. Here: one poller thread per endpoint slot,
-writing rows straight into the dense MetricsStore tensor.
+metric semantics of proposal 003. The production path is the multiplexed
+keep-alive ``ScrapeEngine`` (metricsio/engine.py, docs/METRICSIO.md);
+``Scraper`` here is a thin adapter over it preserving the legacy
+attach/detach/close surface, and ``ThreadPerEndpointScraper`` keeps the
+original one-thread-one-connection implementation alive as the parity
+and benchmark baseline (bench_scrape.py, tests/test_scrape_engine.py).
 """
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 import urllib.request
@@ -132,6 +137,64 @@ def _apply_lora_samples(
     return lora_active, lora_waiting
 
 
+class _Sample:
+    """Duck-typed stand-in for prometheus_client's Sample (the shared
+    freshest-series rule only reads .value and .labels)."""
+
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: dict, value: float):
+        self.name = name
+        self.labels = labels
+        self.value = value
+
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"')
+_UNESCAPE_RE = re.compile(r"\\(.)")
+
+
+def _unescape_label(raw: str) -> str:
+    # Prometheus exposition label-value escapes: \\ -> \, \" -> ", \n.
+    return _UNESCAPE_RE.sub(
+        lambda m: "\n" if m.group(1) == "n" else m.group(1), raw)
+
+
+def _fast_parse_sample_lines(text: str) -> list[_Sample]:
+    """Minimal exposition-line parser for the handful of sample lines the
+    native scanner hands back (`name{labels} value [ts]`). The general
+    prometheus_client parser costs ~170 us per call — at 256 endpoints on
+    a 50 ms cadence that alone is most of a core — while these lines need
+    only label extraction and a float. Semantics parity with the full
+    parser is pinned in tests/test_promparse_native.py."""
+    samples: list[_Sample] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        brace = line.find("{")
+        if brace >= 0:
+            end = line.rfind("}")
+            if end < brace:
+                continue
+            name = line[:brace].strip()
+            labels = {
+                m.group(1): _unescape_label(m.group(2))
+                for m in _LABEL_RE.finditer(line[brace + 1:end])
+            }
+            rest = line[end + 1:].split()
+        else:
+            parts = line.split()
+            name, labels, rest = parts[0], {}, parts[1:]
+        if not rest:
+            continue
+        try:
+            value = float(rest[0])
+        except ValueError:
+            continue
+        samples.append(_Sample(name, labels, value))
+    return samples
+
+
 def _apply_lora_lines(
     lora_text: str,
     lora: Optional[LoraRegistry],
@@ -141,12 +204,8 @@ def _apply_lora_lines(
     the same shared rule."""
     if not lora_text.strip():
         return [], []
-    samples = [
-        s
-        for family in text_string_to_metric_families(lora_text)
-        for s in family.samples
-    ]
-    return _apply_lora_samples(samples, lora, out)
+    return _apply_lora_samples(
+        _fast_parse_sample_lines(lora_text), lora, out)
 
 
 # Fetchers may return bytes (preferred: the native scanner consumes the
@@ -160,12 +219,51 @@ def _http_fetch(url: str) -> bytes:
 
 
 class Scraper:
-    """Per-endpoint fast-poll loop.
+    """Legacy-API adapter over the multiplexed ScrapeEngine.
 
-    `attach(slot, url, mapping)` starts a poller thread for an endpoint;
-    `detach(slot)` stops it (wired to datastore slot reclaim). The reference
-    runs one goroutine per endpoint with a configurable interval
-    (1023 README:59-60); 50 ms default matches its fast-poll guidance.
+    ``attach(slot, url, mapping)`` / ``detach(slot)`` / ``close()`` keep
+    their historical meaning (detach clears the slot's row), but no call
+    ever spawns a per-endpoint thread or joins one: the engine's fixed
+    worker-shard pool does all polling. Call sites that want the engine's
+    knobs (worker count, backoff ceiling) should construct ScrapeEngine
+    directly, as the runner does."""
+
+    def __init__(
+        self,
+        store: MetricsStore,
+        lora: Optional[LoraRegistry] = None,
+        interval_s: float = 0.05,
+        fetcher: Optional[Fetcher] = None,
+        workers: Optional[int] = None,
+    ):
+        from gie_tpu.metricsio.engine import ScrapeEngine
+
+        self.store = store
+        self.interval_s = interval_s
+        self._engine = ScrapeEngine(
+            store, lora=lora, interval_s=interval_s, fetcher=fetcher,
+            workers=workers)
+        self.lora = self._engine.lora
+
+    def attach(self, slot: int, url: str, mapping: ServerMapping) -> None:
+        self._engine.attach(slot, url, mapping)
+
+    def detach(self, slot: int) -> None:
+        self._engine.detach(slot)
+
+    def close(self) -> None:
+        self._engine.close()
+
+
+class ThreadPerEndpointScraper:
+    """The seed's per-endpoint fast-poll loop: one poller thread and one
+    fresh ``urllib`` connection per endpoint per tick.
+
+    Kept (unchanged in behavior) as the comparison baseline for
+    bench_scrape.py and the engine parity tests; production call sites
+    use the ScrapeEngine. The reference runs one goroutine per endpoint
+    with a configurable interval (1023 README:59-60); 50 ms default
+    matches its fast-poll guidance.
     """
 
     def __init__(

@@ -70,6 +70,43 @@ PIPELINE_WAVES = prom.Counter(
     "per-wave histograms above give both terms",
     registry=REGISTRY,
 )
+# Multiplexed scrape engine (gie_tpu/metricsio/engine.py,
+# docs/METRICSIO.md): metrics-ingestion health. Staleness is the achieved
+# per-row refresh interval — the quantity every picker decision and the
+# autoscale stale-hold actually depend on; at a 50 ms target, p99 beyond
+# ~3x the interval means the shard budget (or the pool's reachability) is
+# the bottleneck, not the schedule.
+SCRAPE_STALENESS = prom.Histogram(
+    "gie_scrape_staleness_seconds",
+    "Time between consecutive successful scrapes of the same endpoint "
+    "(attach-to-first-scrape for new endpoints)",
+    buckets=(0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.25, 0.5, 1.0, 2.5),
+    registry=REGISTRY,
+)
+SCRAPE_FETCH = prom.Histogram(
+    "gie_scrape_fetch_seconds",
+    "Per-endpoint fetch + parse latency on the scrape-engine shards",
+    buckets=(1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0),
+    registry=REGISTRY,
+)
+SCRAPE_REUSE = prom.Gauge(
+    "gie_scrape_connection_reuse_ratio",
+    "Fraction of keep-alive HTTP fetches that reused a live connection "
+    "(low values mean model servers are closing idle keep-alives faster "
+    "than the scrape interval)",
+    registry=REGISTRY,
+)
+SCRAPE_FAILS_MAX = prom.Gauge(
+    "gie_scrape_consecutive_failures_max",
+    "Largest consecutive-failure streak among attached endpoints (the "
+    "worst endpoint's adaptive-backoff driver)",
+    registry=REGISTRY,
+)
+SCRAPE_ENDPOINTS = prom.Gauge(
+    "gie_scrape_endpoints",
+    "Endpoints currently attached to the scrape engine",
+    registry=REGISTRY,
+)
 SLOT_OVERFLOW = prom.Gauge(
     "gie_endpoint_slot_overflow_total",
     "Endpoint admissions refused because every scheduler slot (M_MAX) was "

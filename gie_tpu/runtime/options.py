@@ -28,6 +28,12 @@ class Options:
     # TPU scheduler knobs
     batch_window_ms: float = 2.0
     scrape_interval_ms: float = 50.0
+    # Scrape-engine worker shards (metricsio/engine.py): a FIXED pool of
+    # threads multiplexing every endpoint over keep-alive connections.
+    # 0 = auto (min(8, cpu)). This replaces the seed's thread-per-endpoint
+    # polling; the shard count bounds scrape-path threads regardless of
+    # pool size.
+    scrape_workers: int = 0
     model_server_type: str = "vllm"
     # Learned latency predictor (BASELINE configs[3])
     enable_predictor: bool = False
@@ -125,6 +131,10 @@ class Options:
                             help="micro-batch collection window")
         parser.add_argument("--scrape-interval-ms", type=float,
                             default=d.scrape_interval_ms)
+        parser.add_argument("--scrape-workers", type=int,
+                            default=d.scrape_workers,
+                            help="scrape-engine worker shards multiplexing "
+                                 "all endpoint polls (0 = min(8, cpu))")
         parser.add_argument("--model-server-type", default=d.model_server_type,
                             choices=["vllm", "triton-tensorrt-llm",
                                      "trtllm-serve", "sglang"])
@@ -239,6 +249,7 @@ class Options:
             verbosity=args.verbosity,
             batch_window_ms=args.batch_window_ms,
             scrape_interval_ms=args.scrape_interval_ms,
+            scrape_workers=args.scrape_workers,
             model_server_type=args.model_server_type,
             enable_predictor=args.enable_predictor,
             predictor_checkpoint_dir=args.predictor_checkpoint_dir,
@@ -284,6 +295,10 @@ class Options:
             raise ValueError("-v must be 0..5")
         if self.mesh_devices < 0:
             raise ValueError("--mesh-devices must be >= 0")
+        if self.scrape_workers < 0:
+            raise ValueError("--scrape-workers must be >= 0 (0 = auto)")
+        if self.scrape_interval_ms <= 0:
+            raise ValueError("--scrape-interval-ms must be > 0")
         # With tp=1 the dp axis equals the device count, and dp must be a
         # power of two to divide the request buckets (sched/profile.py).
         if self.mesh_devices > 1 and self.mesh_devices & (self.mesh_devices - 1):

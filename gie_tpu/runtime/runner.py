@@ -27,8 +27,8 @@ from gie_tpu.sched import constants as C
 from gie_tpu.extproc.server import StreamingServer
 from gie_tpu.extproc.service import add_extproc_service
 from gie_tpu.metricsio import MetricsStore
+from gie_tpu.metricsio.engine import ScrapeEngine
 from gie_tpu.metricsio.mappings import BY_NAME
-from gie_tpu.metricsio.scrape import Scraper
 from gie_tpu.runtime import metrics as own_metrics
 from gie_tpu.runtime.health import HealthService, start_dedicated_health_server
 from gie_tpu.runtime.logging import get_logger
@@ -123,10 +123,17 @@ class ExtProcServerRunner:
                 self.scheduler.gate_latency_column(self.trainer.confidence())
         self.metrics_store = MetricsStore()
         self.mapping = BY_NAME[opts.model_server_type]
-        self.scraper = Scraper(
+        # Multiplexed keep-alive scrape engine (metricsio/engine.py,
+        # docs/METRICSIO.md): a fixed shard pool polls every endpoint at
+        # the fast-poll cadence; attach/detach below are O(1) so endpoint
+        # churn never blocks a reconcile on a hung fetch. The attribute
+        # keeps the historical `scraper` name — the lifecycle surface
+        # (attach/detach/close) is API-identical.
+        self.scraper = ScrapeEngine(
             self.metrics_store,
             lora=self.lora_registry,
             interval_s=opts.scrape_interval_ms / 1000.0,
+            workers=opts.scrape_workers or None,
         )
         self.datastore = Datastore(on_slot_reclaimed=self._slot_reclaimed)
         self._attach_lock = threading.Lock()
@@ -233,6 +240,11 @@ class ExtProcServerRunner:
                 # Stale = several scrape periods missed, floored well above
                 # jitter so a slow scrape tick never freezes the loop.
                 staleness_s=max(10 * opts.scrape_interval_ms / 1000.0, 1.0),
+                # Second staleness source: the engine's own last-success
+                # clocks cover ingestion-side outages (all endpoints
+                # backing off, wedged shard) that row ages alone miss
+                # when a row was re-attached and its age reset.
+                scrape_engine=self.scraper,
             )
             recommender = AutoscaleRecommender(RecommenderConfig(
                 min_replicas=opts.autoscale_min,

@@ -230,7 +230,7 @@ class BatchingTPUPicker:
         pick_timeout_s: float = 60.0,
         queue_bound: int = 0,
         queue_max_age_s: float = 0.0,
-        pipeline_depth: int = 2,
+        pipeline_depth=2,
         background_warm: bool = False,
     ):
         self.scheduler = scheduler
@@ -287,13 +287,43 @@ class BatchingTPUPicker:
         self._closed = False
         # Two-stage pipeline (docs/PIPELINE.md): the dispatcher assembles
         # and async-dispatches waves; the completer materializes and fans
-        # out. The bounded queue is the backpressure seam — depth ~2 keeps
-        # the device fed (one wave running, one queued behind it) without
-        # letting a slow consumer stack unbounded tail latency onto every
-        # wave dispatched behind it.
-        if pipeline_depth < 1:
-            raise ValueError("pipeline_depth must be >= 1")
-        self._waves: queue.Queue = queue.Queue(maxsize=pipeline_depth)
+        # out. The in-flight bound is the backpressure seam — depth ~2
+        # keeps the device fed (one wave running, one queued behind it)
+        # without letting a slow consumer stack unbounded tail latency
+        # onto every wave dispatched behind it.
+        #
+        # pipeline_depth="auto" (ROADMAP PR 1 follow-up) derives the
+        # bound 1-3 from the measured host-assembly / device-cycle ratio
+        # the pipeline histograms already capture, retuned every
+        # _DEPTH_RETUNE_WAVES waves:
+        #   host-bound (assembly >= 2x the device wait): the bound never
+        #     binds in steady state — depth 1, the shallowest bound,
+        #     merely caps the tail a transient burst can queue.
+        #   balanced (0.5x..2x): depth 3 — one slow assembly (GC pause,
+        #     queue-drain spike) must not starve the device, so one
+        #     extra slot absorbs the jitter.
+        #   device-bound (assembly < 0.5x): depth 2 — the classic double
+        #     buffer; any deeper slot adds a full device cycle of queue
+        #     latency to every wave while the device is already 100%
+        #     busy.
+        # The fixed default (2) is preserved: pass an int to pin it.
+        self._depth_auto = pipeline_depth == "auto"
+        if self._depth_auto:
+            pipeline_depth = 2
+        if not isinstance(pipeline_depth, int) or pipeline_depth < 1:
+            raise ValueError('pipeline_depth must be >= 1 or "auto"')
+        self._depth_limit = pipeline_depth
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        # Stage-time EWMAs feeding the auto policy. Written GIL-atomically
+        # from their own stage's thread (assembly: dispatcher; device
+        # wait: completer); read racily by the retune — an off-by-one-
+        # sample read only shifts a threshold crossing by one window.
+        self._asm_ewma = 0.0
+        self._cycle_ewma = 0.0
+        self._depth_waves = 0
+        self._depth_want_prev = pipeline_depth
+        self._waves: queue.Queue = queue.Queue()
         # Background N-bucket lattice warming (ROADMAP follow-up): with
         # background_warm=True the dispatcher's first contact with a new
         # (m, chunk_lanes) lattice kicks Scheduler.warm_lattice_async for
@@ -518,6 +548,9 @@ class BatchingTPUPicker:
                         item.error = ExtProcError(
                             grpc.StatusCode.UNAVAILABLE, "picker shut down")
                     item.event.set()
+                with self._inflight_cv:
+                    self._inflight -= 1
+                    self._inflight_cv.notify_all()
 
     # -- collector ---------------------------------------------------------
 
@@ -578,6 +611,33 @@ class BatchingTPUPicker:
                         self._cond.wait(self.hold_retry_s)
 
     _M_SHRINK_PATIENCE = 64  # consecutive smaller-bucket waves before shrink
+    _DEPTH_RETUNE_WAVES = 32  # auto pipeline-depth retune cadence
+
+    def _retune_depth(self) -> None:
+        """pipeline_depth="auto": pick the in-flight bound 1-3 from the
+        measured stage-time ratio (rationale at the __init__ comment).
+        Hysteresis: a change applies only when two consecutive retunes
+        agree, so a ratio sitting on a threshold cannot flap the bound
+        every window. Dispatcher-thread only (apart from the racy-read
+        _cycle_ewma, which the completer owns)."""
+        cycle = self._cycle_ewma
+        if cycle <= 0.0 or self._asm_ewma <= 0.0:
+            return  # no completed wave measured yet
+        ratio = self._asm_ewma / cycle
+        if ratio >= 2.0:
+            want = 1
+        elif ratio >= 0.5:
+            want = 3
+        else:
+            want = 2
+        agreed, self._depth_want_prev = want == self._depth_want_prev, want
+        if not agreed or want == self._depth_limit:
+            return
+        with self._inflight_cv:
+            self._depth_limit = want
+            # Raising the limit may unblock a dispatcher waiting on the
+            # old one; lowering just lets in-flight waves drain past it.
+            self._inflight_cv.notify_all()
 
     def _pick_m_bucket(self, endpoints) -> int:
         """Endpoint-axis bucket for this wave: smallest M bucket covering
@@ -676,7 +736,22 @@ class BatchingTPUPicker:
             self._warmed_lattices.add(lattice)
             self._warm_threads.append(
                 self.scheduler.warm_lattice_async(*lattice))
-        own_metrics.HOST_ASSEMBLY.observe(time.perf_counter() - t0)
+        asm_s = time.perf_counter() - t0
+        own_metrics.HOST_ASSEMBLY.observe(asm_s)
+        if self._depth_auto:
+            self._asm_ewma = (asm_s if self._asm_ewma == 0.0
+                              else 0.9 * self._asm_ewma + 0.1 * asm_s)
+            self._depth_waves += 1
+            if self._depth_waves >= self._DEPTH_RETUNE_WAVES:
+                self._depth_waves = 0
+                self._retune_depth()
+        # Backpressure: block while `_depth_limit` waves are in flight —
+        # the same semantics the bounded queue.put had, but against a
+        # limit the auto policy may move at runtime.
+        with self._inflight_cv:
+            while self._inflight >= self._depth_limit:
+                self._inflight_cv.wait()
+            self._inflight += 1
         own_metrics.PIPELINE_DEPTH.inc()
         own_metrics.PIPELINE_WAVES.inc()
         self._waves.put(
@@ -694,6 +769,14 @@ class BatchingTPUPicker:
             wave = self._waves.get()
             if wave is _CLOSE:
                 return
+            # Release the in-flight slot at PICKUP, not completion: the
+            # bounded queue this replaced held `depth` waves while the
+            # completer materialized one more, and that +1 of overlap
+            # (next wave's assembly running during a slow fan-out) is
+            # part of the pipeline's throughput.
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
             try:
                 self._complete_wave(wave)
             except Exception as e:
@@ -714,7 +797,11 @@ class BatchingTPUPicker:
         batch, plen, dlen, lora = wave.batch, wave.plen, wave.dlen, wave.lora
         t0 = time.perf_counter()
         result = wave.pending.materialize()
-        own_metrics.DEVICE_WAIT.observe(time.perf_counter() - t0)
+        wait_s = time.perf_counter() - t0
+        own_metrics.DEVICE_WAIT.observe(wait_s)
+        if self._depth_auto:
+            self._cycle_ewma = (wait_s if self._cycle_ewma == 0.0
+                                else 0.9 * self._cycle_ewma + 0.1 * wait_s)
         # One bulk device->host transfer per wave, not one per request.
         # The load snapshot was captured on device right AFTER this wave's
         # cycle: the state had been migrated to the wave's M bucket, so

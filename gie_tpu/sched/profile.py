@@ -16,9 +16,11 @@ docs/proposals/006-scheduler/README.md:156).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import threading
+import time
 from typing import Callable, Optional
 
 import jax
@@ -554,6 +556,10 @@ class Scheduler:
         self.state = SchedState.init(m=C.M_BUCKETS[0])
         self._key = jax.random.PRNGKey(seed)
         self._lock = threading.Lock()
+        # (monotonic ts, slot, stored, removed) of recent KV events —
+        # replayed over digest installs (see _KV_JOURNAL_MAX below).
+        self._kv_journal: collections.deque = collections.deque(
+            maxlen=self._KV_JOURNAL_MAX)
         self._complete = jax.jit(_complete_update, donate_argnums=0)
         # No donation: resized buffers change size, so none can alias.
         self._resize = jax.jit(resize_state, static_argnames=("m",))
@@ -796,39 +802,70 @@ class Scheduler:
     # Event batches pad to these sizes so the jitted ingest compiles for a
     # handful of shapes, not one per batch.
     _EVENT_BUCKETS = (64, 512, 4096)
+    # Locally observed KV events are journaled and REPLAYED over a
+    # replication-digest install (commit_install): on a follower, an event
+    # that arrived after the leader exported the digest would otherwise be
+    # overwritten by it — ground truth lost to a stale snapshot until the
+    # endpoint happens to re-report (ROADMAP PR 3 follow-up). Entries age
+    # out: anything older than the TTL is presumed reflected in (or
+    # superseded by) the digest stream. Replay is idempotent (the same
+    # evict-then-OR fold), so replaying an event the digest already
+    # carries is harmless.
+    _KV_JOURNAL_MAX = 256
+    _KV_REPLAY_TTL_S = 10.0
+
+    def _fold_prefix_events_locked(
+        self, state, slot: int, stored: np.ndarray, removed: np.ndarray
+    ):
+        """Fold one endpoint's stored/removed chunk hashes into ``state``'s
+        prefix table (caller holds the lock). Oversized batches fold in
+        chunks of the largest bucket."""
+        if slot >= state.m:
+            # The reporting endpoint lives beyond the current bucket
+            # (events arrived before its first pick) — grow now so its
+            # presence bits have somewhere to land.
+            state = self._resize(state, m=m_bucket_for(slot + 1))
+        for hashes, remove in ((stored, False), (removed, True)):
+            hashes = np.asarray(hashes, np.uint32)
+            for start in range(0, len(hashes), self._EVENT_BUCKETS[-1]):
+                part = hashes[start:start + self._EVENT_BUCKETS[-1]]
+                bucket = next(
+                    b for b in self._EVENT_BUCKETS if len(part) <= b)
+                padded = np.zeros((bucket,), np.uint32)
+                padded[: len(part)] = part
+                state = state.replace(prefix=self._ingest(
+                    state.prefix, jnp.asarray(padded), jnp.int32(slot),
+                    state.tick, remove=remove))
+        return state
 
     def apply_prefix_events(
         self, slot: int, stored: np.ndarray, removed: np.ndarray
     ) -> None:
         """KV-cache event ingestion (reference roadmap item 1 'interfaces
         for remote caches'): fold a model server's reported stored/evicted
-        chunk-chain hashes into the device prefix index. Oversized batches
-        fold in chunks of the largest bucket."""
+        chunk-chain hashes into the device prefix index, and journal the
+        batch so a subsequent digest install replays it (see
+        _KV_JOURNAL_MAX)."""
+        stored = np.array(stored, np.uint32, copy=True)
+        removed = np.array(removed, np.uint32, copy=True)
         with self._lock:
-            state = self.state
-            if slot >= state.m:
-                # The reporting endpoint lives beyond the current bucket
-                # (events arrived before its first pick) — grow now so its
-                # presence bits have somewhere to land.
-                state = self._resize(state, m=m_bucket_for(slot + 1))
-            for hashes, remove in ((stored, False), (removed, True)):
-                hashes = np.asarray(hashes, np.uint32)
-                for start in range(0, len(hashes), self._EVENT_BUCKETS[-1]):
-                    part = hashes[start:start + self._EVENT_BUCKETS[-1]]
-                    bucket = next(
-                        b for b in self._EVENT_BUCKETS if len(part) <= b)
-                    padded = np.zeros((bucket,), np.uint32)
-                    padded[: len(part)] = part
-                    state = state.replace(prefix=self._ingest(
-                        state.prefix, jnp.asarray(padded), jnp.int32(slot),
-                        state.tick, remove=remove))
-            self.state = state
+            self.state = self._fold_prefix_events_locked(
+                self.state, slot, stored, removed)
+            self._kv_journal.append(
+                (time.monotonic(), slot, stored, removed))
 
     def evict_endpoint(self, slot: int) -> None:
         """Invalidate all prefix-cache knowledge of an endpoint slot (pod
         deleted or slot reassigned). Called by the datastore on PodDelete
         (reference pkg/lwepp/datastore/datastore.go:257-265)."""
         with self._lock:
+            # Journaled events for a dead slot must not be replayed over a
+            # later digest install — that would resurrect the dead pod's
+            # presence bits on whatever reuses the slot.
+            if any(e[1] == slot for e in self._kv_journal):
+                self._kv_journal = collections.deque(
+                    (e for e in self._kv_journal if e[1] != slot),
+                    maxlen=self._KV_JOURNAL_MAX)
             if slot >= self.state.m:
                 return  # beyond the live bucket: nothing was ever recorded
             self.state = self._evict(self.state, jnp.int32(slot))
@@ -988,8 +1025,23 @@ class Scheduler:
     def commit_install(self, state: SchedState) -> None:
         """Commit half: atomic swap under the lock — never inside the
         jitted cycle, and only ever with a prepare_install-validated
-        state."""
+        state.
+
+        Before the swap, locally journaled KV-cache events newer than the
+        replay TTL are folded INTO the incoming state (ROADMAP PR 3
+        follow-up): a follower's locally observed prefix ground truth —
+        reported by the model servers after the leader exported this
+        digest — survives the install instead of being overwritten until
+        the next event push happens to repeat it."""
         with self._lock:
+            now = time.monotonic()
+            fresh = [e for e in self._kv_journal
+                     if now - e[0] <= self._KV_REPLAY_TTL_S]
+            self._kv_journal = collections.deque(
+                fresh, maxlen=self._KV_JOURNAL_MAX)
+            for _ts, slot, stored, removed in fresh:
+                state = self._fold_prefix_events_locked(
+                    state, slot, stored, removed)
             self.state = state
 
     def install_state(self, arrays: dict) -> bool:

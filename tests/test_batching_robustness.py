@@ -354,3 +354,66 @@ def test_dispatcher_kicks_background_lattice_warm_once():
         assert picker2._warm_threads == []
     finally:
         picker2.close()
+
+
+# ---------------------------------------------------------------------------
+# adaptive pipeline depth (ROADMAP PR 1 follow-up)
+
+
+def test_pipeline_depth_auto_policy_and_hysteresis():
+    """pipeline_depth="auto" derives the in-flight bound 1-3 from the
+    measured host-assembly / device-cycle ratio, with two-agreeing-
+    retunes hysteresis so a ratio sitting on a threshold cannot flap the
+    bound every window."""
+    sched, ds, ms, picker = _stack(pipeline_depth="auto")
+    try:
+        assert picker._depth_auto and picker._depth_limit == 2
+
+        def retune(asm, cycle, times=2):
+            picker._asm_ewma, picker._cycle_ewma = asm, cycle
+            for _ in range(times):
+                picker._retune_depth()
+
+        retune(3.0e-3, 1.0e-3)           # host-bound: bound never binds
+        assert picker._depth_limit == 1
+        retune(1.0e-3, 1.0e-3)           # balanced: absorb assembly jitter
+        assert picker._depth_limit == 3
+        retune(0.1e-3, 1.0e-3)           # device-bound: double buffer
+        assert picker._depth_limit == 2
+        # Hysteresis: ONE deviating window must not move the bound.
+        retune(3.0e-3, 1.0e-3, times=1)
+        assert picker._depth_limit == 2
+        retune(3.0e-3, 1.0e-3, times=1)  # second agreement applies it
+        assert picker._depth_limit == 1
+        # No measurements yet -> no change (fresh picker guard).
+        picker._asm_ewma = picker._cycle_ewma = 0.0
+        picker._retune_depth()
+        assert picker._depth_limit == 1
+    finally:
+        picker.close()
+
+
+def test_pipeline_depth_auto_serves_picks():
+    """End to end: an auto-depth picker keeps the dispatcher/completer
+    pipeline correct (picks fan out, in-flight accounting drains to
+    zero on close)."""
+    sched, ds, ms, picker = _stack(pipeline_depth="auto")
+    try:
+        for i in range(6):
+            res = picker.pick(
+                PickRequest(headers={}, body=b"hello %d" % i),
+                ds.endpoints())
+            assert res.endpoint
+        # EWMAs captured real stage times for the auto policy.
+        assert picker._asm_ewma > 0.0 and picker._cycle_ewma > 0.0
+    finally:
+        picker.close()
+    assert picker._inflight == 0
+
+
+def test_pipeline_depth_validation():
+    import pytest as _pytest
+
+    for bad in (0, -1, "bogus", 1.5):
+        with _pytest.raises(ValueError):
+            _stack(pipeline_depth=bad)

@@ -573,3 +573,77 @@ def test_runner_wires_replication(tmp_path):
         runner.replication.stop()
         runner.picker.close()
         runner.scraper.close()
+
+
+# ---------------------------------------------------------------------------
+# follower-side KV-event merge over digest installs (ROADMAP PR 3 follow-up)
+
+
+def _has_presence_bit(sched: Scheduler, chunk_hash: int, ep_slot: int) -> bool:
+    keys = np.asarray(sched.state.prefix.keys)
+    present = np.asarray(sched.state.prefix.present)
+    row = int(chunk_hash) & (keys.shape[0] - 1)
+    if keys[row] != np.uint32(chunk_hash):
+        return False
+    return bool((present[row, ep_slot // 32] >> (ep_slot % 32)) & 1)
+
+
+def test_install_preserves_local_kv_events_newer_than_digest():
+    """A follower's locally observed KV-cache events (model servers push
+    ground truth straight to every EPP) must survive a digest install:
+    the install replays the journaled events over the incoming state
+    instead of letting a snapshot exported BEFORE the events overwrite
+    them."""
+    leader = _warm_scheduler()
+    digest = leader.export_state()
+    follower = Scheduler(ProfileConfig())
+    assert follower.install_state(digest)
+
+    stored = np.asarray([0xA1B2C3D4, 0x00C0FFEE, 0x12345678], np.uint32)
+    follower.apply_prefix_events(3, stored, np.asarray([], np.uint32))
+    for h in stored:
+        assert _has_presence_bit(follower, int(h), 3)
+
+    # Next poll reinstalls the SAME leader snapshot (leader hasn't seen
+    # these chunks): without the merge this wiped the local events.
+    assert follower.install_state(digest)
+    for h in stored:
+        assert _has_presence_bit(follower, int(h), 3), hex(int(h))
+
+    # Removal events merge too: endpoint 3 reports evicting one chunk.
+    follower.apply_prefix_events(
+        3, np.asarray([], np.uint32), stored[:1])
+    assert follower.install_state(digest)
+    assert not _has_presence_bit(follower, int(stored[0]), 3)
+    assert _has_presence_bit(follower, int(stored[1]), 3)
+
+
+def test_install_kv_merge_respects_ttl_and_eviction():
+    """Journal hygiene: events older than the replay TTL age out (the
+    digest stream is presumed to have caught up), and an evicted
+    endpoint's journal entries are dropped (a dead pod's bits must not be
+    resurrected onto a reused slot)."""
+    leader = _warm_scheduler()
+    digest = leader.export_state()
+
+    # TTL aging: with the TTL forced to zero the journal never replays.
+    f1 = Scheduler(ProfileConfig())
+    assert f1.install_state(digest)
+    f1._KV_REPLAY_TTL_S = 0.0
+    f1.apply_prefix_events(
+        2, np.asarray([0xDEADBEEF], np.uint32), np.asarray([], np.uint32))
+    assert _has_presence_bit(f1, 0xDEADBEEF, 2)
+    import time as _time
+
+    _time.sleep(0.01)
+    assert f1.install_state(digest)
+    assert not _has_presence_bit(f1, 0xDEADBEEF, 2)
+
+    # Eviction pruning: PodDelete between the event and the next install.
+    f2 = Scheduler(ProfileConfig())
+    assert f2.install_state(digest)
+    f2.apply_prefix_events(
+        5, np.asarray([0xBEEFCAFE], np.uint32), np.asarray([], np.uint32))
+    f2.evict_endpoint(5)
+    assert f2.install_state(digest)
+    assert not _has_presence_bit(f2, 0xBEEFCAFE, 5)
