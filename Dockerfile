@@ -31,6 +31,7 @@ COPY gie_tpu/ gie_tpu/
 COPY config/ config/
 COPY --from=native-build /src/native/libgiechunker.so native/libgiechunker.so
 COPY --from=native-build /src/native/libgiepromparse.so native/libgiepromparse.so
+COPY --from=native-build /src/native/libgiejsonscan.so native/libgiejsonscan.so
 
 # Ports: ext-proc gRPC / dedicated health / prometheus metrics.
 EXPOSE 9002 9003 9090

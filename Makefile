@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native generate test test-unit test-conformance bench bench-goodput bench-scrape cost release clean
+.PHONY: all native generate test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
 
 all: native generate
 
@@ -42,6 +42,12 @@ bench-goodput:
 # (docs/METRICSIO.md; sweep CPU + p99 row staleness at 16/64/256).
 bench-scrape:
 	$(PY) bench_scrape.py
+
+# Admission-path benchmark: zero-parse fast lane vs legacy ext-proc
+# (docs/EXTPROC.md; per-request CPU + wall p50/p99, exits non-zero when
+# the fast lane stops beating legacy — the CI regression guard).
+bench-extproc: native
+	$(PY) bench_extproc.py
 
 # Versioned release artifacts (CRDs, tuned profile, conformance report).
 release:

@@ -26,6 +26,15 @@ class BBRPlugin(Protocol):
         self, body: bytes, parsed: Optional[dict]
     ) -> tuple[dict[str, str], Optional[bytes]]: ...
 
+    # Optional fast-lane hook: answer from the zero-parse field scan
+    # (extproc/fieldscan.FieldScan) alone. Return the headers-to-set, or
+    # None when this request needs the full parsed dict (e.g. a body
+    # mutation applies) — the chain then falls back to execute(). A
+    # plugin without this method forces the legacy lane for every
+    # request.
+    #
+    # def execute_scanned(self, scan) -> Optional[dict[str, str]]: ...
+
 
 class ModelExtractorPlugin:
     """Default plugin (1964 DefaultPluginImplementation
@@ -38,6 +47,12 @@ class ModelExtractorPlugin:
         if parsed and isinstance(parsed.get("model"), str):
             return {MODEL_HEADER: parsed["model"]}, None
         return {}, None
+
+    def execute_scanned(self, scan):
+        # scan.model is non-None exactly when parsed["model"] is a str.
+        if scan.valid and scan.model is not None:
+            return {MODEL_HEADER: scan.model}
+        return {}
 
 
 class ModelRewritePlugin:
@@ -68,6 +83,14 @@ class ModelRewritePlugin:
             json.dumps(mutated).encode(),
         )
 
+    def execute_scanned(self, scan):
+        if not scan.valid or scan.model is None:
+            return {}
+        target = self.engine.resolve(self.pool, scan.model, self.namespace)
+        if target is None or target == scan.model:
+            return {}  # no rule fires: nothing to mutate, scan suffices
+        return None  # rewrite applies -> body mutation -> full parse
+
 
 def parse_body(body: bytes) -> Optional[dict]:
     """The chain's single JSON parse (1964 README:59 shared-parse rule),
@@ -84,6 +107,18 @@ def parse_body(body: bytes) -> Optional[dict]:
 class PluginChain:
     def __init__(self, plugins: list[BBRPlugin]):
         self.plugins = list(plugins)
+        # Bound execute_scanned methods resolved once (None when any
+        # plugin lacks the hook — then the fast lane is off for good and
+        # execute_scanned returns None without per-request getattr).
+        methods = [getattr(p, "execute_scanned", None) for p in self.plugins]
+        self._scan_methods = methods if all(methods) else None
+
+    @property
+    def supports_scan(self) -> bool:
+        """False when some plugin lacks the execute_scanned hook — then
+        the fast lane must not bother scanning at all (the scan would be
+        thrown away and the full parse would run anyway)."""
+        return self._scan_methods is not None
 
     def execute(
         self, body: bytes
@@ -103,7 +138,31 @@ class PluginChain:
             headers.update(h)
             if m is not None:
                 mutated = m
-                reparsed = parse_body(m)
-                if reparsed is not None:
-                    current = reparsed
+                # `current` must always describe the CURRENT body bytes:
+                # if a plugin emits an unparsable mutation, downstream
+                # consumers (later plugins, decode-tokens, the transcoding
+                # codec) see None — never a stale dict from a body that no
+                # longer exists.
+                current = parse_body(m)
         return headers, mutated, current
+
+    def execute_scanned(self, scan) -> Optional[dict[str, str]]:
+        """Fast lane (zero-parse admission): fold each plugin's
+        execute_scanned over the field scan. Returns the headers-to-set,
+        or None when any plugin lacks scan support or needs the full
+        parse for THIS request — the caller then runs execute(), whose
+        single shared parse honors the same 1964 at-most-once rule.
+
+        Equivalence to execute(): a None from any plugin means no
+        mutation ever happens on the fast lane, so every plugin saw the
+        scan of the original body — exactly the parsed dict execute()
+        would have fed it."""
+        if self._scan_methods is None:
+            return None
+        headers: dict[str, str] = {}
+        for fn in self._scan_methods:
+            h = fn(scan)
+            if h is None:
+                return None
+            headers.update(h)
+        return headers

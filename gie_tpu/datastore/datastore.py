@@ -85,6 +85,15 @@ class Datastore:
         self._pending_reclaims: list[int] = []
         # Admissions refused because every slot was taken (degrade mode).
         self._overflow = 0
+        # Admission fast path (extproc/server.py): the full-endpoint list
+        # is read once per REQUEST but changes only on pod churn, so a
+        # cached snapshot turns the per-request O(endpoints) copy-under-
+        # lock into one attribute read. Invalidated (None) by every
+        # membership mutation; callers must treat the returned list as
+        # immutable. pool_generation lets the ext-proc layer cache
+        # pool-derived decisions (appProtocol transcoding) the same way.
+        self._snapshot: Optional[list[Endpoint]] = None
+        self.pool_generation = 0
 
     # ---- pool ------------------------------------------------------------
 
@@ -100,6 +109,7 @@ class Datastore:
         with self._lock:
             old = self._pool
             self._pool = pool
+            self.pool_generation += 1
             changed = old is not None and (
                 old.selector != pool.selector
                 or old.target_ports != pool.target_ports
@@ -133,6 +143,8 @@ class Datastore:
     def clear(self) -> None:
         with self._lock:
             self._pool = None
+            self.pool_generation += 1
+            self._snapshot = None
             for key in list(self._endpoints):
                 self._remove_endpoint(key)
         self._drain_reclaims()
@@ -148,6 +160,7 @@ class Datastore:
         self._drain_reclaims()
 
     def _pod_update_or_add_locked(self, pod: Pod) -> None:
+        self._snapshot = None
         pool = self.pool_get()
         active = set(_active_ports(pod, pool.target_ports))
         for idx, port in enumerate(pool.target_ports):
@@ -207,12 +220,22 @@ class Datastore:
     def endpoints(
         self, predicate: Optional[Callable[[Endpoint], bool]] = None
     ) -> list[Endpoint]:
-        """Snapshot of endpoints (reference PodList, datastore.go:181-193)."""
+        """Snapshot of endpoints (reference PodList, datastore.go:181-193).
+        The no-predicate form returns a cached immutable snapshot (rebuilt
+        after membership changes) — do not mutate the result."""
+        if predicate is None:
+            snap = self._snapshot  # GIL-atomic read; None after mutation
+            if snap is not None:
+                return snap
+            with self._lock:
+                snap = self._snapshot
+                if snap is None:
+                    snap = list(self._endpoints.values())
+                    self._snapshot = snap
+            return snap
         with self._lock:
             eps = list(self._endpoints.values())
-        if predicate is not None:
-            eps = [e for e in eps if predicate(e)]
-        return eps
+        return [e for e in eps if predicate(e)]
 
     def endpoint_by_hostport(self, hostport: str) -> Optional[Endpoint]:
         with self._lock:
@@ -248,6 +271,7 @@ class Datastore:
             return self._overflow
 
     def _remove_endpoint(self, key: str) -> None:
+        self._snapshot = None
         ep = self._endpoints.pop(key)
         if self._by_hostport.get(ep.hostport) is ep:
             del self._by_hostport[ep.hostport]

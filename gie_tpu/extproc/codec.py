@@ -95,19 +95,31 @@ class FrameDecoder:
 
 def json_to_generate_request(
     body: bytes,
+    parsed: Optional[dict] = None,
 ) -> tuple[Optional[bytes], bool, str]:
     """OpenAI completion JSON -> (gRPC-framed GenerateRequest, stream flag,
     model name).
+
+    ``parsed`` is the at-most-once-parse handoff (1964 shared-parse rule
+    extended to transcoding): when the caller already parsed these exact
+    bytes — the BBR chain's shared parse, or server._pick_inner's hint
+    parse — passing the dict here skips the second ``json.loads`` the
+    transcoding path used to pay per request. Callers must only pass a
+    dict that came from ``body`` itself; None means "parse here" (which
+    on the zero-parse fast lane is the request's first and only parse).
 
     Returns (None, False, "") when the body is not a transcodable completion
     request — malformed JSON, missing prompt, or field values the proto
     cannot carry (e.g. negative max_tokens) — so callers pass the body
     through untouched instead of killing the stream.
     """
-    try:
-        obj = json.loads(body)
-    except (ValueError, UnicodeDecodeError):
-        return None, False, ""
+    if parsed is not None:
+        obj = parsed
+    else:
+        try:
+            obj = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return None, False, ""
     if not isinstance(obj, dict):
         return None, False, ""
     prompt = obj.get("prompt")

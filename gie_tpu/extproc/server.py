@@ -22,18 +22,37 @@ endpoint-picker protocol (004 README:80).
 from __future__ import annotations
 
 import dataclasses
+import math
 import re
 import time
+from collections import deque
 from typing import Optional, Protocol
 
 import grpc
 
 from google.protobuf.message import DecodeError as _DecodeError
 
-from gie_tpu.extproc import codec, envoy, metadata, pb
+from gie_tpu.extproc import codec, envoy, fieldscan, metadata, pb
+from gie_tpu.runtime import metrics as own_metrics
 from gie_tpu.runtime import tracing
 
 MAX_REQUEST_BODY_SIZE = 10 * 1024 * 1024  # reference server.go:103
+
+# Request headers the pick path actually reads (by exact key, the way the
+# readers look them up). The fast lane copies ONLY these out of the
+# Envoy header map — the legacy path copied every header into ctx.headers
+# per request, and the pick never read the rest (cookies, tracing
+# baggage, auth material). Extend via StreamingServer(needed_headers=...)
+# when a custom picker consumes additional keys.
+NEEDED_REQUEST_HEADERS = frozenset({
+    "content-type",                       # gRPC-in detection (codec)
+    metadata.DECODE_TOKENS_HINT_KEY,
+    metadata.MODEL_NAME_REWRITE_KEY,
+    metadata.OBJECTIVE_KEY,               # criticality band (batching)
+    metadata.FLOW_FAIRNESS_ID_KEY,        # fair interleave (batching)
+    metadata.TTFT_SLO_MS_KEY,             # SLO admission (batching)
+    metadata.TEST_ENDPOINT_SELECTION_HEADER,
+})
 
 
 class ExtProcError(Exception):
@@ -49,7 +68,7 @@ class ShedError(Exception):
     """Request shed under load -> ImmediateResponse 429 (004 README:80)."""
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class PickRequest:
     """reference handlers/server.go:65-69."""
 
@@ -64,7 +83,7 @@ class PickRequest:
     decode_tokens: float = 0.0
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class PickResult:
     """reference handlers/server.go:72-77."""
 
@@ -90,13 +109,16 @@ class PickResult:
     @property
     def destination_value(self) -> str:
         """Comma-separated ordered fallback list (004 README:50-82)."""
+        if not self.fallbacks:
+            return self.endpoint
         return ",".join([self.endpoint] + self.fallbacks)
 
 
 # Body fields carrying the client's output-token cap, by API generation:
-# completions/chat legacy, newer chat, responses API.
-_MAX_TOKENS_FIELDS = ("max_tokens", "max_completion_tokens",
-                      "max_output_tokens")
+# completions/chat legacy, newer chat, responses API. The tuple lives in
+# fieldscan so the native scanner, its fallback, and this module agree on
+# field order (precedence) forever.
+_MAX_TOKENS_FIELDS = fieldscan.MAX_TOKENS_FIELDS
 
 
 # Bound on client-supplied token hints: beyond any real context window,
@@ -106,33 +128,46 @@ _MAX_TOKENS_FIELDS = ("max_tokens", "max_completion_tokens",
 _DECODE_TOKENS_CAP = 1_000_000.0
 
 
+def _clamp_tokens(v: float) -> float:
+    if not math.isfinite(v) or v <= 0:
+        return 0.0
+    return min(v, _DECODE_TOKENS_CAP)
+
+
 def _decode_tokens(
-    headers: dict[str, list[str]], parsed: Optional[dict]
+    headers: dict[str, list[str]],
+    parsed: Optional[dict],
+    scan: Optional[fieldscan.FieldScan] = None,
 ) -> float:
     """Expected output tokens for one request: explicit decode-tokens
-    header first, else the parsed body's max_tokens-style cap; 0.0 when
-    neither is present/parsable (the scheduler treats 0 as unknown).
-    Values are clamped to a finite cap — JSON and float() both happily
-    produce inf."""
-    import math
-
-    def clamp(v: float) -> float:
-        if not math.isfinite(v) or v <= 0:
-            return 0.0
-        return min(v, _DECODE_TOKENS_CAP)
-
-    raw = headers.get(metadata.DECODE_TOKENS_HINT_KEY, [""])[0]
-    try:
-        val = clamp(float(raw))
-        if val > 0:
-            return val
-    except (TypeError, ValueError):
-        pass
+    header first, else the body's max_tokens-style cap — read from the
+    parsed dict (legacy lane) or the zero-parse field scan (fast lane;
+    fieldscan.caps aligns with _MAX_TOKENS_FIELDS and applies the same
+    numeric-not-bool rule). 0.0 when neither is present/parsable (the
+    scheduler treats 0 as unknown). Values are clamped to a finite cap —
+    JSON and float() both happily produce inf."""
+    clamp = _clamp_tokens
+    hint = headers.get(metadata.DECODE_TOKENS_HINT_KEY)
+    if hint:
+        # Guarded conversion, not try-first: the no-hint common case must
+        # not pay a float("") ValueError per request.
+        try:
+            val = clamp(float(hint[0]))
+            if val > 0:
+                return val
+        except (TypeError, ValueError):
+            pass
     if parsed:
         for field in _MAX_TOKENS_FIELDS:
             v = parsed.get(field)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 val = clamp(float(v))
+                if val > 0:
+                    return val
+    elif scan is not None and scan.valid:
+        for v in scan.caps:
+            if v is not None:
+                val = clamp(v)
                 if val > 0:
                     return val
     return 0.0
@@ -160,10 +195,23 @@ class RoundRobinPicker:
         return PickResult(endpoint=ep.hostport)
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class RequestContext:
+    """Per-stream state. Slotted (no per-instance __dict__) and recycled
+    through a bounded pool on the fast lane — one context is born and
+    reset per request at full admission rate. Hooks receiving a context
+    (on_served / on_response_complete) must not retain it past the call;
+    reset hands out FRESH containers, so a PickRequest that outlives the
+    stream (e.g. an abandoned scheduler item) keeps its own headers dict.
+    """
+
     headers: dict[str, list[str]] = dataclasses.field(default_factory=dict)
     candidates: list = dataclasses.field(default_factory=list)
+    # Which admission path served the pick ("fast" | "legacy") — the
+    # gie_extproc_admission_seconds label, so rollout dashboards compare
+    # the two lanes' latency live.
+    lane: str = "legacy"
+    pick_result: Optional[PickResult] = None
     target_endpoint: str = ""
     selected_pod_ip: str = ""
     # http-in -> gRPC-out transcoding state (proposal 2162).
@@ -197,11 +245,143 @@ class RequestContext:
     # JSON body split across network flushes must never train TPOT.
     timing_is_generation: bool = False
 
+    def reset(self) -> None:
+        """Return to the pristine state with FRESH containers (never
+        .clear() — a retained reference from a prior stream must keep its
+        own data)."""
+        self.headers = {}
+        self.candidates = []
+        self.lane = "legacy"
+        self.pick_result = None
+        self.target_endpoint = ""
+        self.selected_pod_ip = ""
+        self.transcoding = False
+        self.transcode_failed = False
+        self.stream_requested = False
+        self.model = ""
+        self.frame_decoder = None
+        self.response_frames = []
+        self.held_bytes = 0
+        self.served_hostport = ""
+        self.resp_tokens = 0
+        self.resp_first_at = 0.0
+        self.resp_last_at = 0.0
+        self.sse_carry = b"\n"
+        self.resp_tail = b""
+        self.resp_tail_truncated = False
+        self.last_frame = None
+        self.timing_is_generation = False
+
+
+# Bounded RequestContext free-list (fast lane): one context per stream at
+# full admission rate; deque.append/pop are GIL-atomic so no lock.
+_CTX_POOL: "deque[RequestContext]" = deque(maxlen=256)
+
+
+def _acquire_ctx() -> RequestContext:
+    try:
+        ctx = _CTX_POOL.pop()
+    except IndexError:
+        return RequestContext()
+    ctx.reset()
+    return ctx
+
 
 class Stream(Protocol):
     def recv(self) -> Optional[pb.ProcessingRequest]: ...
 
     def send(self, resp: pb.ProcessingResponse) -> None: ...
+
+
+class _HeadersTemplatePool:
+    """Pre-serialized ProcessingResponse skeletons for the headers
+    response, keyed by the sorted header-key tuple.
+
+    The legacy path rebuilt the same nested tree — HeadersResponse /
+    CommonResponse / HeaderMutation / N HeaderValueOptions / the
+    dynamic-metadata Struct pyramid — from Python per request; only the
+    VALUES differ between requests with the same key set (the overwhelming
+    majority: the two protocol keys, plus BBR's model header when a chain
+    runs). Here the skeleton is built once, serialized, and each request
+    revives it with one C-level MergeFromString and patches the values.
+    A fresh message per request, never a shared one: responses are queued
+    for serialization by the gRPC layer (service.py) and held by test
+    streams, so reusing a message object across requests would let a
+    later pick mutate an earlier, not-yet-serialized response.
+
+    Byte parity with the built-from-scratch path is pinned by
+    tests/test_extproc_fastlane.py. The cache is bounded: header keys
+    come from pick-result extra_headers, and an adversarial plugin must
+    not grow an unbounded dict.
+    """
+
+    __slots__ = ("_templates", "_limit")
+
+    def __init__(self, limit: int = 64):
+        self._templates: dict[tuple[str, ...], bytes] = {}
+        self._limit = limit
+
+    def build(
+        self, set_headers: dict[str, str], endpoint: str
+    ) -> pb.ProcessingResponse:
+        keys = tuple(sorted(set_headers))
+        tpl = self._templates.get(keys)
+        if tpl is None:
+            skeleton = pb.ProcessingResponse(
+                request_headers=pb.HeadersResponse(
+                    response=pb.CommonResponse(
+                        clear_route_cache=True,
+                        header_mutation=envoy.generate_headers_mutation(
+                            {k: "" for k in keys}
+                        ),
+                    )
+                ),
+                dynamic_metadata=envoy.make_dynamic_metadata(
+                    metadata.DESTINATION_ENDPOINT_NAMESPACE,
+                    {metadata.DESTINATION_ENDPOINT_KEY: ""},
+                ),
+            )
+            tpl = skeleton.SerializeToString()
+            if len(self._templates) < self._limit:
+                # GIL-atomic insert; a racing duplicate build is harmless.
+                self._templates[keys] = tpl
+        resp = pb.ProcessingResponse()
+        resp.MergeFromString(tpl)
+        mutation = resp.request_headers.response.header_mutation
+        for opt, key in zip(mutation.set_headers, keys):
+            opt.header.raw_value = set_headers[key].encode()
+        (
+            resp.dynamic_metadata
+            .fields[metadata.DESTINATION_ENDPOINT_NAMESPACE]
+            .struct_value.fields[metadata.DESTINATION_ENDPOINT_KEY]
+            .string_value
+        ) = endpoint
+        return resp
+
+
+def _empty_body_response(request_path: bool) -> pb.ProcessingResponse:
+    if request_path:
+        return pb.ProcessingResponse(
+            request_body=pb.BodyResponse(response=pb.CommonResponse())
+        )
+    return pb.ProcessingResponse(
+        response_body=pb.BodyResponse(response=pb.CommonResponse())
+    )
+
+
+# Shared immutable pass-through responses (fast lane): nothing ever
+# mutates these after construction, and concurrent SerializeToString on
+# one message is read-only, so every stream can send the same object —
+# the legacy path built a fresh two-level tree per body chunk.
+_PASSTHROUGH_REQUEST_BODY = _empty_body_response(request_path=True)
+_PASSTHROUGH_RESPONSE_BODY = _empty_body_response(request_path=False)
+
+# Pre-resolved admission-histogram children: Histogram.labels() hashes the
+# label tuple under a lock per call — measurable at per-request cadence.
+_ADMISSION_LANES = {
+    "fast": own_metrics.ADMISSION_SECONDS.labels(lane="fast"),
+    "legacy": own_metrics.ADMISSION_SECONDS.labels(lane="legacy"),
+}
 
 
 class StreamingServer:
@@ -210,9 +390,28 @@ class StreamingServer:
 
     def __init__(self, datastore, picker: EndpointPicker, on_served=None,
                  bbr_chain=None, transcode_h2c: bool = True,
-                 on_response_complete=None):
+                 on_response_complete=None, fast_lane: bool = True,
+                 needed_headers=None):
         self.datastore = datastore
         self.picker = picker
+        # Admission fast lane (docs/EXTPROC.md): zero-parse field scan
+        # instead of json.loads when the BBR chain can run from the scan,
+        # needed-keys header copy, and pooled response templates. Off =
+        # the seed's build-everything-per-request path (--extproc-fast-
+        # lane rollout flag); outputs are byte-identical either way.
+        self.fast_lane = fast_lane
+        self._needed_headers = (
+            NEEDED_REQUEST_HEADERS
+            if needed_headers is None
+            else frozenset(NEEDED_REQUEST_HEADERS) | frozenset(needed_headers)
+        )
+        self._headers_templates = _HeadersTemplatePool()
+        # Compiled needed-keys spec for the native header scan (stable
+        # bytes identity — the C side caches its parse per pointer).
+        self._header_spec = fieldscan.HeaderSpec(self._needed_headers)
+        # appProtocol cache, keyed on the datastore's pool generation.
+        self._pool_proto_gen: Optional[int] = None
+        self._pool_proto_grpc = False
         # Served-endpoint feedback hook (004 README:84-101): called with the
         # hostport reported by the data plane at response time.
         self.on_served = on_served
@@ -232,17 +431,26 @@ class StreamingServer:
     def _pool_wants_grpc(self) -> bool:
         if not self.transcode_h2c:
             return False
+        # Pool specs change on reconcile cadence, not request cadence:
+        # cache the appProtocol decision against the datastore's pool
+        # generation instead of taking the datastore lock per request.
+        gen = getattr(self.datastore, "pool_generation", None)
+        if gen is not None and gen == self._pool_proto_gen:
+            return self._pool_proto_grpc
         try:
             pool = self.datastore.pool_get()
         except Exception:
-            return False
-        return getattr(pool, "app_protocol", "http") == "kubernetes.io/h2c"
+            value = False
+        else:
+            value = getattr(pool, "app_protocol", "http") == "kubernetes.io/h2c"
+        if gen is not None:
+            self._pool_proto_grpc = value
+            self._pool_proto_gen = gen
+        return value
 
     # ------------------------------------------------------------------ #
 
     def process(self, stream: Stream) -> None:
-        from gie_tpu.runtime import metrics as own_metrics
-
         own_metrics.STREAMS.inc()
         try:
             self._process(stream)
@@ -250,7 +458,19 @@ class StreamingServer:
             own_metrics.STREAMS.dec()
 
     def _process(self, stream: Stream) -> None:
-        ctx = RequestContext()
+        if self.fast_lane:
+            ctx = _acquire_ctx()
+            try:
+                self._process_with(ctx, stream)
+            finally:
+                # Hooks ran synchronously inside the loop; nothing holds
+                # the context once the stream ends (reset() hands out
+                # fresh containers for anything that does hold a dict).
+                _CTX_POOL.append(ctx)
+        else:
+            self._process_with(RequestContext(), stream)
+
+    def _process_with(self, ctx: RequestContext, stream: Stream) -> None:
         body = bytearray()
         headers_deferred = False
         while True:
@@ -259,8 +479,17 @@ class StreamingServer:
                 return
             which = req.WhichOneof("request")
             if which == "request_headers":
-                with tracing.span("extproc.request_headers"):
+                admission_t0 = time.perf_counter()
+                if self.fast_lane:
+                    # No per-request tracing spans on the fast lane: two
+                    # span observes cost more than the scan they would
+                    # time; gie_extproc_admission_seconds carries the
+                    # admission signal instead (spans return with the
+                    # rollout flag off).
                     self._handle_request_headers(ctx, req)
+                else:
+                    with tracing.span("extproc.request_headers"):
+                        self._handle_request_headers(ctx, req)
                 if req.request_headers.end_of_stream:
                     try:
                         self._pick(ctx, None)
@@ -274,6 +503,8 @@ class StreamingServer:
                         )
                         return
                     stream.send(self._headers_response(ctx))
+                    _ADMISSION_LANES[ctx.lane].observe(
+                        time.perf_counter() - admission_t0)
                 else:
                     headers_deferred = True
             elif which == "request_body":
@@ -286,6 +517,7 @@ class StreamingServer:
                     )
                 body.extend(chunk)
                 if req.request_body.end_of_stream:
+                    admission_t0 = time.perf_counter()
                     try:
                         result = self._pick(ctx, bytes(body))
                     except ShedError:
@@ -305,6 +537,8 @@ class StreamingServer:
                             result.mutated_body, request_path=True
                         ):
                             stream.send(resp)
+                    elif self.fast_lane:
+                        stream.send(_PASSTHROUGH_REQUEST_BODY)
                     else:
                         stream.send(
                             pb.ProcessingResponse(
@@ -313,6 +547,8 @@ class StreamingServer:
                                 )
                             )
                         )
+                    _ADMISSION_LANES[ctx.lane].observe(
+                        time.perf_counter() - admission_t0)
                 else:
                     # Intermediate chunks need no reply in buffered-partial
                     # mode; continue receiving.
@@ -331,13 +567,16 @@ class StreamingServer:
                     )
                 else:
                     self._count_plain_tokens(ctx, req.response_body.body)
-                    stream.send(
-                        pb.ProcessingResponse(
-                            response_body=pb.BodyResponse(
-                                response=pb.CommonResponse()
+                    if self.fast_lane:
+                        stream.send(_PASSTHROUGH_RESPONSE_BODY)
+                    else:
+                        stream.send(
+                            pb.ProcessingResponse(
+                                response_body=pb.BodyResponse(
+                                    response=pb.CommonResponse()
+                                )
                             )
                         )
-                    )
                 if req.response_body.end_of_stream:
                     self._finish_token_count(ctx)
                     if self.on_response_complete is not None:
@@ -356,12 +595,61 @@ class StreamingServer:
     ) -> None:
         """reference handlers/request.go:34-139."""
         hdrs = req.request_headers
-        for h in hdrs.headers.headers:
-            ctx.headers.setdefault(h.key, []).append(envoy.get_header_value(h))
+        if self.fast_lane:
+            # Needed-keys scan: copy only the headers the pick path reads
+            # (NEEDED_REQUEST_HEADERS + constructor extensions). Envoy
+            # sends HTTP/2 headers lowercased, and every reader looks up
+            # the exact lowercase key, so exact-match filtering sees
+            # precisely what the legacy full copy made visible.
+            # Native path: one C-level HeaderMap serialize + one wire walk
+            # beats iterating N message wrappers from Python; the pure-
+            # Python loop below is the no-library fallback (inlined
+            # get_header_value — a function call per header is real money
+            # at 12+ headers x full admission rate).
+            out = ctx.headers
+            pairs = (
+                fieldscan.scan_headers(
+                    hdrs.headers.SerializeToString(), self._header_spec
+                )
+                if fieldscan.headers_available()
+                else None
+            )
+            if pairs is not None:
+                for key, value in pairs:
+                    bucket = out.get(key)
+                    if bucket is None:
+                        out[key] = [value]
+                    else:
+                        bucket.append(value)
+            else:
+                needed = self._needed_headers
+                for h in hdrs.headers.headers:
+                    key = h.key
+                    if key in needed:
+                        raw = h.raw_value
+                        value = (
+                            raw.decode("utf-8", "replace") if raw else h.value
+                        )
+                        bucket = out.get(key)
+                        if bucket is None:
+                            out[key] = [value]
+                        else:
+                            bucket.append(value)
+        else:
+            for h in hdrs.headers.headers:
+                ctx.headers.setdefault(h.key, []).append(
+                    envoy.get_header_value(h)
+                )
 
         # Subset hint from filter metadata: string ("ip1,ip2") or array forms
         # (reference request.go:51-77 — both Envoy pathways supported).
-        md = envoy.extract_metadata_values(req)
+        # Requests without filter metadata (the overwhelming majority) skip
+        # the struct->dict conversion entirely.
+        md = (
+            envoy.extract_metadata_values(req)
+            if req.metadata_context.filter_metadata
+            else {}
+        )
         has_subset_filter = False
         metadata_endpoints: list[str] = []
         subset_ns = md.get(metadata.SUBSET_FILTER_NAMESPACE)
@@ -380,10 +668,18 @@ class StreamingServer:
             metadata_endpoints = [p.strip() for p in parts if p.strip()]
 
         # Test steering header takes priority (reference request.go:84-97).
+        # Fast lane: the needed-keys pass above already captured it, so
+        # read the dict instead of rescanning (and re-lowercasing) every
+        # header. Envoy lowercases HTTP/2 header keys, so the exact-match
+        # copy sees what the case-insensitive legacy scan would.
         filter_endpoints: list[str] = []
-        test_val = envoy.extract_header_value(
-            hdrs, metadata.TEST_ENDPOINT_SELECTION_HEADER
-        )
+        if self.fast_lane:
+            vals = ctx.headers.get(metadata.TEST_ENDPOINT_SELECTION_HEADER)
+            test_val = vals[0] if vals else None
+        else:
+            test_val = envoy.extract_header_value(
+                hdrs, metadata.TEST_ENDPOINT_SELECTION_HEADER
+            )
         if test_val:
             filter_endpoints = [p.strip() for p in test_val.split(",") if p.strip()]
         if not filter_endpoints and metadata_endpoints:
@@ -415,23 +711,71 @@ class StreamingServer:
 
     def _pick(self, ctx: RequestContext, body: Optional[bytes]) -> PickResult:
         """reference handlers/request.go:141-163."""
+        if self.fast_lane:  # admission histogram replaces the span
+            return self._pick_inner(ctx, body)
         with tracing.span("extproc.pick", candidates=len(ctx.candidates)):
             return self._pick_inner(ctx, body)
 
     def _pick_inner(self, ctx: RequestContext, body: Optional[bytes]) -> PickResult:
+        """Admission core. Two lanes, byte-identical outputs:
+
+        fast   (fast_lane on, and the BBR chain — if any — can answer
+               from the field scan): ZERO json.loads. The native scanner
+               (fieldscan) pulls model/stream/max_tokens in one pass; the
+               body flows through untouched.
+        legacy (flag off, or a plugin needs the parsed dict / mutates the
+               body): at most ONE json.loads for the whole request path —
+               the chain's shared parse (1964 README:59) rides into the
+               decode-tokens extraction AND the transcoding codec below,
+               which previously re-parsed the same bytes
+               (bbr/chain.py:78 + codec.py:108).
+        """
         bbr_headers: dict[str, str] = {}
         bbr_body: Optional[bytes] = None
         parsed: Optional[dict] = None
-        if self.bbr_chain is not None and body:
-            with tracing.span("extproc.bbr"):
-                bbr_headers, bbr_body, parsed = self.bbr_chain.execute(body)
-        elif body:
-            # No BBR chain: the EPP still owes the scheduler its
-            # output-length hint; this is the request path's one parse
-            # (same at-most-once contract as the chain's).
-            from gie_tpu.bbr.chain import parse_body
+        scan: Optional[fieldscan.FieldScan] = None
+        # gRPC transcoding (checked up front so the lane choice can see
+        # it): a body that will be reframed as a GenerateRequest needs a
+        # full parse no matter what — scanning first would only add work.
+        # The single parse below then rides into the codec.
+        will_transcode = (
+            body is not None
+            and self._pool_wants_grpc()
+            and not codec.is_grpc_request(ctx.headers)
+        )
+        if self.fast_lane and body and not will_transcode:
+            chain = self.bbr_chain
+            if chain is None:
+                scan = fieldscan.scan(body)
+            elif getattr(chain, "supports_scan", True):
+                # supports_scan is checked BEFORE scanning: a chain that
+                # statically cannot answer from the scan (a plugin without
+                # the execute_scanned hook) must not pay a wasted body
+                # pass per request on top of its full parse.
+                scan = fieldscan.scan(body)
+                scanned_headers = chain.execute_scanned(scan)
+                if scanned_headers is None:
+                    # THIS request needs the full parse (a body mutation
+                    # fires): run the legacy chain. One parse.
+                    scan = None
+                else:
+                    bbr_headers = scanned_headers
+        if scan is None and body:
+            if self.bbr_chain is not None:
+                with tracing.span("extproc.bbr"):
+                    bbr_headers, bbr_body, parsed = self.bbr_chain.execute(body)
+            else:
+                # No BBR chain: the EPP still owes the scheduler its
+                # output-length hint; this is the request path's one parse
+                # (same at-most-once contract as the chain's).
+                from gie_tpu.bbr.chain import parse_body
 
-            parsed = parse_body(body)
+                parsed = parse_body(body)
+        # Lane label = the rollout flag, not the per-request parse path:
+        # templates and the needed-keys header scan apply flag-wide, and
+        # dashboards compare deployments by flag setting. (A chain- or
+        # transcode-forced full parse under the flag still reports fast.)
+        ctx.lane = "fast" if self.fast_lane else "legacy"
         # Model precedence: an explicit rewrite (from BBR's rewrite plugin,
         # else the upstream rewrite header) beats the raw extracted body
         # model (proposal 1816 rewrite > 1964 extraction).
@@ -447,24 +791,32 @@ class StreamingServer:
                 headers=ctx.headers,
                 body=bbr_body if bbr_body is not None else body,
                 model=model,
-                decode_tokens=_decode_tokens(ctx.headers, parsed),
+                decode_tokens=_decode_tokens(ctx.headers, parsed, scan),
             ),
             ctx.candidates,
         )
-        result.extra_headers = {**bbr_headers, **result.extra_headers}
+        if result.extra_headers:
+            result.extra_headers = {**bbr_headers, **result.extra_headers}
+        elif bbr_headers:
+            # bbr_headers is a fresh per-request dict (chain.execute /
+            # execute_scanned build it); handing it over avoids a copy.
+            result.extra_headers = bbr_headers
         if result.mutated_body is None and bbr_body is not None:
             result.mutated_body = bbr_body
 
         # http-in -> gRPC-out (proposal 2162): JSON clients talking to an
         # h2c/gRPC pool get their (possibly BBR-mutated) completion body
         # reframed as a gRPC GenerateRequest. gRPC-in clients pass through.
-        if (
-            body is not None
-            and self._pool_wants_grpc()
-            and not codec.is_grpc_request(ctx.headers)
-        ):
+        if will_transcode:
             source = result.mutated_body if result.mutated_body is not None else body
-            framed, stream_requested, model_name = codec.json_to_generate_request(source)
+            # At-most-once parse: hand the codec the dict this request
+            # already paid for — valid only when `source` IS the bytes
+            # that dict came from (the raw body, or the chain's final
+            # mutation; a picker-supplied mutated_body is neither).
+            framed, stream_requested, model_name = codec.json_to_generate_request(
+                source,
+                parsed=parsed if (source is body or source is bbr_body) else None,
+            )
             if framed is not None:
                 ctx.stream_requested = stream_requested
                 ctx.transcoding = True
@@ -482,7 +834,11 @@ class StreamingServer:
 
     def _headers_response(self, ctx: RequestContext) -> pb.ProcessingResponse:
         """Destination via BOTH header and envoy.lb dynamic metadata
-        (004 README:46-82; reference server.go:148-190)."""
+        (004 README:46-82; reference server.go:148-190). Fast lane: the
+        response skeleton comes from the pre-serialized template pool and
+        only the endpoint-bearing values are patched — byte-identical to
+        the built-from-scratch legacy path (pinned by
+        tests/test_extproc_fastlane.py)."""
         set_headers = {
             metadata.DESTINATION_ENDPOINT_KEY: ctx.target_endpoint,
             # Conformance affordance: ask the echo backend to reflect the
@@ -491,9 +847,13 @@ class StreamingServer:
                 metadata.CONFORMANCE_TEST_RESULT_HEADER + ":" + ctx.target_endpoint
             ),
         }
-        extra = getattr(ctx, "pick_result", None)
-        if extra is not None:
+        extra = ctx.pick_result
+        if extra is not None and extra.extra_headers:
             set_headers.update(extra.extra_headers)
+        if self.fast_lane:
+            return self._headers_templates.build(
+                set_headers, ctx.target_endpoint
+            )
         return pb.ProcessingResponse(
             request_headers=pb.HeadersResponse(
                 response=pb.CommonResponse(

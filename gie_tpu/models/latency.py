@@ -5,8 +5,12 @@ north star configs[3]; the reference moved it out-of-tree with the full EPP —
 the spec seam is the Score stage of docs/proposals/0845-scheduler-
 architecture-proposal/README.md:66-72). A small MLP maps per-(request,
 endpoint) features to predicted (TTFT seconds, TPOT seconds/token); the
-scorer column is exp(-predicted_request_latency / norm), normalized to
-(0, 1].
+scorer column is 1 / (1 + predicted_request_latency / norm), normalized to
+(0, 1] (hyperbolic decay — exp(-latency/norm) underflowed to exactly 0.0
+for long-decode requests, where a 4096-token response predicts tens to
+hundreds of seconds; once every candidate scores 0.0f the column carries
+less signal than the picker's ulp-level tiebreak and long decodes were
+steered by lane order instead of the trained TPOT head).
 
 Everything — feature construction from the dense batches, the forward over
 the full [N, M] grid, and the SGD step — is jit-compiled; the MXU sees one
@@ -51,7 +55,8 @@ LOAD_NORM = 32.0
 class LatencyPredictorConfig:
     hidden: int = 128
     layers: int = 2
-    # Normalization for the score column: score = exp(-latency / norm_s).
+    # Normalization for the score column:
+    # score = 1 / (1 + latency / norm_s).
     norm_s: float = 2.0
     learning_rate: float = 1e-3
     weight_decay: float = 1e-4
@@ -202,7 +207,12 @@ def predictor_score_fn(predictor: LatencyPredictor):
         )
         latency = predictor.request_latency(
             params, feats, slots, reqs.decode_len)
-        return jnp.exp(-latency / predictor.cfg.norm_s)
+        # Hyperbolic, not exponential, decay: monotone in latency with a
+        # fat tail, so a 30 s and a 300 s forecast still score DIFFERENT
+        # float32 values. exp(-latency/norm) flushed both to 0.0f on
+        # long-decode requests and the column went dark exactly where
+        # TPOT matters most (tests/test_tpot_training.py pd steering).
+        return 1.0 / (1.0 + jnp.maximum(latency, 0.0) / predictor.cfg.norm_s)
 
     return fn
 

@@ -29,6 +29,20 @@ BATCH_SIZE = prom.Histogram(
 STREAMS = prom.Gauge(
     "gie_active_streams", "Open ext-proc streams", registry=REGISTRY
 )
+# Admission fast lane (extproc/server.py, docs/EXTPROC.md): per-request
+# EPP overhead between "request fully received" and "routing decision
+# sent" — pick + body scan/parse + response build. The lane label splits
+# the zero-parse fast path from the legacy build-everything path so a
+# --extproc-fast-lane rollout compares both live; the scheduler's own
+# batching wait is measured separately by gie_pick_latency_seconds.
+ADMISSION_SECONDS = prom.Histogram(
+    "gie_extproc_admission_seconds",
+    "Per-request admission processing time (pick + parse/scan + response "
+    "build) by lane (fast = zero-parse scan path, legacy = full parse)",
+    ["lane"],
+    buckets=(1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3),
+    registry=REGISTRY,
+)
 QUEUE_DEPTH = prom.Gauge(
     "gie_flow_queue_depth",
     "Picks waiting in the flow-control queue (reference flow-controller "

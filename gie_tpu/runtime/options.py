@@ -62,6 +62,13 @@ class Options:
     kv_events_bind: str = "127.0.0.1"
     # Shared bearer token required on KV-event POSTs (None = no auth).
     kv_events_token: Optional[str] = None
+    # Admission fast lane (extproc/server.py, docs/EXTPROC.md): zero-parse
+    # native JSON field scan, needed-keys header copy, and pooled
+    # ProcessingResponse templates on the per-request pick path. Outputs
+    # are byte-identical to the legacy path (pinned by tests); the flag
+    # exists for safe rollout and for custom pickers that read request
+    # headers outside server.NEEDED_REQUEST_HEADERS.
+    extproc_fast_lane: bool = True
     # Flow-control queue bounds (reference flow-controller overload policy,
     # proposal 0683): max picks waiting (0 = unbounded) and max seconds a
     # non-critical pick may queue before shedding 429 (0 = unbounded).
@@ -166,6 +173,18 @@ class Options:
         parser.add_argument("--kv-events-token", default=d.kv_events_token,
                             help="shared bearer token required on KV-event "
                                  "POSTs (default: no auth)")
+        parser.add_argument("--extproc-fast-lane", dest="extproc_fast_lane",
+                            action="store_true",
+                            default=d.extproc_fast_lane,
+                            help="zero-parse admission fast path (native "
+                                 "JSON field scan + pooled response "
+                                 "templates + needed-keys header copy)")
+        parser.add_argument("--no-extproc-fast-lane",
+                            dest="extproc_fast_lane", action="store_false",
+                            help="disable the admission fast lane (full "
+                                 "json.loads + per-request response "
+                                 "build; use when a custom picker reads "
+                                 "headers beyond the needed-keys set)")
         parser.add_argument("--queue-bound", type=int, default=d.queue_bound,
                             help="max picks waiting in the flow-control "
                                  "queue; a full queue sheds by criticality "
@@ -262,6 +281,7 @@ class Options:
             kv_events_port=args.kv_events_port,
             kv_events_bind=args.kv_events_bind,
             kv_events_token=args.kv_events_token,
+            extproc_fast_lane=args.extproc_fast_lane,
             queue_bound=args.queue_bound,
             queue_max_age_s=args.queue_max_age_s,
             autoscale_mode=args.autoscale_mode,

@@ -297,6 +297,7 @@ class ExtProcServerRunner:
             self.datastore, self.picker,
             on_served=self.picker.observe_served,
             on_response_complete=self.picker.observe_response_complete,
+            fast_lane=opts.extproc_fast_lane,
         )
         self.grpc_server: Optional[grpc.Server] = None
         self.health_server: Optional[grpc.Server] = None

@@ -9,11 +9,11 @@ when nobody scrapes/logs is two clock reads.
 
 from __future__ import annotations
 
-import contextlib
 import time
 
 import prometheus_client as prom
 
+from gie_tpu.runtime import logging as own_logging
 from gie_tpu.runtime.logging import TRACE, get_logger
 from gie_tpu.runtime.metrics import REGISTRY
 
@@ -27,15 +27,49 @@ SPANS = prom.Histogram(
 
 _log = get_logger("trace")
 
+# Label-child cache: SPANS.labels() takes a lock and hashes the label
+# tuple on every call; span names are a small fixed set on the admission
+# hot path (2 spans per request), so resolve each child once.
+_CHILDREN: dict = {}
 
-@contextlib.contextmanager
-def span(name: str, **attrs):
+
+def _child(name: str):
+    child = _CHILDREN.get(name)
+    if child is None:
+        child = _CHILDREN[name] = SPANS.labels(span=name)
+    return child
+
+
+class _Span:
+    """Slotted context manager: the generator/contextlib machinery plus
+    the suppressed-log record build cost more than the spans' useful work
+    on the admission hot path (hundreds of thousands of requests/s per
+    core); the histogram observe is always live, the TRACE log record is
+    only constructed when TRACE verbosity is actually enabled."""
+
+    __slots__ = ("name", "attrs", "started")
+
+    def __init__(self, name: str, attrs):
+        self.name = name
+        self.attrs = attrs
+        self.started = 0.0
+
+    def __enter__(self):
+        self.started = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        elapsed = time.monotonic() - self.started
+        _child(self.name).observe(elapsed)
+        if own_logging._level.get() >= TRACE:
+            _log.v(TRACE).info(
+                "span", name=self.name, seconds=round(elapsed, 6),
+                **self.attrs
+            )
+        return False
+
+
+def span(name: str, **attrs) -> _Span:
     """Time a request-path section: prometheus histogram always, TRACE-level
     structured log when verbosity allows."""
-    started = time.monotonic()
-    try:
-        yield
-    finally:
-        elapsed = time.monotonic() - started
-        SPANS.labels(span=name).observe(elapsed)
-        _log.v(TRACE).info("span", name=name, seconds=round(elapsed, 6), **attrs)
+    return _Span(name, attrs)

@@ -1,0 +1,768 @@
+// Zero-parse admission fast path: streaming JSON field scanner.
+//
+// The EPP's pick path needs exactly four things from a request body —
+// `model`, the max_tokens-style output cap, `stream`, and whether a
+// prompt/messages shape exists — yet the legacy path pays a full
+// json.loads (object materialization, dict interning, unicode decode of
+// the entire prompt) once or twice per request (bbr/chain.py +
+// extproc/codec.py). This scanner walks the body ONCE, validates the
+// exact JSON language Python's json.loads accepts, and extracts only the
+// watched top-level fields without building any objects.
+//
+// Parity contract (pinned by tests/test_fieldscan.py): for every input
+// where gie_json_scan returns OK/INVALID, the extracted fields MUST
+// equal what json.loads + Python-side field reads would produce —
+// duplicate keys keep the LAST occurrence, numbers follow Python float
+// semantics (1e400 -> inf), NaN/Infinity/-Infinity literals are accepted
+// (allow_nan default), strings reject raw control chars (strict mode)
+// and invalid UTF-8, \uXXXX escapes decode with surrogate-pair joining.
+// Inputs whose Python behavior the scanner cannot cheaply reproduce
+// return FALLBACK and the caller runs the real json.loads:
+//   - non-UTF-8 encodings json.detect_encoding would accept (BOMs,
+//     UTF-16/32 null-byte patterns)
+//   - escaped top-level keys ({"model": ...})
+//   - lone surrogates in the model string (Python keeps them; a later
+//     .encode() must crash identically)
+//   - integer literals too long for Python's float() (OverflowError)
+//   - nesting beyond SCAN_MAX_DEPTH (Python recurses toward its limit)
+//   - model strings longer than the caller's buffer
+//
+// Mirrors the promparse.cc pattern: one extern-C entry point, caller
+// supplies reusable per-thread output buffers. Build: make -C native
+// (libgiejsonscan.so); pure-Python fallback in extproc/fieldscan.py.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// SWAR "does any byte need attention" test for string scanning: true when
+// the 8-byte word contains a quote, backslash, control byte (< 0x20), or
+// non-ASCII byte. Standard zero-byte detection: haszero(v) =
+// (v - 0x01..) & ~v & 0x80.. ; hasvalue(x, b) = haszero(x ^ (b * 0x01..)).
+inline uint64_t string_special(uint64_t w) {
+  const uint64_t ones = 0x0101010101010101ULL;
+  const uint64_t highs = 0x8080808080808080ULL;
+  uint64_t high = w & highs;                         // >= 0x80
+  uint64_t ctrl = (w - ones * 0x20) & ~w & highs;    // < 0x20 (ASCII only)
+  uint64_t q = w ^ (ones * '"');
+  q = (q - ones) & ~q & highs;
+  uint64_t b = w ^ (ones * '\\');
+  b = (b - ones) & ~b & highs;
+  return high | ctrl | q | b;
+}
+
+// Unaligned 8-byte load via memcpy (compiles to a single mov on x86).
+inline uint64_t load8(const unsigned char* p) {
+  uint64_t w;
+  memcpy(&w, p, 8);
+  return w;
+}
+
+constexpr long kOk = 0;
+constexpr long kInvalid = -1;
+constexpr long kFallback = -2;
+
+constexpr int kMaxDepth = 64;
+// Python float() overflows past ~1.8e308; any integer literal of <= 308
+// digits stays below 1e308 and converts exactly like strtod. Longer
+// literals can raise OverflowError in Python where strtod yields inf.
+constexpr int kMaxIntDigits = 308;
+
+// Flag vector indices (out_flags, u8[6]).
+enum {
+  kFlagTopIsObject = 0,
+  kFlagHasModel = 1,       // top-level "model" is a string
+  kFlagStreamTruthy = 2,   // bool(obj["stream"]) per Python truthiness
+  kFlagHasStream = 3,      // top-level "stream" key present
+  kFlagPromptIsString = 4,
+  kFlagMessagesIsList = 5,
+};
+
+// Watched top-level keys. Order of the caps trio matches
+// extproc/server.py _MAX_TOKENS_FIELDS.
+enum WatchId {
+  kWatchNone = -1,
+  kWatchModel = 0,
+  kWatchStream = 1,
+  kWatchPrompt = 2,
+  kWatchMessages = 3,
+  kWatchCap0 = 4,  // max_tokens
+  kWatchCap1 = 5,  // max_completion_tokens
+  kWatchCap2 = 6,  // max_output_tokens
+};
+
+struct Scanner {
+  const unsigned char* s;
+  long n;
+  long i = 0;
+  long rc = kOk;  // sticky: first invalid/fallback wins
+
+  unsigned char flags[6] = {0, 0, 0, 0, 0, 0};
+  unsigned char caps_found[3] = {0, 0, 0};
+  char* model_buf;
+  long model_cap;
+  long model_len = 0;
+  double* caps;
+
+  bool fail(long code) {
+    if (rc == kOk) rc = code;
+    return false;
+  }
+
+  void skip_ws() {
+    while (i < n) {
+      unsigned char c = s[i];
+      if (c == ' ' || c == '\t' || c == '\n' || c == '\r') ++i;
+      else break;
+    }
+  }
+
+  bool lit(const char* word) {
+    long len = (long)strlen(word);
+    if (i + len > n || memcmp(s + i, word, len) != 0) return false;
+    i += len;
+    return true;
+  }
+
+  // Validate one UTF-8 sequence starting at s[i] (first byte >= 0x80).
+  // Python's json.loads(bytes) decodes with errors='surrogatepass', so
+  // raw CESU surrogate encodings (ED A0-BF 80-BF) are ACCEPTED (they
+  // become lone surrogates in the str) while overlongs and codepoints
+  // past U+10FFFF still raise. *out_surrogate reports the accepted
+  // surrogate case — a model string containing one needs the Python
+  // fallback (a later .encode() must crash identically to legacy).
+  bool utf8_seq(unsigned char first, unsigned char* out, int* out_len,
+                bool* out_surrogate) {
+    int need;
+    unsigned char lo = 0x80, hi = 0xBF;
+    *out_surrogate = false;
+    if (first >= 0xC2 && first <= 0xDF) need = 1;
+    else if (first == 0xE0) { need = 2; lo = 0xA0; }
+    else if (first >= 0xE1 && first <= 0xEF) {
+      need = 2;  // ED A0-BF would be a surrogate: allowed (surrogatepass)
+      if (first == 0xED) *out_surrogate = true;  // maybe — checked below
+    }
+    else if (first == 0xF0) { need = 3; lo = 0x90; }
+    else if (first >= 0xF1 && first <= 0xF3) need = 3;
+    else if (first == 0xF4) { need = 3; hi = 0x8F; }
+    else return false;
+    if (i + need > n) return false;
+    out[0] = first;
+    for (int k = 0; k < need; ++k) {
+      unsigned char c = s[i + k];
+      unsigned char l = (k == 0) ? lo : 0x80, h = (k == 0) ? hi : 0xBF;
+      if (c < l || c > h) return false;
+      out[1 + k] = c;
+    }
+    if (first == 0xED && s[i] < 0xA0) *out_surrogate = false;
+    i += need;
+    *out_len = 1 + need;
+    return true;
+  }
+
+  // Append a codepoint as UTF-8 into the model buffer.
+  bool model_push_cp(unsigned long cp) {
+    char tmp[4];
+    int len;
+    if (cp < 0x80) { tmp[0] = (char)cp; len = 1; }
+    else if (cp < 0x800) {
+      tmp[0] = (char)(0xC0 | (cp >> 6));
+      tmp[1] = (char)(0x80 | (cp & 0x3F));
+      len = 2;
+    } else if (cp < 0x10000) {
+      tmp[0] = (char)(0xE0 | (cp >> 12));
+      tmp[1] = (char)(0x80 | ((cp >> 6) & 0x3F));
+      tmp[2] = (char)(0x80 | (cp & 0x3F));
+      len = 3;
+    } else {
+      tmp[0] = (char)(0xF0 | (cp >> 18));
+      tmp[1] = (char)(0x80 | ((cp >> 12) & 0x3F));
+      tmp[2] = (char)(0x80 | ((cp >> 6) & 0x3F));
+      tmp[3] = (char)(0x80 | (cp & 0x3F));
+      len = 4;
+    }
+    if (model_len + len > model_cap) return fail(kFallback);
+    memcpy(model_buf + model_len, tmp, len);
+    model_len += len;
+    return true;
+  }
+
+  bool hex4(unsigned long* out) {
+    if (i + 4 > n) return false;
+    unsigned long v = 0;
+    for (int k = 0; k < 4; ++k) {
+      unsigned char c = s[i + k];
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= c - '0';
+      else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+      else return false;
+    }
+    i += 4;
+    *out = v;
+    return true;
+  }
+
+  // Parse a string. s[i] is past the opening quote on entry.
+  // mode 0: validate only.
+  // mode 1: capture decoded UTF-8 into model_buf (the `model` value).
+  // mode 2: key capture — raw bytes into key_buf (no escapes allowed at
+  //         top level; an escape sets *key_escaped).
+  // Returns false on INVALID input (rc set); empty-ness via *out_empty.
+  bool string_tail(int mode, bool* out_empty, char* key_buf, long key_cap,
+                   long* key_len, bool* key_escaped) {
+    bool empty = true;
+    if (mode == 1) model_len = 0;
+    if (mode == 2) *key_len = 0;
+    while (true) {
+      // Bulk-skip plain ASCII runs (the prompt body — by far most of the
+      // bytes the scanner sees). Validate-only mode just advances; the
+      // capture modes copy the clean span wholesale.
+      if (i + 8 <= n && !string_special(load8(s + i))) {
+        long run_start = i;
+        do {
+          i += 8;
+        } while (i + 8 <= n && !string_special(load8(s + i)));
+        long run = i - run_start;
+        if (run) {
+          empty = false;
+          if (mode == 1) {
+            if (model_len + run > model_cap) return fail(kFallback);
+            memcpy(model_buf + model_len, s + run_start, run);
+            model_len += run;
+          } else if (mode == 2) {
+            const char* kp = (const char*)(s + run_start);
+            for (long k = 0; k < run; ++k) {
+              if (*key_len < key_cap) key_buf[(*key_len)++] = kp[k];
+              else { *key_len = key_cap + 1; break; }
+            }
+          }
+        }
+      }
+      if (i >= n) return fail(kInvalid);
+      unsigned char c = s[i];
+      if (c == '"') {
+        ++i;
+        if (out_empty) *out_empty = empty;
+        return true;
+      }
+      empty = false;
+      if (c == '\\') {
+        ++i;
+        if (i >= n) return fail(kInvalid);
+        unsigned char e = s[i++];
+        if (mode == 2 && key_escaped) *key_escaped = true;
+        unsigned long cp;
+        switch (e) {
+          case '"': cp = '"'; break;
+          case '\\': cp = '\\'; break;
+          case '/': cp = '/'; break;
+          case 'b': cp = '\b'; break;
+          case 'f': cp = '\f'; break;
+          case 'n': cp = '\n'; break;
+          case 'r': cp = '\r'; break;
+          case 't': cp = '\t'; break;
+          case 'u': {
+            if (!hex4(&cp)) return fail(kInvalid);
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+              // High surrogate: try to join with a following \uDC00-DFFF
+              // exactly like Python's scanner does.
+              if (i + 1 < n && s[i] == '\\' && s[i + 1] == 'u') {
+                long save = i;
+                i += 2;
+                unsigned long lo2;
+                if (!hex4(&lo2)) return fail(kInvalid);
+                if (lo2 >= 0xDC00 && lo2 <= 0xDFFF) {
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo2 - 0xDC00);
+                } else {
+                  i = save;  // lone high surrogate, next escape stands alone
+                  if (mode == 1) return fail(kFallback);
+                }
+              } else if (mode == 1) {
+                return fail(kFallback);  // lone surrogate in model string
+              }
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+              if (mode == 1) return fail(kFallback);  // lone low surrogate
+            }
+            break;
+          }
+          default:
+            return fail(kInvalid);
+        }
+        if (mode == 1 && !model_push_cp(cp)) return false;
+        if (mode == 2 && key_len) {
+          if (cp < 0x80 && *key_len < key_cap) key_buf[(*key_len)++] = (char)cp;
+          else if (key_escaped) *key_escaped = true;
+        }
+        continue;
+      }
+      if (c < 0x20) return fail(kInvalid);  // strict: raw control char
+      if (c < 0x80) {
+        ++i;
+        if (mode == 1) {
+          if (model_len + 1 > model_cap) return fail(kFallback);
+          model_buf[model_len++] = (char)c;
+        } else if (mode == 2) {
+          if (*key_len < key_cap) key_buf[(*key_len)++] = (char)c;
+          else *key_len = key_cap + 1;  // too long to be a watched key
+        }
+        continue;
+      }
+      ++i;  // consume the lead byte, utf8_seq consumes continuations
+      unsigned char seq[4];
+      int seq_len;
+      bool is_surrogate;
+      if (!utf8_seq(c, seq, &seq_len, &is_surrogate)) return fail(kInvalid);
+      if (mode == 1 && is_surrogate) return fail(kFallback);
+      if (mode == 1) {
+        if (model_len + seq_len > model_cap) return fail(kFallback);
+        memcpy(model_buf + model_len, seq, seq_len);
+        model_len += seq_len;
+      } else if (mode == 2) {
+        *key_len = key_cap + 1;  // non-ASCII key: never a watched key
+      }
+    }
+  }
+
+  // Parse a number token. On entry s[i] is the first char ('-' or digit).
+  // Grammar is exactly Python json's NUMBER_RE. Returns the token span;
+  // *is_plain_int true when no fraction/exponent part exists.
+  bool number_token(long* start, long* len, bool* is_plain_int) {
+    long b = i;
+    if (i < n && s[i] == '-') ++i;
+    if (i >= n) return fail(kInvalid);
+    if (s[i] == '0') {
+      ++i;
+    } else if (s[i] >= '1' && s[i] <= '9') {
+      ++i;
+      while (i < n && s[i] >= '0' && s[i] <= '9') ++i;
+    } else {
+      return fail(kInvalid);
+    }
+    bool plain = true;
+    if (i < n && s[i] == '.') {
+      plain = false;
+      ++i;
+      if (i >= n || s[i] < '0' || s[i] > '9') return fail(kInvalid);
+      while (i < n && s[i] >= '0' && s[i] <= '9') ++i;
+    }
+    if (i < n && (s[i] == 'e' || s[i] == 'E')) {
+      plain = false;
+      ++i;
+      if (i < n && (s[i] == '+' || s[i] == '-')) ++i;
+      if (i >= n || s[i] < '0' || s[i] > '9') return fail(kInvalid);
+      while (i < n && s[i] >= '0' && s[i] <= '9') ++i;
+    }
+    *start = b;
+    *len = i - b;
+    *is_plain_int = plain;
+    return true;
+  }
+
+  // Parse one value. `watch` routes extraction for watched top-level
+  // fields. Reports Python truthiness via *truthy (needed for `stream`).
+  bool value(int depth, int watch, bool* truthy) {
+    if (depth > kMaxDepth) return fail(kFallback);
+    if (i >= n) return fail(kInvalid);
+    unsigned char c = s[i];
+    bool t = true;
+
+    if (c == '"') {
+      ++i;
+      bool empty = false;
+      int mode = (watch == kWatchModel) ? 1 : 0;
+      if (!string_tail(mode, &empty, nullptr, 0, nullptr, nullptr))
+        return false;
+      t = !empty;
+      if (watch == kWatchModel) flags[kFlagHasModel] = 1;
+      else if (watch == kWatchPrompt) flags[kFlagPromptIsString] = 1;
+    } else if (c == '{') {
+      ++i;
+      long members = 0;
+      if (!object_tail(depth, &members)) return false;
+      t = members > 0;
+      if (watch == kWatchModel) flags[kFlagHasModel] = 0;
+    } else if (c == '[') {
+      ++i;
+      long elems = 0;
+      if (!array_tail(depth, &elems)) return false;
+      t = elems > 0;
+      if (watch == kWatchMessages) flags[kFlagMessagesIsList] = 1;
+    } else if (c == 't') {
+      if (!lit("true")) return fail(kInvalid);
+      t = true;
+    } else if (c == 'f') {
+      if (!lit("false")) return fail(kInvalid);
+      t = false;
+    } else if (c == 'n') {
+      if (!lit("null")) return fail(kInvalid);
+      t = false;
+    } else if (c == 'N') {
+      if (!lit("NaN")) return fail(kInvalid);
+      t = true;
+      if (watch >= kWatchCap0) {
+        caps[watch - kWatchCap0] = NAN;
+        caps_found[watch - kWatchCap0] = 1;
+      }
+    } else if (c == 'I') {
+      if (!lit("Infinity")) return fail(kInvalid);
+      if (watch >= kWatchCap0) {
+        caps[watch - kWatchCap0] = HUGE_VAL;
+        caps_found[watch - kWatchCap0] = 1;
+      }
+    } else if (c == '-' && i + 1 < n && s[i + 1] == 'I') {
+      ++i;
+      if (!lit("Infinity")) return fail(kInvalid);
+      if (watch >= kWatchCap0) {
+        caps[watch - kWatchCap0] = -HUGE_VAL;
+        caps_found[watch - kWatchCap0] = 1;
+      }
+    } else if (c == '-' || (c >= '0' && c <= '9')) {
+      long b, len;
+      bool plain;
+      if (!number_token(&b, &len, &plain)) return false;
+      if (watch == kWatchStream || watch >= kWatchCap0) {
+        if (plain) {
+          long digits = len - (s[b] == '-' ? 1 : 0);
+          if (digits > kMaxIntDigits && watch >= kWatchCap0)
+            return fail(kFallback);  // Python float(int) may OverflowError
+        }
+        char tmp[512];
+        double v;
+        if (len < (long)sizeof(tmp)) {
+          memcpy(tmp, s + b, len);
+          tmp[len] = 0;
+          v = strtod(tmp, nullptr);  // overflow -> +/-HUGE_VAL like float()
+        } else {
+          // Token longer than the stack buffer: only reachable for
+          // non-plain-int forms (huge fraction digit runs); strtod on a
+          // heap copy would be correct but the case is pathological.
+          return fail(kFallback);
+        }
+        if (watch >= kWatchCap0) {
+          caps[watch - kWatchCap0] = v;
+          caps_found[watch - kWatchCap0] = 1;
+        }
+        t = !(v == 0.0);  // NaN is truthy, -0.0 falsy — matches Python
+        if (std::isnan(v)) t = true;
+      }
+    } else {
+      return fail(kInvalid);
+    }
+
+    // Overwrite semantics for duplicate keys: the LAST occurrence decides
+    // flags, so clear per-key state the value above did not set.
+    if (watch == kWatchModel && c != '"') flags[kFlagHasModel] = 0;
+    if (watch == kWatchPrompt && c != '"') flags[kFlagPromptIsString] = 0;
+    if (watch == kWatchMessages && c != '[') flags[kFlagMessagesIsList] = 0;
+    if (watch >= kWatchCap0 && c != '-' && !(c >= '0' && c <= '9') &&
+        c != 'N' && c != 'I') {
+      caps_found[watch - kWatchCap0] = 0;
+    }
+    if (watch == kWatchStream) {
+      flags[kFlagHasStream] = 1;
+      flags[kFlagStreamTruthy] = t ? 1 : 0;
+    }
+    if (truthy) *truthy = t;
+    return true;
+  }
+
+  int watch_for_key(const char* key, long len) {
+    switch (len) {
+      case 5:
+        if (memcmp(key, "model", 5) == 0) return kWatchModel;
+        break;
+      case 6:
+        if (memcmp(key, "stream", 6) == 0) return kWatchStream;
+        if (memcmp(key, "prompt", 6) == 0) return kWatchPrompt;
+        break;
+      case 8:
+        if (memcmp(key, "messages", 8) == 0) return kWatchMessages;
+        break;
+      case 10:
+        if (memcmp(key, "max_tokens", 10) == 0) return kWatchCap0;
+        break;
+      case 21:
+        if (memcmp(key, "max_completion_tokens", 21) == 0) return kWatchCap1;
+        break;
+      case 17:
+        if (memcmp(key, "max_output_tokens", 17) == 0) return kWatchCap2;
+        break;
+    }
+    return kWatchNone;
+  }
+
+  // s[i] is past the '{'. depth is the depth OF this object (top = 1).
+  bool object_tail(int depth, long* members) {
+    skip_ws();
+    if (i < n && s[i] == '}') {
+      ++i;
+      *members = 0;
+      return true;
+    }
+    while (true) {
+      skip_ws();
+      if (i >= n || s[i] != '"') return fail(kInvalid);
+      ++i;
+      char key[32];
+      long key_len = 0;
+      bool escaped = false;
+      if (!string_tail(2, nullptr, key, (long)sizeof(key), &key_len,
+                       &escaped))
+        return false;
+      int watch = kWatchNone;
+      if (depth == 1) {
+        if (escaped) return fail(kFallback);  // {"model": ...}
+        if (key_len <= (long)sizeof(key))
+          watch = watch_for_key(key, key_len);
+      }
+      skip_ws();
+      if (i >= n || s[i] != ':') return fail(kInvalid);
+      ++i;
+      skip_ws();
+      if (!value(depth + 1, watch, nullptr)) return false;
+      ++*members;
+      skip_ws();
+      if (i >= n) return fail(kInvalid);
+      if (s[i] == ',') {
+        ++i;
+        continue;
+      }
+      if (s[i] == '}') {
+        ++i;
+        return true;
+      }
+      return fail(kInvalid);
+    }
+  }
+
+  bool array_tail(int depth, long* elems) {
+    skip_ws();
+    if (i < n && s[i] == ']') {
+      ++i;
+      *elems = 0;
+      return true;
+    }
+    while (true) {
+      skip_ws();
+      if (!value(depth + 1, kWatchNone, nullptr)) return false;
+      ++*elems;
+      skip_ws();
+      if (i >= n) return fail(kInvalid);
+      if (s[i] == ',') {
+        ++i;
+        continue;
+      }
+      if (s[i] == ']') {
+        ++i;
+        return true;
+      }
+      return fail(kInvalid);
+    }
+  }
+
+  long run() {
+    if (n == 0) return kInvalid;
+    // json.loads(bytes) runs detect_encoding first: BOMs and null-byte
+    // patterns select UTF-16/32. Reproduce the *detection* and fall back
+    // — decoding those is Python's job.
+    if (s[0] == 0xEF || s[0] == 0xFE || s[0] == 0xFF) return kFallback;
+    for (long k = 0; k < (n < 4 ? n : 4); ++k)
+      if (s[k] == 0x00) return kFallback;
+    skip_ws();
+    if (i >= n) return kInvalid;
+    bool top_obj = s[i] == '{';
+    if (!value(1, kWatchNone, nullptr)) return rc;
+    skip_ws();
+    if (i != n) {  // trailing non-whitespace: "Extra data" in Python
+      fail(kInvalid);
+      return rc;
+    }
+    flags[kFlagTopIsObject] = top_obj ? 1 : 0;
+    return rc;
+  }
+};
+
+}  // namespace
+
+namespace {
+
+// ---- needed-keys header scan ---------------------------------------------
+// The admission path reads a handful of request headers out of Envoy's
+// HeaderMap; iterating the map from Python costs ~0.5 us per header at
+// full request rate. Instead the caller serializes the HeaderMap (one
+// C-level SerializeToString) and this walker extracts only the needed
+// keys from the wire bytes: HeaderMap{ repeated HeaderValue headers = 1 }
+// with HeaderValue{ key = 1, value = 2, raw_value = 3 }. raw_value wins
+// over value when non-empty (envoy.get_header_value semantics).
+
+inline bool rd_varint(const unsigned char* p, long n, long* i,
+                      unsigned long long* out) {
+  unsigned long long v = 0;
+  int shift = 0;
+  while (*i < n && shift < 64) {
+    unsigned char b = p[(*i)++];
+    v |= (unsigned long long)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+struct NeededKeys {
+  std::string spec;  // cached spec CONTENT (pointer identity is unsafe:
+                     // a freed spec buffer can be reallocated at the
+                     // same address for a different key set)
+  std::vector<std::string> keys;
+};
+
+// Per-thread parsed-spec cache, keyed by content; the strcmp on a hit is
+// ~100 bytes and beats reparsing into vector<string> per request.
+thread_local NeededKeys g_needed;
+
+}  // namespace
+
+extern "C" {
+
+// Serialized-HeaderMap needed-keys extraction. `needed` is a '\n'-joined
+// key list (cached per spec pointer). For each header whose key exactly
+// matches a needed key, writes (needed-key index, value offset, value
+// length) into the out arrays — offsets into `buf`, raw_value preferred
+// over value when non-empty. Returns the number of matches written
+// (capped at `cap`), or -1 on malformed input (caller falls back to the
+// Python loop).
+long gie_headers_scan(const char* buf, long n, const char* needed,
+                      long* out_idx, long* out_off, long* out_len,
+                      long cap) {
+  const unsigned char* p = (const unsigned char*)buf;
+  if (strcmp(g_needed.spec.c_str(), needed) != 0) {
+    g_needed.keys.clear();
+    const char* q = needed;
+    while (*q) {
+      const char* end = strchr(q, '\n');
+      std::string key = end ? std::string(q, end - q) : std::string(q);
+      q = end ? end + 1 : q + key.size();
+      if (!key.empty()) g_needed.keys.push_back(std::move(key));
+    }
+    g_needed.spec = needed;
+  }
+  const std::vector<std::string>& keys = g_needed.keys;
+  long found = 0;
+  long i = 0;
+  while (i < n && found < cap) {
+    unsigned long long tag;
+    if (!rd_varint(p, n, &i, &tag)) return -1;
+    unsigned long long field = tag >> 3, wire = tag & 7;
+    if (field == 1 && wire == 2) {
+      unsigned long long msg_len;
+      if (!rd_varint(p, n, &i, &msg_len)) return -1;
+      if (i + (long)msg_len > n) return -1;
+      long end = i + (long)msg_len;
+      long key_off = -1, key_len = 0;
+      long val_off = -1, val_len = 0;
+      long raw_off = -1, raw_len = 0;
+      while (i < end) {
+        unsigned long long t2;
+        if (!rd_varint(p, end, &i, &t2)) return -1;
+        unsigned long long f2 = t2 >> 3, w2 = t2 & 7;
+        if (w2 == 2) {
+          unsigned long long l2;
+          if (!rd_varint(p, end, &i, &l2)) return -1;
+          if (i + (long)l2 > end) return -1;
+          if (f2 == 1) { key_off = i; key_len = (long)l2; }
+          else if (f2 == 2) { val_off = i; val_len = (long)l2; }
+          else if (f2 == 3) { raw_off = i; raw_len = (long)l2; }
+          i += (long)l2;
+        } else if (w2 == 0) {
+          unsigned long long skip;
+          if (!rd_varint(p, end, &i, &skip)) return -1;
+        } else if (w2 == 5) {
+          i += 4;
+        } else if (w2 == 1) {
+          i += 8;
+        } else {
+          return -1;
+        }
+      }
+      if (i != end) return -1;
+      if (key_off >= 0) {
+        for (size_t k = 0; k < keys.size(); ++k) {
+          const std::string& want = keys[k];
+          if ((long)want.size() == key_len &&
+              memcmp(want.data(), p + key_off, key_len) == 0) {
+            out_idx[found] = (long)k;
+            if (raw_len > 0) {
+              out_off[found] = raw_off;
+              out_len[found] = raw_len;
+            } else {
+              out_off[found] = val_off >= 0 ? val_off : 0;
+              out_len[found] = val_off >= 0 ? val_len : 0;
+            }
+            ++found;
+            break;
+          }
+        }
+      }
+    } else if (wire == 2) {
+      unsigned long long l;
+      if (!rd_varint(p, n, &i, &l)) return -1;
+      i += (long)l;
+    } else if (wire == 0) {
+      unsigned long long skip;
+      if (!rd_varint(p, n, &i, &skip)) return -1;
+    } else if (wire == 5) {
+      i += 4;
+    } else if (wire == 1) {
+      i += 8;
+    } else {
+      return -1;
+    }
+  }
+  return (i > n) ? -1 : found;
+}
+
+// One validating pass over `text` (UTF-8 JSON bytes). All scalar results
+// ride in the RETURN VALUE so the common case is exactly one FFI call
+// with no output-buffer reads:
+//   < 0         -1 json.loads would raise -> parsed None;
+//               -2 inconclusive: caller must run the real json.loads
+//   >= 0        bit 0  top_is_object
+//               bit 1  has_model (model string decoded into model_buf)
+//               bit 2  stream truthy (Python bool() of the value)
+//               bit 3  "stream" key present
+//               bit 4  prompt is a string
+//               bit 5  messages is a list
+//               bits 6-8   out_caps[k] valid (max_tokens,
+//                          max_completion_tokens, max_output_tokens —
+//                          set only when the LAST occurrence is a JSON
+//                          number; bools are not numbers, matching
+//                          Python's isinstance check)
+//               bits 16+   decoded model byte length
+long gie_json_scan(const char* text, long n, double* out_caps,
+                   char* model_buf, long model_cap) {
+  Scanner sc;
+  sc.s = (const unsigned char*)text;
+  sc.n = n;
+  sc.model_buf = model_buf;
+  sc.model_cap = model_cap;
+  sc.caps = out_caps;
+  long rc = sc.run();
+  if (rc != kOk) return rc;
+  long out = 0;
+  for (int k = 0; k < 6; ++k)
+    if (sc.flags[k]) out |= 1L << k;
+  for (int k = 0; k < 3; ++k)
+    if (sc.caps_found[k]) out |= 1L << (6 + k);
+  out |= sc.model_len << 16;
+  return out;
+}
+
+}  // extern "C"

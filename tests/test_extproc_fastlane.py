@@ -1,0 +1,617 @@
+"""Admission fast lane parity + behavior (ISSUE 5, docs/EXTPROC.md).
+
+The acceptance bar: BYTE-IDENTICAL ProcessingResponse streams between
+--extproc-fast-lane on (zero-parse scan, pooled response templates,
+needed-keys header copy) and off (the legacy full-parse path), for
+non-transcoding AND transcoding requests — headers response, body
+mutation, and dynamic metadata alike. Plus the at-most-once parse
+contract: the whole request path performs at most one json.loads, zero
+on the fast lane.
+"""
+
+import json
+
+import pytest
+
+from gie_tpu.api.modelrewrite import (
+    InferenceModelRewrite,
+    ModelMatch,
+    RewriteEngine,
+    RewriteRule,
+    TargetModel,
+)
+from gie_tpu.bbr.chain import (
+    ModelExtractorPlugin,
+    ModelRewritePlugin,
+    PluginChain,
+)
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool
+from gie_tpu.extproc import codec, pb
+from gie_tpu.extproc.server import (
+    NEEDED_REQUEST_HEADERS,
+    PickResult,
+    RoundRobinPicker,
+    StreamingServer,
+    ShedError,
+)
+from tests.test_datastore import make_pod
+from tests.test_extproc import FakeStream, body_msg, headers_msg
+
+
+def make_ds(n=3, grpc_pool=False):
+    ds = Datastore()
+    pool = EndpointPool(selector={"app": "vllm"}, target_ports=[8000],
+                        namespace="default")
+    if grpc_pool:
+        pool.app_protocol = "kubernetes.io/h2c"
+    ds.pool_set(pool)
+    for i in range(n):
+        ds.pod_update_or_add(make_pod(name=f"p{i}", ip=f"10.0.0.{i}"))
+    return ds
+
+
+class RecordingPicker(RoundRobinPicker):
+    """RoundRobin that records every PickRequest it sees."""
+
+    def __init__(self, extra_headers=None):
+        super().__init__()
+        self.requests = []
+        self.extra_headers = extra_headers
+
+    def pick(self, req, candidates):
+        self.requests.append(req)
+        result = super().pick(req, candidates)
+        if self.extra_headers:
+            result.extra_headers = dict(self.extra_headers)
+        return result
+
+
+def run_stream(server, messages):
+    stream = FakeStream(list(messages))
+    server.process(stream)
+    return stream.sent
+
+
+def both_lanes(messages, *, n=3, grpc_pool=False, chain_fn=None,
+               picker_fn=RecordingPicker):
+    """Run one scripted stream through a fast and a legacy server wired
+    identically (fresh pickers with the same deterministic sequence) and
+    return (fast_responses, legacy_responses, fast_picker, legacy_picker).
+    """
+    out = {}
+    for fast in (True, False):
+        ds = make_ds(n, grpc_pool=grpc_pool)
+        picker = picker_fn()
+        server = StreamingServer(
+            ds, picker,
+            bbr_chain=chain_fn() if chain_fn else None,
+            fast_lane=fast,
+        )
+        out[fast] = (run_stream(server, messages), picker)
+    return out[True][0], out[False][0], out[True][1], out[False][1]
+
+
+def assert_byte_identical(messages, **kw):
+    fast, legacy, pf, pl = both_lanes(messages, **kw)
+    assert len(fast) == len(legacy)
+    for i, (f, l) in enumerate(zip(fast, legacy)):
+        assert f.SerializeToString(deterministic=True) == \
+            l.SerializeToString(deterministic=True), (
+            f"response {i} differs:\nfast:   {f}\nlegacy: {l}")
+    return fast, legacy, pf, pl
+
+
+COMPLETION = json.dumps({
+    "model": "llama-3.1-8b", "prompt": "p" * 256,
+    "max_tokens": 128, "stream": False,
+}).encode()
+
+CHAT = json.dumps({
+    "model": "m-chat",
+    "messages": [{"role": "user", "content": "hello"}],
+    "max_completion_tokens": 64, "stream": True,
+}).encode()
+
+REQUEST_HEADERS = {
+    "content-type": "application/json",
+    "user-agent": "openai-python/1.40.0",
+    "cookie": "session=" + "c" * 64,
+    "x-request-id": "11111111-2222-3333-4444-555555555555",
+    "x-gateway-inference-objective": "standard",
+    "x-gateway-inference-fairness-id": "tenant-1",
+}
+
+
+def extractor_chain():
+    return PluginChain([ModelExtractorPlugin()])
+
+
+# --------------------------------------------------------------------------
+# Byte parity
+# --------------------------------------------------------------------------
+
+
+def test_parity_headers_only():
+    assert_byte_identical([headers_msg(REQUEST_HEADERS)])
+
+
+def test_parity_body_no_chain():
+    assert_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+         body_msg(COMPLETION)])
+
+
+def test_parity_body_with_extractor_chain():
+    fast, legacy, pf, pl = assert_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+         body_msg(COMPLETION)],
+        chain_fn=extractor_chain)
+    # The extracted model header must actually be present in the mutation.
+    mut = fast[0].request_headers.response.header_mutation
+    keys = {o.header.key: o.header.raw_value for o in mut.set_headers}
+    assert keys["X-Gateway-Model-Name"] == b"llama-3.1-8b"
+
+
+def test_parity_chat_body():
+    assert_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False), body_msg(CHAT)],
+        chain_fn=extractor_chain)
+
+
+def test_parity_malformed_and_empty_bodies():
+    for body in (b"not json", b"", b"[1,2,3]", b'{"model": 5}',
+                 b'{"truncated": ', b'\xff\xfe garbage'):
+        assert_byte_identical(
+            [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+             body_msg(body)],
+            chain_fn=extractor_chain)
+
+
+def test_parity_decode_tokens_header_precedence():
+    hdrs = dict(REQUEST_HEADERS)
+    hdrs["x-gateway-inference-decode-tokens"] = "99"
+    fast, legacy, pf, pl = assert_byte_identical(
+        [headers_msg(hdrs, end_of_stream=False), body_msg(COMPLETION)],
+        chain_fn=extractor_chain)
+    # The scheduler-visible hint must match too, not just the wire bytes.
+    assert pf.requests[-1].decode_tokens == pl.requests[-1].decode_tokens == 99.0
+
+
+@pytest.mark.parametrize("body,expected", [
+    (json.dumps({"max_tokens": 40}).encode(), 40.0),
+    (json.dumps({"max_tokens": 0, "max_completion_tokens": 7}).encode(), 7.0),
+    (json.dumps({"max_tokens": True, "max_output_tokens": 3}).encode(), 3.0),
+    (json.dumps({"max_tokens": -5}).encode(), 0.0),
+    (json.dumps({"max_tokens": 1e400}).encode(), 0.0),   # inf clamps to 0
+    (b'{"max_tokens": NaN, "max_output_tokens": 5}', 5.0),
+    (json.dumps({"nothing": 1}).encode(), 0.0),
+])
+def test_decode_tokens_equivalence(body, expected):
+    fast, legacy, pf, pl = assert_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False), body_msg(body)])
+    assert pf.requests[-1].decode_tokens == expected
+    assert pl.requests[-1].decode_tokens == expected
+
+
+def test_parity_rewrite_noop_stays_fast():
+    """A rewrite engine with no matching rule: the scan answers, no parse
+    happens, and output matches legacy exactly."""
+    def chain():
+        eng = RewriteEngine(seed=0)
+        eng.apply(InferenceModelRewrite(
+            name="rw", pool_ref="other-pool",
+            rules=[RewriteRule(matches=[ModelMatch("zzz")],
+                               targets=[TargetModel("never")])]))
+        return PluginChain([ModelExtractorPlugin(),
+                            ModelRewritePlugin(eng, "pool")])
+
+    assert_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+         body_msg(COMPLETION)],
+        chain_fn=chain)
+
+
+def test_parity_rewrite_applies_forces_full_parse():
+    """A firing rewrite mutates the body: the fast lane must fall back to
+    the full chain internally and still emit identical bytes (headers
+    response + CONTINUE_AND_REPLACE body chunks)."""
+    def chain():
+        eng = RewriteEngine(seed=0)
+        eng.apply(InferenceModelRewrite(
+            name="rw", pool_ref="pool",
+            rules=[RewriteRule(matches=[ModelMatch("llama-3.1-8b")],
+                               targets=[TargetModel("llama-70b")])]))
+        return PluginChain([ModelExtractorPlugin(),
+                            ModelRewritePlugin(eng, "pool")])
+
+    fast, legacy, pf, pl = assert_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+         body_msg(COMPLETION)],
+        chain_fn=chain)
+    # The mutated body really flows: a CONTINUE_AND_REPLACE body response.
+    body_resp = fast[1].request_body.response
+    assert body_resp.status == pb.CommonResponse.CONTINUE_AND_REPLACE
+    assert json.loads(body_resp.body_mutation.body)["model"] == "llama-70b"
+
+
+def test_parity_transcoding_buffered_and_streaming():
+    for body in (COMPLETION, CHAT):
+        fast, legacy, pf, pl = assert_byte_identical(
+            [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+             body_msg(body)],
+            grpc_pool=True, chain_fn=extractor_chain)
+        # The body really was reframed as a gRPC GenerateRequest.
+        mutation = fast[1].request_body.response.body_mutation.body
+        frames = list(codec.iter_frames(mutation))
+        assert len(frames) == 1
+
+
+def test_parity_transcoding_untranscodable_body_passthrough():
+    assert_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+         body_msg(b'{"no": "prompt"}')],
+        grpc_pool=True, chain_fn=extractor_chain)
+
+
+def test_parity_subset_metadata_and_steering_header():
+    md = {"envoy.lb.subset_hint":
+          {"x-gateway-destination-endpoint-subset": "10.0.0.1,10.0.0.2"}}
+    assert_byte_identical([headers_msg(REQUEST_HEADERS, metadata_struct=md)])
+    hdrs = dict(REQUEST_HEADERS)
+    hdrs["test-epp-endpoint-selection"] = "10.0.0.2:8000"
+    fast, legacy, pf, pl = assert_byte_identical([headers_msg(hdrs)])
+    mut = fast[0].request_headers.response.header_mutation
+    dest = {o.header.key: o.header.raw_value for o in mut.set_headers}
+    assert dest["x-gateway-destination-endpoint"] == b"10.0.0.2:8000"
+
+
+def test_parity_shed_and_response_phase():
+    class SheddingPicker(RecordingPicker):
+        def pick(self, req, candidates):
+            raise ShedError()
+
+    out = {}
+    for fast in (True, False):
+        server = StreamingServer(make_ds(), SheddingPicker(),
+                                 fast_lane=fast)
+        out[fast] = run_stream(server, [headers_msg(REQUEST_HEADERS)])
+    assert [r.SerializeToString(deterministic=True) for r in out[True]] == \
+        [r.SerializeToString(deterministic=True) for r in out[False]]
+    assert out[True][0].immediate_response.status.code == 429
+
+
+def test_parity_response_body_passthrough_and_sse_counting():
+    """The response phase (SSE token harvest) must behave identically,
+    including the shared pass-through response object."""
+    sse = (b'data: {"choices": [{"text": "a"}]}\n\n'
+           b'data: {"choices": [{"text": "b"}]}\n\n'
+           b'data: [DONE]\n\n')
+    messages = [
+        headers_msg(REQUEST_HEADERS, end_of_stream=False),
+        body_msg(COMPLETION),
+        pb.ProcessingRequest(response_headers=pb.HttpHeaders()),
+        pb.ProcessingRequest(response_body=pb.HttpBody(
+            body=sse, end_of_stream=True)),
+    ]
+    tokens = {}
+    for fast in (True, False):
+        seen = []
+        server = StreamingServer(
+            make_ds(), RecordingPicker(), fast_lane=fast,
+            on_response_complete=lambda ctx: seen.append(ctx.resp_tokens))
+        responses = run_stream(server, messages)
+        tokens[fast] = (seen,
+                        [r.SerializeToString(deterministic=True)
+                         for r in responses])
+    assert tokens[True] == tokens[False]
+    assert tokens[True][0] == [2]  # two data frames, [DONE] decremented
+
+
+def test_parity_picker_extra_headers_template_keysets():
+    """Different extra-header key sets interleaved: the template pool must
+    never bleed one keyset's skeleton into another's response."""
+    extras = [
+        {},
+        {"x-custom-a": "1"},
+        {"x-custom-a": "2", "x-custom-b": "zz"},
+        {},
+        {"x-custom-b": "only-b"},
+        {"x-custom-a": "3"},
+    ]
+    ds_fast, ds_legacy = make_ds(), make_ds()
+    fast_srv = StreamingServer(ds_fast, RoundRobinPicker(), fast_lane=True)
+    legacy_srv = StreamingServer(ds_legacy, RoundRobinPicker(),
+                                 fast_lane=False)
+    for extra in extras:
+        msgs = [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+                body_msg(COMPLETION)]
+        outs = []
+        for srv in (fast_srv, legacy_srv):
+            srv.picker.extra = extra  # noqa: unused — readability only
+            orig_pick = RoundRobinPicker.pick
+
+            def pick(req, candidates, _extra=extra, _srv=srv):
+                r = orig_pick(_srv.picker, req, candidates)
+                r.extra_headers = dict(_extra)
+                return r
+
+            srv.picker.pick = pick
+            outs.append(run_stream(srv, list(msgs)))
+        for f, l in zip(*outs):
+            assert f.SerializeToString(deterministic=True) == \
+                l.SerializeToString(deterministic=True)
+
+
+def test_template_pool_is_bounded():
+    from gie_tpu.extproc.server import _HeadersTemplatePool
+
+    pool = _HeadersTemplatePool(limit=4)
+    for i in range(32):
+        resp = pool.build(
+            {"x-gateway-destination-endpoint": "1.2.3.4:8000",
+             f"x-hostile-{i}": "v"},
+            "1.2.3.4:8000",
+        )
+        mut = resp.request_headers.response.header_mutation
+        assert {o.header.key for o in mut.set_headers} == {
+            "x-gateway-destination-endpoint", f"x-hostile-{i}"}
+    assert len(pool._templates) <= 4
+
+
+# --------------------------------------------------------------------------
+# At-most-once parse contract
+# --------------------------------------------------------------------------
+
+
+def count_parses(monkeypatch):
+    calls = {"n": 0}
+    real = json.loads
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(json, "loads", counting)
+    return calls
+
+
+def test_fast_lane_zero_parses(monkeypatch):
+    from gie_tpu.extproc import fieldscan
+
+    if not fieldscan.available():
+        pytest.skip("native scanner not built")
+    server = StreamingServer(make_ds(), RecordingPicker(),
+                             bbr_chain=extractor_chain(), fast_lane=True)
+    calls = count_parses(monkeypatch)
+    run_stream(server, [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+                        body_msg(COMPLETION)])
+    assert calls["n"] == 0
+
+
+def test_legacy_lane_single_parse(monkeypatch):
+    server = StreamingServer(make_ds(), RecordingPicker(),
+                             bbr_chain=extractor_chain(), fast_lane=False)
+    calls = count_parses(monkeypatch)
+    run_stream(server, [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+                        body_msg(COMPLETION)])
+    assert calls["n"] == 1
+
+
+@pytest.mark.parametrize("fast", [True, False])
+def test_transcoding_single_parse(fast, monkeypatch):
+    """The satellite fix: the gRPC-transcoding path used to json.loads the
+    SAME body twice (chain + codec). Now: exactly one parse per request on
+    either lane."""
+    server = StreamingServer(make_ds(grpc_pool=True), RecordingPicker(),
+                             bbr_chain=extractor_chain(), fast_lane=fast)
+    calls = count_parses(monkeypatch)
+    run_stream(server, [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+                        body_msg(COMPLETION)])
+    assert calls["n"] == 1
+
+
+def test_codec_accepts_prepared_parse():
+    parsed = json.loads(COMPLETION)
+    framed_a = codec.json_to_generate_request(COMPLETION)
+    framed_b = codec.json_to_generate_request(COMPLETION, parsed=parsed)
+    assert framed_a == framed_b
+
+
+def test_chain_reparse_failure_clears_current():
+    """A plugin emitting an unparsable mutation must not leave a stale
+    parsed dict visible downstream (codec would transcode bytes that no
+    longer exist)."""
+    class BreakerPlugin:
+        name = "breaker"
+
+        def execute(self, body, parsed):
+            return {}, b"\x00 not json"
+
+    headers, mutated, parsed = PluginChain(
+        [ModelExtractorPlugin(), BreakerPlugin()]
+    ).execute(COMPLETION)
+    assert mutated == b"\x00 not json"
+    assert parsed is None
+
+
+# --------------------------------------------------------------------------
+# Fast-lane behavioral specifics
+# --------------------------------------------------------------------------
+
+
+def test_needed_keys_header_filtering():
+    """Fast lane: ctx.headers carries only the needed keys; the junk the
+    pick never reads (cookies, auth, tracing) stays out. Legacy carries
+    everything. Responses are identical either way (other tests)."""
+    seen = {}
+    for fast in (True, False):
+        picker = RecordingPicker()
+        server = StreamingServer(make_ds(), picker, fast_lane=fast)
+        run_stream(server,
+                   [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+                    body_msg(COMPLETION)])
+        seen[fast] = picker.requests[-1].headers
+    assert "cookie" not in seen[True]
+    assert "user-agent" not in seen[True]
+    assert "cookie" in seen[False]
+    assert seen[True]["x-gateway-inference-objective"] == ["standard"]
+    assert seen[True]["x-gateway-inference-fairness-id"] == ["tenant-1"]
+    for key in seen[True]:
+        assert key in NEEDED_REQUEST_HEADERS
+
+
+def test_needed_headers_constructor_extension():
+    picker = RecordingPicker()
+    server = StreamingServer(make_ds(), picker, fast_lane=True,
+                             needed_headers={"x-my-picker-header"})
+    hdrs = dict(REQUEST_HEADERS)
+    hdrs["x-my-picker-header"] = "custom"
+    run_stream(server, [headers_msg(hdrs, end_of_stream=False),
+                        body_msg(COMPLETION)])
+    assert picker.requests[-1].headers["x-my-picker-header"] == ["custom"]
+
+
+def test_duplicate_needed_headers_preserved_in_order():
+    hm = pb.HeaderMap()
+    for v in ("first", "second"):
+        hm.headers.append(pb.HeaderValue(
+            key="x-gateway-inference-objective", raw_value=v.encode()))
+    hm.headers.append(pb.HeaderValue(
+        key="content-type", raw_value=b"application/json"))
+    req = pb.ProcessingRequest(request_headers=pb.HttpHeaders(
+        headers=hm, end_of_stream=False))
+    picker = RecordingPicker()
+    server = StreamingServer(make_ds(), picker, fast_lane=True)
+    run_stream(server, [req, body_msg(COMPLETION)])
+    assert picker.requests[-1].headers["x-gateway-inference-objective"] == \
+        ["first", "second"]
+
+
+def test_request_context_pool_isolation():
+    """Recycled contexts must not leak state between streams: a
+    transcoding stream followed by a plain stream on the same server."""
+    server = StreamingServer(make_ds(grpc_pool=True), RecordingPicker(),
+                             fast_lane=True)
+    for _ in range(8):
+        run_stream(server,
+                   [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+                    body_msg(CHAT),
+                    pb.ProcessingRequest(response_headers=pb.HttpHeaders()),
+                    pb.ProcessingRequest(response_body=pb.HttpBody(
+                        body=codec.frame(b"\x08\x01"), end_of_stream=True))])
+    plain_server = StreamingServer(make_ds(), RecordingPicker(),
+                                   fast_lane=True)
+    sent = run_stream(plain_server, [headers_msg(REQUEST_HEADERS)])
+    assert sent[0].request_headers.response.clear_route_cache
+
+
+def test_admission_histogram_records_by_lane():
+    from gie_tpu.runtime import metrics as own_metrics
+
+    def count(lane):
+        for m in own_metrics.ADMISSION_SECONDS.collect():
+            for s in m.samples:
+                if s.name.endswith("_count") and s.labels.get("lane") == lane:
+                    return s.value
+        return 0.0
+
+    before_fast, before_legacy = count("fast"), count("legacy")
+    for fast in (True, False):
+        server = StreamingServer(make_ds(), RecordingPicker(),
+                                 fast_lane=fast)
+        run_stream(server,
+                   [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+                    body_msg(COMPLETION)])
+    assert count("fast") == before_fast + 1
+    assert count("legacy") == before_legacy + 1
+
+
+def test_options_flag_plumbs_through():
+    import argparse
+
+    from gie_tpu.runtime.options import Options
+
+    parser = argparse.ArgumentParser()
+    Options.add_flags(parser)
+    on = Options.from_args(parser.parse_args(["--pool-name", "p"]))
+    off = Options.from_args(parser.parse_args(
+        ["--pool-name", "p", "--no-extproc-fast-lane"]))
+    assert on.extproc_fast_lane is True
+    assert off.extproc_fast_lane is False
+
+
+def test_header_scan_native_matches_python_loop():
+    """Needed-keys extraction: the native wire-walk and the Python loop
+    must see the same headers (incl. raw_value-over-value and empty
+    raw_value falling back to value)."""
+    from gie_tpu.extproc import fieldscan
+
+    if not fieldscan.available():
+        pytest.skip("native scanner not built")
+    hm = pb.HeaderMap()
+    hm.headers.append(pb.HeaderValue(key="content-type",
+                                     raw_value=b"application/json"))
+    hm.headers.append(pb.HeaderValue(key="cookie", raw_value=b"nope"))
+    hm.headers.append(pb.HeaderValue(
+        key="x-gateway-inference-objective", value="via-value-field"))
+    hm.headers.append(pb.HeaderValue(
+        key="x-gateway-inference-fairness-id", value="ignored",
+        raw_value=b"raw-wins"))
+    spec = fieldscan.HeaderSpec(NEEDED_REQUEST_HEADERS)
+    pairs = fieldscan.scan_headers(hm.SerializeToString(), spec)
+    assert pairs == [
+        ("content-type", "application/json"),
+        ("x-gateway-inference-objective", "via-value-field"),
+        ("x-gateway-inference-fairness-id", "raw-wins"),
+    ]
+
+
+def test_header_scan_spec_cache_keyed_by_content():
+    """Two different specs used back to back on one thread (server
+    re-created with different needed_headers): the native per-thread
+    parsed-spec cache must re-key on CONTENT — a freed spec buffer can be
+    reallocated at the same address for a different key set."""
+    from gie_tpu.extproc import fieldscan
+
+    if not fieldscan.headers_available():
+        pytest.skip("native scanner not built")
+    hm = pb.HeaderMap()
+    hm.headers.append(pb.HeaderValue(key="x-a", raw_value=b"va"))
+    hm.headers.append(pb.HeaderValue(key="x-b", raw_value=b"vb"))
+    raw = hm.SerializeToString()
+    for _ in range(3):  # alternate to defeat any one-entry identity cache
+        assert fieldscan.scan_headers(
+            raw, fieldscan.HeaderSpec({"x-a"})) == [("x-a", "va")]
+        assert fieldscan.scan_headers(
+            raw, fieldscan.HeaderSpec({"x-b"})) == [("x-b", "vb")]
+
+
+def test_scanless_chain_skips_the_scan_entirely(monkeypatch):
+    """A chain with a plugin lacking execute_scanned must not pay a wasted
+    body scan per request: exactly ONE parse (the chain's), zero scans."""
+    class OpaquePlugin:
+        name = "opaque"
+
+        def execute(self, body, parsed):
+            return {"x-opaque": "1"}, None
+
+    from gie_tpu.extproc import fieldscan
+
+    chain = PluginChain([ModelExtractorPlugin(), OpaquePlugin()])
+    assert not chain.supports_scan
+    scans = {"n": 0}
+    real_scan = fieldscan.scan
+
+    def counting_scan(body):
+        scans["n"] += 1
+        return real_scan(body)
+
+    monkeypatch.setattr(fieldscan, "scan", counting_scan)
+    server = StreamingServer(make_ds(), RecordingPicker(), bbr_chain=chain,
+                             fast_lane=True)
+    calls = count_parses(monkeypatch)
+    run_stream(server, [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+                        body_msg(COMPLETION)])
+    assert scans["n"] == 0
+    assert calls["n"] == 1

@@ -1,0 +1,382 @@
+"""Field-scan parity suite (ISSUE 5): the native JSON scanner must agree
+with json.loads-derived extraction on every input it claims to handle.
+
+The contract (native/jsonscan.cc): for any body where scan_native returns
+a FieldScan, that FieldScan MUST equal scan_py's (the single honest
+json.loads reference). Returning None (fallback) is always allowed — the
+caller then runs the real parse — but the plain-request corpus asserts it
+does NOT happen for normal traffic, or the fast lane silently stops being
+fast. The fuzz corpus runs regardless of the library; the native
+assertions skip when native/libgiejsonscan.so is not built (test_native.py
+pattern)."""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+import string
+
+import pytest
+
+from gie_tpu.extproc import fieldscan
+from gie_tpu.extproc.fieldscan import FieldScan, scan, scan_native, scan_py
+
+needs_native = pytest.mark.skipif(
+    not fieldscan.available(), reason="native/libgiejsonscan.so not built"
+)
+
+
+def assert_parity(body: bytes, *, require_native: bool = False):
+    """scan_native agrees with scan_py whenever it answers at all."""
+    try:
+        expected = scan_py(body)
+    except OverflowError:
+        # float(huge int) raises in Python — exactly what the legacy
+        # _decode_tokens path does. The native scanner must fall back so
+        # the fast lane crashes identically instead of silently clamping.
+        assert scan_native(body) is None
+        return None
+    got = scan_native(body)
+    if got is None:
+        assert not require_native, f"unexpected fallback for {body[:80]!r}"
+        return expected
+    assert got == expected, (
+        f"native/python divergence for {body[:120]!r}:\n"
+        f"  native: {got}\n  python: {expected}"
+    )
+    # scan() must equal the same thing through either path.
+    assert scan(body) == expected
+    return expected
+
+
+# --------------------------------------------------------------------------
+# Directed corpus
+# --------------------------------------------------------------------------
+
+
+PLAIN_BODIES = [
+    b'{"model": "llama-3.1-8b", "prompt": "hello", "max_tokens": 128}',
+    b'{"model": "m", "messages": [{"role": "user", "content": "hi"}], '
+    b'"max_completion_tokens": 512, "stream": true}',
+    b'{"prompt": "x", "max_output_tokens": 9.5}',
+    b'{"model": "", "stream": false}',
+    b'{}',
+    b'  {"model" : "spaced"}  ',
+    b'{"temperature": 0.7, "top_p": 0.9, "n": 1, "logprobs": null}',
+]
+
+
+@needs_native
+@pytest.mark.parametrize("body", PLAIN_BODIES)
+def test_plain_requests_never_fall_back(body):
+    assert_parity(body, require_native=True)
+
+
+@needs_native
+def test_extracted_fields_plain():
+    fs = scan(b'{"model": "m1", "prompt": "p", "max_tokens": 64, '
+              b'"stream": true, "messages": [1]}')
+    assert fs.valid
+    assert fs.model == "m1"
+    assert fs.stream is True
+    assert fs.prompt_is_str
+    assert fs.messages_is_list
+    assert fs.caps == (64.0, None, None)
+
+
+UNICODE_BODIES = [
+    '{"model": "ünïcodé-模型", "prompt": "日本語テキスト"}'.encode(),
+    b'{"model": "esc\\u00e9\\u6a21"}',              # \u escapes
+    b'{"model": "pair\\ud83d\\ude00end"}',          # surrogate pair
+    b'{"model": "q\\"b\\\\s\\/f\\b\\f\\n\\r\\t"}',  # every simple escape
+    '{"prompt": "' .encode() + "é".encode() * 700 + b'"}',  # long multibyte
+    b'{"model": "\\ud83d\\ude00\\ud83d\\ude01"}',   # adjacent pairs
+    '{"ключ": "значение", "model": "m"}'.encode(),  # non-ASCII keys
+]
+
+
+@needs_native
+@pytest.mark.parametrize("body", UNICODE_BODIES)
+def test_unicode_escapes(body):
+    assert_parity(body, require_native=True)
+
+
+FALLBACK_BODIES = [
+    b'{"model": "\\ud800"}',            # lone high surrogate in model
+    b'{"model": "\\udc00tail"}',        # lone low surrogate in model
+    b'{"mod\\u0065l": "escaped-key"}',  # escaped top-level key
+    b'\xef\xbb\xbf{"model": "bom"}',    # UTF-8 BOM (utf-8-sig decode)
+    '{"model": "utf16"}'.encode("utf-16-le"),
+    b'{"deep": ' + b'[' * 80 + b']' * 80 + b'}',   # past the depth cap
+    b'{"max_tokens": ' + b'9' * 400 + b'}',        # float(int) overflow
+    b'{"model": "' + b'm' * 8192 + b'"}',          # model beyond the cap
+]
+
+
+@needs_native
+@pytest.mark.parametrize("body", FALLBACK_BODIES)
+def test_hard_cases_fall_back_not_diverge(body):
+    # These MAY fall back (and today all do); they must never disagree.
+    assert_parity(body)
+
+
+@needs_native
+def test_cesu_surrogate_bytes_follow_surrogatepass():
+    # json.loads(bytes) decodes with errors='surrogatepass': raw 3-byte
+    # surrogate encodings are VALID (they become lone surrogates in the
+    # str). Outside the model string that is just a valid document; in
+    # the model string the scanner must fall back (lone-surrogate rule).
+    assert_parity(b'{"a": "\xed\xa0\x80"}', require_native=True)
+    assert_parity(b'{"prompt": "\xed\xb0\x80", "model": "ok"}',
+                  require_native=True)
+    assert_parity(b'{"model": "\xed\xa0\x80"}')  # fallback allowed
+
+
+@needs_native
+def test_lone_surrogate_outside_model_is_fine():
+    # Python keeps lone surrogates in non-model strings; validity-wise the
+    # document parses, and the scanner only needs Python semantics for the
+    # model string itself.
+    assert_parity(b'{"prompt": "\\ud800", "model": "ok"}',
+                  require_native=True)
+
+
+DUPLICATE_KEY_BODIES = [
+    b'{"model": "first", "model": "last"}',
+    b'{"model": "str", "model": 5}',            # type change: last wins
+    b'{"model": 5, "model": "str"}',
+    b'{"max_tokens": 1, "max_tokens": 2}',
+    b'{"max_tokens": 7, "max_tokens": "nan"}',  # number -> non-number
+    b'{"max_tokens": true, "max_tokens": 3}',
+    b'{"stream": true, "stream": 0}',
+    b'{"stream": 0, "stream": {"a": 1}}',
+    b'{"prompt": "s", "prompt": [1]}',
+    b'{"messages": [1], "messages": "no"}',
+]
+
+
+@needs_native
+@pytest.mark.parametrize("body", DUPLICATE_KEY_BODIES)
+def test_duplicate_keys_last_wins(body):
+    assert_parity(body, require_native=True)
+
+
+NUMBER_BODIES = [
+    b'{"max_tokens": 0}',
+    b'{"max_tokens": -1}',
+    b'{"max_tokens": -0.0}',
+    b'{"max_tokens": 1e400}',          # inf, like Python float("1e400")
+    b'{"max_tokens": -1e400}',
+    b'{"max_tokens": 1.5e-8}',
+    b'{"max_tokens": 16, "max_completion_tokens": 32, '
+    b'"max_output_tokens": 64}',
+    b'{"max_tokens": NaN}',            # allow_nan default
+    b'{"max_tokens": Infinity}',
+    b'{"max_tokens": -Infinity}',
+    b'{"a": NaN, "b": [Infinity, -Infinity]}',
+    b'{"max_tokens": 123456789012345678901234567890}',  # big but floatable
+    b'{"max_tokens": 1E+3}',
+    b'{"max_tokens": 0.5}',
+    b'{"stream": 0.0}',
+    b'{"stream": -0.0}',
+    b'{"stream": NaN}',                # NaN is truthy
+]
+
+
+@needs_native
+@pytest.mark.parametrize("body", NUMBER_BODIES)
+def test_number_semantics(body):
+    assert_parity(body, require_native=True)
+
+
+INVALID_BODIES = [
+    b'',
+    b'   ',
+    b'not json',
+    b'{"a": 1',                 # truncated object
+    b'{"a": "unterminated',     # truncated string
+    b'{"a": 1e}',               # bad exponent
+    b'{"a": 01}',               # leading zero
+    b'{"a": .5}',
+    b'{"a": 1.}',
+    b'{"a": +1}',
+    b'{"a": -}',
+    b'{"a": tru}',
+    b'{"a": 1,}',               # trailing comma
+    b'{,}',
+    b'{"a" 1}',                 # missing colon
+    b'{1: 2}',                  # non-string key
+    b'{"a": 1} trailing',
+    b'{"a": 1}{"b": 2}',
+    b'{"a": "\x01"}',           # raw control char (strict mode)
+    b'{"a": "\\x41"}',          # bad escape
+    b'{"a": "\xff\xfe"}',       # invalid UTF-8 in string
+    b'{"a": "\xc0\xaf"}',       # overlong encoding
+    b'{"a": "\xf5\x80\x80\x80"}',  # > U+10FFFF
+    b'[1, 2',                   # truncated array
+    b'"just a string"',         # valid JSON, not an object
+    b'42',
+    b'null',
+    b'true',
+]
+
+
+@needs_native
+@pytest.mark.parametrize("body", INVALID_BODIES)
+def test_invalid_and_non_object(body):
+    assert_parity(body)
+
+
+@needs_native
+def test_nested_structures_do_not_leak_into_top_level():
+    assert_parity(
+        b'{"outer": {"model": "inner", "max_tokens": 999, "stream": true},'
+        b' "list": [{"model": "deep"}, [1, [2, [3]]]],'
+        b' "model": "top"}',
+        require_native=True,
+    )
+
+
+@needs_native
+def test_large_prompt_over_1mib():
+    big = b'x' * (1024 * 1024 + 4096)
+    body = (b'{"model": "big", "prompt": "' + big
+            + b'", "max_tokens": 42, "stream": false}')
+    fs = assert_parity(body, require_native=True)
+    assert fs.valid and fs.model == "big" and fs.caps[0] == 42.0
+
+
+@needs_native
+def test_large_chat_messages():
+    msgs = [{"role": "user", "content": "y" * 4096} for _ in range(64)]
+    body = json.dumps({"model": "chat", "messages": msgs,
+                       "max_completion_tokens": 256}).encode()
+    fs = assert_parity(body, require_native=True)
+    assert fs.messages_is_list and fs.caps == (None, 256.0, None)
+
+
+@needs_native
+def test_truncations_of_a_valid_body():
+    body = json.dumps({
+        "model": "mé\U0001F600", "prompt": "p" * 100,
+        "max_tokens": 7, "stream": True, "messages": [{"a": [1, 2]}],
+    }).encode()
+    for cut in range(len(body)):
+        assert_parity(body[:cut])
+
+
+# --------------------------------------------------------------------------
+# Randomized fuzz
+# --------------------------------------------------------------------------
+
+
+def _rand_value(rng: random.Random, depth: int):
+    kind = rng.randrange(8 if depth < 3 else 6)
+    if kind == 0:
+        return rng.choice([None, True, False])
+    if kind == 1:
+        return rng.randrange(-(10 ** 6), 10 ** 6)
+    if kind == 2:
+        return rng.uniform(-1e6, 1e6)
+    if kind == 3:
+        n = rng.randrange(0, 20)
+        return "".join(rng.choice(string.printable) for _ in range(n))
+    if kind == 4:
+        return "".join(
+            chr(rng.choice([0x65, 0xE9, 0x4E2D, 0x1F600, 0x20AC]))
+            for _ in range(rng.randrange(0, 6))
+        )
+    if kind == 5:
+        return rng.choice([float("nan"), float("inf"), float("-inf"),
+                           0.0, -0.0, 1e308, -1e308])
+    if kind == 6:
+        return [_rand_value(rng, depth + 1)
+                for _ in range(rng.randrange(0, 4))]
+    return {
+        f"k{rng.randrange(6)}": _rand_value(rng, depth + 1)
+        for _ in range(rng.randrange(0, 4))
+    }
+
+
+_WATCHED = ("model", "stream", "prompt", "messages", "max_tokens",
+            "max_completion_tokens", "max_output_tokens")
+
+
+@needs_native
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_random_objects(seed):
+    rng = random.Random(0xF1E1D + seed)
+    for _ in range(150):
+        obj = {}
+        for _ in range(rng.randrange(0, 8)):
+            key = (rng.choice(_WATCHED) if rng.random() < 0.6
+                   else f"other{rng.randrange(4)}")
+            obj[key] = _rand_value(rng, 0)
+        body = json.dumps(obj, ensure_ascii=bool(rng.random() < 0.5)).encode()
+        assert_parity(body, require_native=True)
+
+
+@needs_native
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_mutated_bytes(seed):
+    """Random byte mutations of valid bodies: mostly invalid JSON — the
+    scanner must classify them exactly like json.loads (and may never
+    crash or diverge)."""
+    rng = random.Random(0xBAD + seed)
+    base = json.dumps({
+        "model": "mut", "prompt": "p" * 40, "max_tokens": 9,
+        "stream": False, "messages": [{"role": "user", "content": "c"}],
+    }).encode()
+    for _ in range(200):
+        b = bytearray(base)
+        for _ in range(rng.randrange(1, 4)):
+            op = rng.randrange(3)
+            pos = rng.randrange(len(b))
+            if op == 0:
+                b[pos] = rng.randrange(256)
+            elif op == 1:
+                del b[pos]
+            else:
+                b.insert(pos, rng.randrange(256))
+        assert_parity(bytes(b))
+
+
+# --------------------------------------------------------------------------
+# Pure-Python reference semantics (run even without the library)
+# --------------------------------------------------------------------------
+
+
+def test_scan_py_matches_parse_body_validity():
+    from gie_tpu.bbr.chain import parse_body
+
+    for body in (PLAIN_BODIES + INVALID_BODIES
+                 + [b'[1]', b'"s"', b'{"model": "m"}']):
+        assert scan_py(body).valid == (parse_body(body) is not None)
+
+
+def test_scan_py_field_rules():
+    fs = scan_py(b'{"model": 5, "stream": "s", "prompt": 1, '
+                 b'"messages": {}, "max_tokens": true}')
+    assert fs.valid
+    assert fs.model is None          # non-string model
+    assert fs.stream is True         # bool("s")
+    assert not fs.prompt_is_str
+    assert not fs.messages_is_list
+    assert fs.caps == (None, None, None)   # bool is not a number
+
+
+def test_fieldscan_equality_handles_nan():
+    a = FieldScan(True, caps=(float("nan"), None, None))
+    b = FieldScan(True, caps=(float("nan"), None, None))
+    c = FieldScan(True, caps=(1.0, None, None))
+    assert a == b and a != c
+
+
+@needs_native
+def test_scan_falls_back_to_python_transparently():
+    # A fallback-class input still yields a correct FieldScan via scan().
+    body = b'{"mod\\u0065l": "escaped"}'
+    assert scan_native(body) is None
+    assert scan(body) == scan_py(body)
+    assert scan(body).model == "escaped"

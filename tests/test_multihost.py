@@ -34,11 +34,30 @@ def test_two_process_global_train_step():
         for pid in range(2)
     ]
     outs = []
+    errs = []
     try:
         for p in procs:
             out, err = p.communicate(timeout=180)
-            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
             outs.append(out)
+            errs.append((p.returncode, err))
+            if p.returncode != 0:
+                # Fail fast: a peer waiting on the collective that will
+                # never form would block its own communicate() for the
+                # full timeout and bury this worker's stderr.
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+        # Newer jaxlib builds refuse cross-process collectives on the CPU
+        # backend outright; that is an environment capability, not a
+        # regression in the multihost wiring — the test stays live for
+        # TPU machines and older CPU stacks.
+        if any("Multiprocess computations aren't implemented on the CPU "
+               "backend" in err for _, err in errs):
+            import pytest
+
+            pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
+        for rc, err in errs:
+            assert rc == 0, f"worker failed:\n{err[-2000:]}"
     finally:
         for p in procs:
             if p.poll() is None:
