@@ -19,8 +19,10 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 WORKDIR /src
 COPY native/ native/
 # Force a rebuild: the repo tracks a host-built .so whose mtime would
-# otherwise make `make` no-op and ship a foreign-ABI binary.
-RUN make -C native clean all
+# otherwise make `make` no-op and ship a foreign-ABI binary. The asan +
+# fuzz variants build alongside so the sanitizer smoke (docs/ANALYSIS.md)
+# is reproducible in-container.
+RUN make -C native clean all asan fuzz
 
 FROM python:3.12-slim
 RUN pip install --no-cache-dir \
@@ -32,6 +34,13 @@ COPY config/ config/
 COPY --from=native-build /src/native/libgiechunker.so native/libgiechunker.so
 COPY --from=native-build /src/native/libgiepromparse.so native/libgiepromparse.so
 COPY --from=native-build /src/native/libgiejsonscan.so native/libgiejsonscan.so
+# Sanitizer smoke in-container (docs/ANALYSIS.md):
+#   docker run --entrypoint sh gie-tpu-epp -c \
+#     'python hack/fuzz_seeds.py /tmp/corpus && \
+#      native/fuzz/bin/fuzz_jsonscan -max_total_time=30 /tmp/corpus/jsonscan'
+COPY --from=native-build /src/native/fuzz/bin/ native/fuzz/bin/
+COPY hack/fuzz_seeds.py hack/fuzz_seeds.py
+COPY tests/test_fieldscan.py tests/test_fieldscan.py
 
 # Ports: ext-proc gRPC / dedicated health / prometheus metrics.
 EXPOSE 9002 9003 9090

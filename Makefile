@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native generate test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
+.PHONY: all native native-asan generate lint fuzz-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
 
 all: native generate
 
@@ -10,15 +10,37 @@ all: native generate
 native:
 	$(MAKE) -C native
 
+# Sanitizer variants of the native libraries + standalone fuzz binaries
+# (ASan/UBSan, halt on first finding — docs/ANALYSIS.md).
+native-asan:
+	$(MAKE) -C native asan fuzz
+
+# gie-lint: lock-discipline / trace-safety / blocking-in-async static
+# analysis over gie_tpu/ (docs/ANALYSIS.md). Non-zero on any violation
+# not covered by gie_tpu/lint/baseline.toml, and on stale baseline
+# entries — the baseline can only shrink.
+lint:
+	$(PY) -m gie_tpu.lint gie_tpu
+
+# Bounded ASan/UBSan fuzz pass over the three native libraries, seeded
+# from the parity-test corpora (FUZZ_SECS per library, default 30).
+FUZZ_SECS ?= 30
+fuzz-smoke: native-asan
+	$(PY) hack/fuzz_seeds.py
+	native/fuzz/bin/fuzz_jsonscan  -max_total_time=$(FUZZ_SECS) native/fuzz/corpus/jsonscan
+	native/fuzz/bin/fuzz_promparse -max_total_time=$(FUZZ_SECS) native/fuzz/corpus/promparse
+	native/fuzz/bin/fuzz_chunker   -max_total_time=$(FUZZ_SECS) native/fuzz/corpus/chunker
+
 # CRD manifests (reference `make generate`).
 generate:
 	$(PY) -m gie_tpu.api.crdgen config/crd/bases
 
 # Full test tier: unit + conformance on the virtual 8-device CPU mesh.
-test:
+# Lint gates the suite: a hierarchy violation fails before pytest runs.
+test: lint
 	$(PY) -m pytest tests/ -q
 
-test-unit:
+test-unit: lint
 	$(PY) -m pytest tests/ -q --ignore=tests/test_conformance.py
 
 # Conformance suite with report emission (reference `go test ./conformance`).

@@ -25,7 +25,6 @@ from __future__ import annotations
 import ctypes
 import json
 import math
-import os
 import threading
 from typing import Optional
 
@@ -95,11 +94,9 @@ _INVALID = FieldScan(valid=False)
 
 
 def _load_native():
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-        "native",
-        "libgiejsonscan.so",
-    )
+    from gie_tpu.utils.nativelib import native_lib_path
+
+    path = native_lib_path("giejsonscan")
     try:
         lib = ctypes.CDLL(path)
         fn = lib.gie_json_scan

@@ -1,0 +1,33 @@
+"""gie-lint: repo-native static analyzers for the concurrency and
+trace-safety rules this codebase actually depends on.
+
+Every concurrency bug shipped so far (pick-lock held across a D2H copy in
+``Scheduler.export_state``, the stale-parsed-dict reuse in PluginChain)
+was caught by manual review. Before the multi-core ext-proc workers and
+the mesh-sharded pick cycle multiply the thread and FFI surface
+(ROADMAP items 1-2), the invariants move into tooling:
+
+``locks``      lock-discipline analyzer — acquisition order against the
+               declared hierarchy in ``lockorder.toml``, plus
+               blocking-while-locked (I/O, json, sleeps, subprocess, JAX
+               D2H syncs inside a ``with lock:`` body).
+``tracesafe``  JAX trace-safety — import-time device constants (the
+               80x-dispatch landmine), host syncs and Python side
+               effects inside jit-traced code, host-sync calls in
+               production modules.
+``asynclint``  blocking calls inside ``async def`` event-loop code
+               (the ext-proc/runner loops are sync today; this rule
+               keeps the first async code honest).
+``dynamic``    instrumented lock wrapper: records REAL acquisition
+               orders under tests and asserts them against the same
+               declared hierarchy the static layer enforces.
+
+Run as ``make lint`` / ``python -m gie_tpu.lint``; pinned by
+tests/test_lint.py. Findings that predate the rules live in
+``baseline.toml`` — every entry carries a justification and must still
+match a real finding (stale entries fail the build), so the baseline
+can only shrink. See docs/ANALYSIS.md for the rule catalog.
+"""
+
+from gie_tpu.lint.model import RepoIndex, Violation  # noqa: F401
+from gie_tpu.lint.runner import run_paths  # noqa: F401

@@ -1,0 +1,288 @@
+"""JAX trace-safety analyzer (rules GT001-GT003).
+
+GT001  import-time device constant: a jnp array constructor or jax
+       device query executes at module import (module body, class body,
+       or function default). This generalizes the axon 80x-dispatch
+       landmine guard (tests/test_no_module_level_device_constants.py):
+       a jitted program closing over an import-time device array
+       dispatches ~80x slower on this TPU backend, and import-time
+       ``jax.devices()``-style queries force backend initialization
+       before the runner has configured the platform.
+
+GT002  host sync / Python side effect inside jit-traced code: within a
+       function that is jitted (decorator, ``jax.jit(f)`` assignment or
+       call) or reachable from one through the resolved call graph —
+       ``float()/int()/bool()`` on a non-constant (implicit D2H sync on
+       a tracer), ``.item()/.tolist()``, ``jax.device_get``,
+       ``.block_until_ready()``, ``numpy.asarray/array`` on traced
+       values, ``print()`` (trace-time side effect — use
+       ``jax.debug.print``), and wall-clock reads (``time.*`` — baked
+       into the trace as a constant).
+
+GT003  explicit host sync in production code: ``.block_until_ready()``
+       / ``jax.block_until_ready`` belong in benches and tests; inside
+       ``gie_tpu/`` they serialize the dispatch pipeline the scheduler
+       exists to keep full. Allowlist via ``[tracesafe] allow_files``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from gie_tpu.lint.blocking import body_nodes
+from gie_tpu.lint.model import (
+    FunctionInfo, RepoIndex, Violation, dotted_name)
+
+# Import-time device-array constructors / backend queries. jnp.* is
+# matched by alias; these are matched after import resolution.
+_IMPORT_TIME_BAD = (
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.device_put", "jax.default_backend",
+)
+
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NUMPY_PULLS = {"numpy.asarray", "numpy.array", "numpy.copy"}
+_CLOCK_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.time_ns", "time.monotonic_ns",
+}
+# Static-shape reads that make float()/int() legitimate inside a trace.
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _jnp_aliases(mi) -> set[str]:
+    """Aliases under which jax.numpy is reachable in this module."""
+    out = set()
+    for alias, target in mi.imports.items():
+        if target == "jax.numpy":
+            out.add(alias)
+        if target == "jax":
+            out.add(f"{alias}.numpy")
+    for name, target in mi.from_names.items():
+        if target == "jax.numpy":
+            out.add(name)
+    return out
+
+
+def _call_targets_jnp(value: ast.AST, aliases: set[str]) -> bool:
+    for call in ast.walk(value):
+        if not isinstance(call, ast.Call):
+            continue
+        dn = dotted_name(call.func)
+        if dn is None:
+            continue
+        head, _, _rest = dn.rpartition(".")
+        if head and head in aliases:
+            return True
+    return False
+
+
+def _import_time_values(tree: ast.Module):
+    """(description, value-node) pairs evaluated at import time."""
+    def from_body(body, where):
+        for node in body:
+            if isinstance(node, ast.Assign):
+                names = ", ".join(ast.unparse(t) for t in node.targets)
+                yield f"{where}{names}", node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                yield f"{where}{ast.unparse(node.target)}", node.value
+            elif isinstance(node, ast.ClassDef):
+                yield from from_body(node.body, f"{where}{node.name}.")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None
+                ]:
+                    yield f"{where}{node.name}(default)", d
+
+    yield from from_body(tree.body, "")
+
+
+def _mark_jitted(index: RepoIndex) -> None:
+    """Set fi.jit_chain on every function that is jitted or reachable
+    from a jitted function via the resolved call graph."""
+    for fi in index.all_functions():
+        fi.jit_chain = None  # type: ignore[attr-defined]
+
+    def resolve_jit_factory(mi, call: ast.Call) -> bool:
+        dn = dotted_name(call.func)
+        if dn is None:
+            return False
+        resolved = index._resolve_dotted_import(mi, dn) or dn
+        if resolved in ("jax.jit", "jax.pmap"):
+            return True
+        # functools.partial(jax.jit, ...) / partial(jax.jit, ...)
+        if resolved in ("functools.partial", "partial") and call.args:
+            inner = dotted_name(call.args[0])
+            if inner:
+                r = index._resolve_dotted_import(mi, inner) or inner
+                return r in ("jax.jit", "jax.pmap")
+        return False
+
+    roots = []
+    for mi in index.modules.values():
+        # Decorated functions/methods.
+        for fi in list(mi.functions.values()) + [
+            m for c in mi.classes.values() for m in c.methods.values()
+        ]:
+            for dec in getattr(fi.node, "decorator_list", []):
+                hit = False
+                if isinstance(dec, ast.Call):
+                    hit = resolve_jit_factory(mi, dec)
+                else:
+                    dn = dotted_name(dec)
+                    if dn:
+                        r = index._resolve_dotted_import(mi, dn) or dn
+                        hit = r in ("jax.jit", "jax.pmap")
+                if hit:
+                    roots.append(fi)
+                    break
+        # jax.jit(f) call sites anywhere in the module: mark f when it
+        # resolves to an in-tree function.
+        for fi in list(mi.functions.values()) + [
+            m for c in mi.classes.values() for m in c.methods.values()
+        ]:
+            for cs in fi.calls.values():
+                call = cs.node
+                if not (isinstance(call.func, (ast.Name, ast.Attribute))
+                        and resolve_jit_factory(mi, call)):
+                    continue
+                for arg in call.args[:1]:
+                    target = _resolve_func_ref(index, fi, arg)
+                    if target is not None:
+                        roots.append(target)
+    for fi in roots:
+        fi.jit_chain = "jitted here"
+    # Propagate reachability with the originating chain.
+    changed = True
+    while changed:
+        changed = False
+        for fi in index.all_functions():
+            if fi.jit_chain is None:
+                continue
+            for cs in fi.calls.values():
+                t = cs.target
+                if t is not None and t.jit_chain is None:
+                    t.jit_chain = f"called from jit via {fi.where}"
+                    changed = True
+
+
+def _resolve_func_ref(index: RepoIndex, fi: FunctionInfo,
+                      expr: ast.expr) -> Optional[FunctionInfo]:
+    if isinstance(expr, ast.Name):
+        mi = fi.module
+        if expr.id in mi.functions:
+            return mi.functions[expr.id]
+        return None
+    if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name) and expr.value.id == "self":
+        if fi.cls is not None:
+            return fi.cls.find_method(expr.attr)
+    return None
+
+
+def _is_static_arg(arg: ast.expr) -> bool:
+    """float(x) is trace-safe when x is a literal or a static property
+    (shape/ndim/len) rather than a traced value."""
+    if isinstance(arg, ast.Constant):
+        return True
+    if isinstance(arg, ast.UnaryOp):
+        return _is_static_arg(arg.operand)
+    if isinstance(arg, ast.BinOp):
+        return _is_static_arg(arg.left) and _is_static_arg(arg.right)
+    if isinstance(arg, ast.Call):
+        dn = dotted_name(arg.func)
+        return dn == "len"
+    if isinstance(arg, ast.Subscript):
+        return _is_static_arg(arg.value)
+    if isinstance(arg, ast.Attribute):
+        if arg.attr in _STATIC_ATTRS:
+            return True
+        return False
+    return False
+
+
+def run(index: RepoIndex, cfg: dict) -> list[Violation]:
+    out: list[Violation] = []
+    tcfg = cfg.get("tracesafe", {})
+    allow_files = set(tcfg.get("allow_files", []))
+
+    # GT001 — import-time device constants.
+    for mi in index.modules.values():
+        aliases = _jnp_aliases(mi)
+        for desc, value in _import_time_values(mi.tree):
+            hit = aliases and _call_targets_jnp(value, aliases)
+            if not hit:
+                for call in ast.walk(value):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    dn = dotted_name(call.func)
+                    if dn is None:
+                        continue
+                    r = index._resolve_dotted_import(mi, dn) or dn
+                    if r in _IMPORT_TIME_BAD:
+                        hit = True
+                        break
+            if hit:
+                out.append(Violation(
+                    "GT001", mi.file, value.lineno, desc or "<module>",
+                    "device array/backend query at import time — jitted "
+                    "code closing over an import-time device constant "
+                    "dispatches ~80x slower on the axon backend; build "
+                    "it lazily or use numpy"))
+
+    # GT002 — host syncs / side effects in jit-traced code.
+    _mark_jitted(index)
+    for fi in index.all_functions():
+        chain = getattr(fi, "jit_chain", None)
+        if chain is None:
+            continue
+        origin = "" if chain == "jitted here" else f" ({chain})"
+        for node in body_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cs = fi.calls.get(id(node))
+            msg = None
+            if cs is not None and cs.ext is not None:
+                if cs.ext in ("float", "int", "bool"):
+                    if node.args and not _is_static_arg(node.args[0]):
+                        msg = (f"{cs.ext}() on a traced value forces a "
+                               f"host sync (implicit D2H)")
+                elif cs.ext == "print":
+                    msg = ("print() inside traced code runs at TRACE "
+                           "time only — use jax.debug.print")
+                elif cs.ext in _NUMPY_PULLS:
+                    msg = (f"{cs.ext}() inside traced code pulls the "
+                           f"value to host (D2H sync)")
+                elif cs.ext in _CLOCK_CALLS:
+                    msg = (f"{cs.ext}() inside traced code is baked "
+                           f"into the compiled program as a constant")
+                elif cs.ext == "jax.device_get":
+                    msg = "jax.device_get inside traced code (D2H sync)"
+            if msg is None and cs is not None and cs.method is not None:
+                if cs.method in _HOST_SYNC_METHODS:
+                    msg = (f".{cs.method}() inside traced code forces a "
+                           f"host sync")
+            if msg is not None:
+                out.append(Violation(
+                    "GT002", fi.module.file, node.lineno, fi.qualname,
+                    msg + origin))
+
+    # GT003 — explicit host syncs in production modules.
+    for fi in index.all_functions():
+        if fi.module.file in allow_files:
+            continue
+        if getattr(fi, "jit_chain", None) is not None:
+            continue  # already covered (and attributed) by GT002
+        for cs in fi.calls.values():
+            hit = (cs.ext == "jax.block_until_ready"
+                   or (cs.ext or "").endswith(".block_until_ready")
+                   or cs.method == "block_until_ready")
+            if hit:
+                out.append(Violation(
+                    "GT003", fi.module.file, cs.node.lineno, fi.qualname,
+                    "block_until_ready() in production code serializes "
+                    "the dispatch pipeline — it belongs in bench/test "
+                    "paths (allowlist in lockorder.toml [tracesafe] if "
+                    "intentional)"))
+    return out

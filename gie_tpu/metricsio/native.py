@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import ctypes
 import functools
-import os
 import threading
 from typing import Optional, Union
 
@@ -26,11 +25,9 @@ from gie_tpu.metricsio.mappings import LabeledGauge, ServerMapping
 
 
 def _load_native():
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-        "native",
-        "libgiepromparse.so",
-    )
+    from gie_tpu.utils.nativelib import native_lib_path
+
+    path = native_lib_path("giepromparse")
     try:
         lib = ctypes.CDLL(path)
         fn = lib.gie_prom_extract

@@ -136,7 +136,6 @@ class ExtProcServerRunner:
             workers=opts.scrape_workers or None,
         )
         self.datastore = Datastore(on_slot_reclaimed=self._slot_reclaimed)
-        self._attach_lock = threading.Lock()
         self._overflow_logged = 0
         self.picker = BatchingTPUPicker(
             self.scheduler,

@@ -16,7 +16,6 @@ Hash 0 is reserved for "empty table slot" and remapped to 1.
 from __future__ import annotations
 
 import ctypes
-import os
 import zlib
 
 import numpy as np
@@ -25,11 +24,9 @@ from gie_tpu.sched import constants as C
 
 
 def _load_native():
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-        "native",
-        "libgiechunker.so",
-    )
+    from gie_tpu.utils.nativelib import native_lib_path
+
+    path = native_lib_path("giechunker")
     try:
         lib = ctypes.CDLL(path)
         fn = lib.gie_chunk_hashes_batch

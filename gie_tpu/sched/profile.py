@@ -825,8 +825,10 @@ class Scheduler:
             # (events arrived before its first pick) — grow now so its
             # presence bits have somewhere to land.
             state = self._resize(state, m=m_bucket_for(slot + 1))
+        # Both callers (apply_prefix_events, commit_install's journal
+        # replay) hand in uint32 host arrays already — no conversion here,
+        # this runs under the pick lock.
         for hashes, remove in ((stored, False), (removed, True)):
-            hashes = np.asarray(hashes, np.uint32)
             for start in range(0, len(hashes), self._EVENT_BUCKETS[-1]):
                 part = hashes[start:start + self._EVENT_BUCKETS[-1]]
                 bucket = next(
@@ -882,8 +884,15 @@ class Scheduler:
             self.state = self._clear_prefix(self.state, jnp.int32(slot))
 
     def snapshot_assumed_load(self) -> np.ndarray:
+        """Host copy of the assumed-load vector. Same discipline as
+        export_state: the lock covers only a donation-safe DEVICE copy
+        (the live buffer is deleted by the next pick's donation; the
+        copy's is not), and the D2H sync runs outside it — this is on
+        the metrics-exposition and autoscale-probe paths, which must not
+        stall the pick hot path for a transfer (gie-lint GL002)."""
         with self._lock:
-            return np.asarray(self.state.assumed_load)
+            load = jnp.copy(self.state.assumed_load)
+        return np.asarray(load)
 
     # -- optional warm-restart persistence ---------------------------------
     # The reference explicitly accepts prefix-index loss on restart

@@ -1,0 +1,127 @@
+"""Export seed corpora for the native fuzz harnesses (native/fuzz/).
+
+The jsonscan corpus is lifted verbatim from tests/test_fieldscan.py's
+directed corpora (the same bodies the parity suite pins against
+json.loads), the promparse corpus from production-shaped exposition
+samples (including the 0xFE spec||text split the harness understands),
+and the chunker corpus from prompt-like byte blobs sized around the
+header scheme fuzz_chunker.cc decodes. Run from the repo root:
+
+    python hack/fuzz_seeds.py [out_dir]   # default native/fuzz/corpus
+
+`make fuzz-smoke` runs this automatically before the harnesses.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Module-level directed corpora exported from the fieldscan parity suite.
+_FIELDSCAN_LISTS = (
+    "PLAIN_BODIES",
+    "UNICODE_BODIES",
+    "FALLBACK_BODIES",
+    "DUPLICATE_KEY_BODIES",
+    "NUMBER_BODIES",
+    "INVALID_BODIES",
+)
+
+PROMPARSE_SEEDS = [
+    # Production vLLM exposition under the default query spec.
+    b"# HELP vllm:num_requests_waiting x\n"
+    b"# TYPE vllm:num_requests_waiting gauge\n"
+    b"vllm:num_requests_waiting 7\n"
+    b"vllm:num_requests_running 3 1700000000000\n"
+    b"vllm:kv_cache_usage_perc 0.42\n"
+    b'unrelated_metric{a="b"} 9\n',
+    b'vllm:cache_config_info{block_size="16",num_gpu_blocks="2048"} 1\n'
+    b"vllm:num_requests_waiting 0\n"
+    b"vllm:num_requests_running 0\n"
+    b"vllm:kv_cache_usage_perc 0\n",
+    b'vllm:num_requests_waiting{engine="a\\"b\\\\c",zone="x"} 5\n'
+    b"vllm:num_requests_running 1\n"
+    b"vllm:kv_cache_usage_perc 0.5\n",
+    b"vllm:kv_cache_usage_perc +Inf\n"
+    b"vllm:num_requests_waiting -Inf\n"
+    b"vllm:num_requests_running NaN\n",
+    b'vllm:lora_requests_info{running_lora_adapters="a,b",'
+    b'max_lora="4",waiting_lora_adapters=""} 1.0 99\n'
+    b'vllm:lora_requests_info{running_lora_adapters="c",'
+    b'max_lora="4",waiting_lora_adapters="d"} 1.0 100\n'
+    b"vllm:num_requests_running 2\n",
+    # Custom spec segment before the 0xFE separator: both grammars fuzz.
+    b"metric_a\nmetric_b|l=v|vl\xfemetric_a 1\nmetric_b{l=\"v\",vl=\"3\"} 1\n",
+    b"\xfe",      # empty spec, empty text
+    b"",          # default spec, empty text
+    b"vllm:num_requests_waiting 1e309\n",  # overflow-to-inf value path
+]
+
+CHUNKER_SEEDS = [
+    # 3-byte header (n_prompts/chunk_bytes/max_chunks) + weights + body.
+    bytes([0, 15, 8]) + bytes([1]) + b"The quick brown fox " * 8,
+    bytes([3, 63, 32]) + bytes([1, 2, 3, 4]) + bytes(range(256)) * 3,
+    bytes([1, 0, 0]) + bytes([7, 9]) + b"\x00" * 129,  # max_chunks=0 legal
+    bytes([2, 95, 16]) + bytes([255, 0, 128]) + b"abc" * 211,
+    bytes([0, 1, 32]) + bytes([1]),  # empty body
+]
+
+
+def _load_fieldscan_bodies() -> list[bytes]:
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)  # test module imports gie_tpu
+    try:
+        import pytest  # noqa: F401
+    except ImportError:
+        # Runtime container: no pytest. The corpora are plain
+        # module-level byte lists; a decorator-absorbing stub is enough
+        # to import them.
+        import types
+        import unittest.mock as mock
+        stub = types.ModuleType("pytest")
+        stub.mark = mock.MagicMock()
+        sys.modules["pytest"] = stub
+    path = os.path.join(REPO, "tests", "test_fieldscan.py")
+    spec = importlib.util.spec_from_file_location("_fieldscan_corpus", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bodies: list[bytes] = []
+    for name in _FIELDSCAN_LISTS:
+        bodies.extend(getattr(mod, name))
+    return bodies
+
+
+def _write(out_dir: str, name: str, seeds: list[bytes]) -> int:
+    d = os.path.join(out_dir, name)
+    os.makedirs(d, exist_ok=True)
+    for i, blob in enumerate(seeds):
+        with open(os.path.join(d, f"seed_{i:03d}"), "wb") as f:
+            f.write(blob)
+    return len(seeds)
+
+
+def main(argv: list[str]) -> int:
+    out_dir = argv[1] if len(argv) > 1 else os.path.join(
+        REPO, "native", "fuzz", "corpus")
+    json_seeds = _load_fieldscan_bodies()
+    # jsonscan also doubles as the headers_scan input; add a serialized
+    # HeaderMap-shaped blob so the varint walker starts from valid bytes.
+    json_seeds = list(json_seeds) + [
+        b"\n\x1a\n\x0ccontent-type\x12\x10application/json"
+        b"\n\x14\n\x05:path\x12\x0b/v1/generate",
+    ]
+    counts = {
+        "jsonscan": _write(out_dir, "jsonscan", json_seeds),
+        "promparse": _write(out_dir, "promparse", PROMPARSE_SEEDS),
+        "chunker": _write(out_dir, "chunker", CHUNKER_SEEDS),
+    }
+    for name, n in sorted(counts.items()):
+        print(f"fuzz_seeds: {n:3d} seed(s) -> {out_dir}/{name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

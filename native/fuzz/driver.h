@@ -1,0 +1,192 @@
+// Standalone fuzz driver for the gie-tpu native libraries.
+//
+// The container toolchain is g++ (no clang/libFuzzer), so each harness
+// defines the libFuzzer entry point LLVMFuzzerTestOneInput and this
+// header supplies a main() that reproduces the libFuzzer workflow:
+//
+//   fuzz_jsonscan [-max_total_time=S] [-runs=N] [-seed=N] corpus_dir...
+//
+//   1. every file in the corpus dirs runs once (regression pass);
+//   2. a deterministic xorshift-driven mutation loop (bit flips, byte
+//      sets, truncations, insertions, block duplication, two-seed
+//      splices) runs until the time or run budget is exhausted.
+//
+// Built with -fsanitize=address,undefined -fno-sanitize-recover=all, a
+// finding aborts the process non-zero — exactly what `make fuzz-smoke`
+// and tests/test_fuzz_smoke.py treat as failure. With a clang
+// toolchain, compile the harness with -fsanitize=fuzzer and WITHOUT
+// -DGIE_STANDALONE_FUZZ to get the real coverage-guided loop; the
+// harness source is identical.
+
+#ifndef GIE_FUZZ_DRIVER_H_
+#define GIE_FUZZ_DRIVER_H_
+
+#include <dirent.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <time.h>
+
+#include <string>
+#include <vector>
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size);
+
+#ifdef GIE_STANDALONE_FUZZ
+
+namespace gie_fuzz {
+
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 0x9e3779b97f4a7c15ULL) {}
+  uint64_t next() {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return s;
+  }
+  size_t below(size_t n) { return n ? (size_t)(next() % n) : 0; }
+};
+
+inline void load_file(const char* path,
+                      std::vector<std::vector<uint8_t>>* corpus) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return;
+  std::vector<uint8_t> buf;
+  uint8_t chunk[4096];
+  size_t n;
+  while ((n = fread(chunk, 1, sizeof chunk, f)) > 0)
+    buf.insert(buf.end(), chunk, chunk + n);
+  fclose(f);
+  corpus->push_back(std::move(buf));
+}
+
+inline void load_path(const char* path,
+                      std::vector<std::vector<uint8_t>>* corpus) {
+  struct stat st;
+  if (stat(path, &st) != 0) {
+    fprintf(stderr, "fuzz: missing corpus path %s (run "
+                    "`python hack/fuzz_seeds.py` first)\n", path);
+    return;
+  }
+  if (!S_ISDIR(st.st_mode)) {
+    load_file(path, corpus);
+    return;
+  }
+  DIR* d = opendir(path);
+  if (!d) return;
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    if (e->d_name[0] == '.') continue;
+    std::string full = std::string(path) + "/" + e->d_name;
+    if (stat(full.c_str(), &st) == 0 && S_ISREG(st.st_mode))
+      load_file(full.c_str(), corpus);
+  }
+  closedir(d);
+}
+
+inline std::vector<uint8_t> mutate(
+    const std::vector<std::vector<uint8_t>>& corpus, Rng* rng) {
+  std::vector<uint8_t> out = corpus[rng->below(corpus.size())];
+  int rounds = 1 + (int)rng->below(8);
+  for (int r = 0; r < rounds; ++r) {
+    switch (rng->below(7)) {
+      case 0:  // bit flip
+        if (!out.empty())
+          out[rng->below(out.size())] ^= (uint8_t)(1u << rng->below(8));
+        break;
+      case 1:  // random byte
+        if (!out.empty())
+          out[rng->below(out.size())] = (uint8_t)rng->next();
+        break;
+      case 2:  // truncate
+        if (!out.empty()) out.resize(rng->below(out.size()));
+        break;
+      case 3: {  // insert a byte
+        size_t pos = rng->below(out.size() + 1);
+        out.insert(out.begin() + pos, (uint8_t)rng->next());
+        break;
+      }
+      case 4: {  // duplicate a block
+        if (out.empty() || out.size() > (1u << 20)) break;
+        size_t a = rng->below(out.size());
+        size_t len = rng->below(out.size() - a) % 64 + 1;
+        std::vector<uint8_t> block(out.begin() + a,
+                                   out.begin() + a + len);
+        out.insert(out.begin() + rng->below(out.size() + 1),
+                   block.begin(), block.end());
+        break;
+      }
+      case 5: {  // splice with another seed
+        const std::vector<uint8_t>& other =
+            corpus[rng->below(corpus.size())];
+        if (other.empty()) break;
+        size_t cut_a = rng->below(out.size() + 1);
+        size_t cut_b = rng->below(other.size());
+        out.resize(cut_a);
+        out.insert(out.end(), other.begin() + cut_b, other.end());
+        break;
+      }
+      case 6: {  // interesting magic bytes
+        static const char magics[] =
+            "\"{}[]\\u0000:,0eE.+-\x80\xc0\xed\xf4\n";
+        if (!out.empty())
+          out[rng->below(out.size())] =
+              (uint8_t)magics[rng->below(sizeof magics - 1)];
+        break;
+      }
+    }
+  }
+  return out;
+}
+
+}  // namespace gie_fuzz
+
+int main(int argc, char** argv) {
+  double max_total_time = 30.0;
+  long long runs = -1;
+  uint64_t seed = 1;
+  std::vector<std::vector<uint8_t>> corpus;
+  for (int i = 1; i < argc; ++i) {
+    if (strncmp(argv[i], "-max_total_time=", 16) == 0)
+      max_total_time = atof(argv[i] + 16);
+    else if (strncmp(argv[i], "-runs=", 6) == 0)
+      runs = atoll(argv[i] + 6);
+    else if (strncmp(argv[i], "-seed=", 6) == 0)
+      seed = (uint64_t)atoll(argv[i] + 6);
+    else if (argv[i][0] == '-')
+      fprintf(stderr, "fuzz: ignoring unknown flag %s\n", argv[i]);
+    else
+      gie_fuzz::load_path(argv[i], &corpus);
+  }
+  fprintf(stderr, "fuzz: %zu seed(s), budget %.0fs\n",
+          corpus.size(), max_total_time);
+  // Regression pass over the seeds themselves.
+  for (const auto& s : corpus)
+    LLVMFuzzerTestOneInput(s.data(), s.size());
+  if (corpus.empty())
+    corpus.push_back(std::vector<uint8_t>());  // fuzz from scratch
+  gie_fuzz::Rng rng(seed);
+  struct timespec t0, now;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  long long done = 0;
+  for (;;) {
+    if (runs >= 0 && done >= runs) break;
+    if ((done & 0x3ff) == 0) {
+      clock_gettime(CLOCK_MONOTONIC, &now);
+      double elapsed = (double)(now.tv_sec - t0.tv_sec) +
+                       (double)(now.tv_nsec - t0.tv_nsec) * 1e-9;
+      if (elapsed >= max_total_time) break;
+    }
+    std::vector<uint8_t> input = gie_fuzz::mutate(corpus, &rng);
+    LLVMFuzzerTestOneInput(input.data(), input.size());
+    ++done;
+  }
+  fprintf(stderr, "fuzz: %lld run(s), no findings\n", done);
+  return 0;
+}
+
+#endif  // GIE_STANDALONE_FUZZ
+#endif  // GIE_FUZZ_DRIVER_H_

@@ -1,0 +1,77 @@
+// Fuzz harness for native/chunker.cc (gie_chunk_hashes_batch).
+//
+// The chunker's contract is trusted-caller (hashing.py builds the
+// offsets), so the harness fuzzes DATA and the size parameters while
+// always constructing a contract-valid offsets table: the first three
+// input bytes pick n_prompts / chunk_bytes / max_chunks, the next
+// n_prompts bytes pick the split proportions, and the remainder is the
+// concatenated prompt bytes. Asserts pin the out_counts bound and the
+// zero-padding + hash-never-zero invariants the prefix index relies on
+// (a 0 hash means "empty lane" on the device table).
+
+#include <assert.h>
+#include <stdint.h>
+
+#include <algorithm>
+#include <vector>
+
+#include "driver.h"
+
+extern "C" void gie_chunk_hashes_batch(
+    const uint8_t* data, const int64_t* offsets, int n_prompts,
+    int chunk_bytes, int max_chunks, uint32_t* out_hashes,
+    int32_t* out_counts);
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  if (size < 4) return 0;
+  int n_prompts = 1 + data[0] % 4;
+  int chunk_bytes = 1 + data[1] % 96;
+  int max_chunks = data[2] % 33;  // 0 is legal: hash nothing
+  size_t header = 3 + (size_t)n_prompts;
+  if (size < header) return 0;
+  const uint8_t* body = data + header;
+  int64_t body_len = (int64_t)(size - header);
+
+  // Contract-valid ascending offsets over the body, split proportionally
+  // to the per-prompt header bytes.
+  std::vector<int64_t> offsets(n_prompts + 1);
+  offsets[0] = 0;
+  int64_t pos = 0;
+  int weight_total = 0;
+  for (int p = 0; p < n_prompts; ++p) weight_total += data[3 + p] + 1;
+  int64_t remaining = body_len;
+  for (int p = 0; p < n_prompts; ++p) {
+    int64_t share = (p == n_prompts - 1)
+        ? remaining
+        : body_len * (data[3 + p] + 1) / weight_total;
+    if (share > remaining) share = remaining;
+    pos += share;
+    remaining -= share;
+    offsets[p + 1] = pos;
+  }
+  offsets[n_prompts] = body_len;
+
+  // Exact-size buffer so ASan catches a one-past-the-end write; the
+  // max() only covers max_chunks==0, where .data() of an empty vector
+  // would be null.
+  std::vector<uint32_t> hashes(
+      std::max<size_t>((size_t)n_prompts * max_chunks, 1));
+  std::vector<int32_t> counts(n_prompts);
+  gie_chunk_hashes_batch(body, offsets.data(), n_prompts, chunk_bytes,
+                         max_chunks, hashes.data(), counts.data());
+  for (int p = 0; p < n_prompts; ++p) {
+    assert(counts[p] >= 0 && counts[p] <= max_chunks);
+    int64_t plen = offsets[p + 1] - offsets[p];
+    int64_t expect = plen / chunk_bytes;
+    if (expect > max_chunks) expect = max_chunks;
+    assert(counts[p] == (int32_t)expect);
+    const uint32_t* row = hashes.data() + (size_t)p * max_chunks;
+    for (int c = 0; c < max_chunks; ++c) {
+      if (c < counts[p])
+        assert(row[c] != 0);   // live chunk hash is never the 0 sentinel
+      else
+        assert(row[c] == 0);   // tail is zero-padded
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,64 @@
+// Fuzz harness for native/jsonscan.cc (gie_json_scan + gie_headers_scan).
+//
+// Seeds: tests/test_fieldscan.py's directed corpus, exported by
+// hack/fuzz_seeds.py. Every input is thrown at the JSON field scanner
+// with both a full-size and a deliberately tiny model buffer (the
+// fallback-on-overflow path), and at the serialized-HeaderMap walker
+// (arbitrary bytes exercise the varint/bounds checks). ASan/UBSan do
+// the real judging; the asserts here pin the packed-return contract.
+
+#include <assert.h>
+#include <stdint.h>
+#include <string.h>
+
+#include "driver.h"
+
+extern "C" long gie_json_scan(const char* text, long n, double* out_caps,
+                              char* model_buf, long model_cap);
+extern "C" long gie_headers_scan(const char* buf, long n,
+                                 const char* needed, long* out_idx,
+                                 long* out_off, long* out_len, long cap);
+
+namespace {
+constexpr long kHdrCap = 32;
+const char kNeeded[] =
+    "content-length\ncontent-type\nx-gateway-model-name\n:path";
+}  // namespace
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  static const uint8_t kEmpty[1] = {0};
+  if (size == 0) data = kEmpty;  // scanners get a valid pointer
+  const char* text = (const char*)data;
+  long n = (long)size;
+
+  double caps[3];
+  char model[4096];
+  long rc = gie_json_scan(text, n, caps, model, sizeof model);
+  if (rc >= 0) {
+    long model_len = rc >> 16;
+    assert(model_len >= 0 && model_len <= (long)sizeof model);
+    // has_model without top_is_object would be a scanner logic bug.
+    if (rc & 0x02) assert(rc & 0x01);
+  } else {
+    assert(rc == -1 || rc == -2);
+  }
+
+  // Tiny model buffer: long model strings must fall back, never spill.
+  char tiny[8];
+  long rc2 = gie_json_scan(text, n, caps, tiny, sizeof tiny);
+  if (rc2 >= 0) assert((rc2 >> 16) <= (long)sizeof tiny);
+
+  // HeaderMap walker on the same bytes: must bound-check every varint.
+  long idx[kHdrCap], off[kHdrCap], len[kHdrCap];
+  long found = gie_headers_scan(text, n, kNeeded, idx, off, len, kHdrCap);
+  if (found >= 0) {
+    assert(found <= kHdrCap);
+    for (long i = 0; i < found; ++i) {
+      assert(idx[i] >= 0 && idx[i] < 4);
+      assert(off[i] >= 0 && len[i] >= 0 && off[i] + len[i] <= n);
+    }
+  } else {
+    assert(found == -1);
+  }
+  return 0;
+}

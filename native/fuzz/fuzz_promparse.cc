@@ -1,0 +1,92 @@
+// Fuzz harness for native/promparse.cc (gie_prom_extract).
+//
+// Input layout: an optional query-spec segment, then 0xFE, then the
+// exposition text — so the fuzzer mutates BOTH grammars (the
+// "name|k=v;k2=v2|value_label" spec parser and the exposition scanner).
+// Without a 0xFE separator the whole input is exposition text under the
+// production vLLM query spec. n_queries is counted exactly like
+// parse_queries counts (non-empty '\n'-split lines), so the deep
+// extraction path runs instead of bailing at the count check.
+
+#include <assert.h>
+#include <math.h>
+#include <stdint.h>
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+#include "driver.h"
+
+extern "C" long gie_prom_extract(
+    const char* text, long n, const char* query_spec, double* out_values,
+    unsigned char* out_found, long n_queries, const char* extra_families,
+    long* out_off, long* out_len, long cap);
+
+namespace {
+
+// Production-shaped default spec (metricsio/native.py builds these).
+const char kDefaultSpec[] =
+    "vllm:num_requests_running\n"
+    "vllm:num_requests_waiting\n"
+    "vllm:kv_cache_usage_perc\n"
+    "vllm:cache_config_info||block_size\n"
+    "vllm:cache_config_info||num_gpu_blocks";
+
+long count_queries(const char* spec) {
+  long count = 0;
+  const char* p = spec;
+  while (*p) {
+    const char* end = strchr(p, '\n');
+    size_t len = end ? (size_t)(end - p) : strlen(p);
+    if (len > 0) ++count;
+    p = end ? end + 1 : p + len;
+  }
+  return count;
+}
+
+constexpr long kExtraCap = 16;
+
+}  // namespace
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  std::string spec;
+  static const uint8_t kEmpty[1] = {0};
+  if (size == 0) data = kEmpty;  // memchr/extract get a valid pointer
+  const char* text = (const char*)data;
+  long n = (long)size;
+  const uint8_t* sep =
+      size ? (const uint8_t*)memchr(data, 0xFE, size) : nullptr;
+  if (sep != nullptr) {
+    spec.assign((const char*)data, sep - data);
+    // An embedded NUL would truncate the C-string spec — that is fine,
+    // it just shortens the spec the same way strlen would.
+    text = (const char*)(sep + 1);
+    n = (long)(size - (sep - data) - 1);
+  } else {
+    spec = kDefaultSpec;
+  }
+  long n_queries = count_queries(spec.c_str());
+  if (n_queries > 256) return 0;  // spec bomb: bound the allocation
+
+  std::vector<double> values(n_queries ? n_queries : 1);
+  std::vector<unsigned char> found(n_queries ? n_queries : 1);
+  long extra_off[kExtraCap], extra_len[kExtraCap];
+  long extras = gie_prom_extract(
+      text, n, spec.c_str(), values.data(), found.data(), n_queries,
+      "vllm:lora_requests_info", extra_off, extra_len, kExtraCap);
+  if (extras < 0) {
+    assert(extras == -1);
+    return 0;
+  }
+  long written = extras < kExtraCap ? extras : kExtraCap;
+  for (long i = 0; i < written; ++i) {
+    assert(extra_off[i] >= 0 && extra_len[i] >= 0);
+    assert(extra_off[i] + extra_len[i] <= n);
+  }
+  for (long i = 0; i < n_queries; ++i) {
+    assert(found[i] == 0 || found[i] == 1);
+    if (!found[i]) assert(isnan(values[i]));
+  }
+  return 0;
+}

@@ -664,7 +664,10 @@ long gie_headers_scan(const char* buf, long n, const char* needed,
     if (field == 1 && wire == 2) {
       unsigned long long msg_len;
       if (!rd_varint(p, n, &i, &msg_len)) return -1;
-      if (i + (long)msg_len > n) return -1;
+      // Unsigned compare against the REMAINING bytes: a 64-bit varint
+      // length casts to a negative long, and `i + (long)len > n` then
+      // passes, walking i out of the buffer (fuzz_jsonscan finding).
+      if (msg_len > (unsigned long long)(n - i)) return -1;
       long end = i + (long)msg_len;
       long key_off = -1, key_len = 0;
       long val_off = -1, val_len = 0;
@@ -676,7 +679,7 @@ long gie_headers_scan(const char* buf, long n, const char* needed,
         if (w2 == 2) {
           unsigned long long l2;
           if (!rd_varint(p, end, &i, &l2)) return -1;
-          if (i + (long)l2 > end) return -1;
+          if (l2 > (unsigned long long)(end - i)) return -1;
           if (f2 == 1) { key_off = i; key_len = (long)l2; }
           else if (f2 == 2) { val_off = i; val_len = (long)l2; }
           else if (f2 == 3) { raw_off = i; raw_len = (long)l2; }
@@ -714,6 +717,7 @@ long gie_headers_scan(const char* buf, long n, const char* needed,
     } else if (wire == 2) {
       unsigned long long l;
       if (!rd_varint(p, n, &i, &l)) return -1;
+      if (l > (unsigned long long)(n - i)) return -1;
       i += (long)l;
     } else if (wire == 0) {
       unsigned long long skip;

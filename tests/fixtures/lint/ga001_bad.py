@@ -1,0 +1,23 @@
+"""Golden violation: blocking calls inside async code (GA001) — a
+synchronous sleep, blocking HTTP through a helper, and a threading
+Event wait, all stalling the event loop."""
+
+import time
+import threading
+import urllib.request
+
+
+def fetch_sync(url):
+    return urllib.request.urlopen(url)      # blocking I/O
+
+
+class Loop:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready = threading.Event()
+
+    async def handle(self, url):
+        time.sleep(0.1)                     # GA001
+        body = fetch_sync(url)              # transitive urlopen: GA001
+        self.ready.wait()                   # Event wait: GA001
+        return body

@@ -1,0 +1,26 @@
+"""Golden negative: awaitable forms inside async code — asyncio.sleep,
+awaited helpers, and a short lock-protected section. (Note json.loads is
+deliberately absent: the shared denylist flags it even in async code —
+a large parse stalls the loop exactly like I/O.) Must produce NO
+GA001."""
+
+import asyncio
+import threading
+
+
+class Loop:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready = threading.Event()
+        self.state = None
+
+    async def tick(self):
+        await asyncio.sleep(0.1)            # awaitable sleep
+        return self.state
+
+    async def handle(self, payload):
+        parsed = payload.decode("utf-8")    # cheap transform is fine
+        with self._lock:                    # short section is fine
+            self.state = parsed
+        self.ready.set()                    # Event.SET never blocks
+        return await self.tick()

@@ -1,0 +1,43 @@
+"""Golden violation: out-of-order lock acquisition (GL001), three
+shapes — direct nesting, nesting through a resolved call chain, and
+self-deadlock on a non-reentrant Lock."""
+
+import threading
+
+
+class Helper:
+    def __init__(self):
+        self._lock = threading.Lock()   # rank 30
+
+    def touch(self):
+        with self._lock:
+            return 1
+
+
+class Outer:
+    def __init__(self):
+        self._outer = threading.Lock()  # rank 10
+        self._inner = threading.Lock()  # rank 20
+        self.helper = Helper()
+
+    def inverted_direct(self):
+        with self._inner:               # rank 20 held...
+            with self._outer:           # ...rank 10 acquired: GL001
+                return 1
+
+    def call_chain_inversion(self):
+        with self.helper._lock:         # rank 30 held...
+            self.ordered()              # ...calls into rank 10: GL001
+
+    def ordered(self):
+        with self._outer:
+            return 2
+
+    def self_deadlock(self):
+        with self._outer:
+            with self._outer:           # non-reentrant Lock: GL001
+                return 3
+
+    def inverted_one_statement(self):
+        with self._inner, self._outer:  # 20 then 10 in ONE with: GL001
+            return 4

@@ -1,0 +1,34 @@
+"""Golden negative: well-ordered acquisition — outer (rank 10) wraps
+inner (rank 20), and the cross-call form follows the same order. Must
+produce NO GL001."""
+
+import threading
+
+
+class Outer:
+    def __init__(self):
+        self._outer = threading.Lock()  # rank 10
+        self._inner = threading.Lock()  # rank 20
+
+    def nested_in_order(self):
+        with self._outer:
+            with self._inner:
+                return 1
+
+    def inner_section(self):
+        with self._inner:
+            return 2
+
+    def call_in_order(self):
+        with self._outer:
+            return self.inner_section()
+
+    def sequential_not_nested(self):
+        with self._inner:
+            x = 1
+        with self._outer:   # sequential re-ordering is legal
+            return x
+
+    def one_statement_in_order(self):
+        with self._outer, self._inner:  # 10 then 20 in one with: legal
+            return 3

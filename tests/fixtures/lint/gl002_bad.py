@@ -1,0 +1,46 @@
+"""Golden violation: blocking-while-locked (GL002) — a sleep and a JSON
+parse directly under a lock, typed-receiver I/O under a lock, a blocking
+helper reached through the call graph, and a D2H pull under a lock in a
+jax-importing module."""
+
+import http.client
+import json
+import time
+import threading
+
+import jax  # noqa: F401  (activates the [d2h] rules)
+import numpy as np
+
+
+class Conn:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.conn = http.client.HTTPConnection("localhost", 1)
+
+    def fetch_locked(self):
+        with self._lock:
+            self.conn.request("GET", "/")      # typed receiver I/O: GL002
+            return self.conn.getresponse()     # method denylist: GL002
+
+
+def slow_helper():
+    time.sleep(0.5)
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = None
+
+    def parse_locked(self, payload):
+        with self._lock:
+            time.sleep(0.1)                    # GL002
+            self.state = json.loads(payload)   # GL002
+
+    def helper_locked(self):
+        with self._lock:
+            slow_helper()                      # transitive sleep: GL002
+
+    def d2h_locked(self, device_array):
+        with self._lock:
+            return np.asarray(device_array)    # device sync: GL002

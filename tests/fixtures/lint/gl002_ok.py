@@ -1,0 +1,40 @@
+"""Golden negative: the blocking work runs OUTSIDE the critical
+section (fetch-then-lock, parse-then-lock), and a Condition waits on
+ITSELF while held (the one blocking call whose contract is to release
+the lock). Must produce NO GL002."""
+
+import json
+import time
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self.state = None
+
+    def parse_then_publish(self, payload):
+        parsed = json.loads(payload)   # outside the lock
+        with self._lock:
+            self.state = parsed
+
+    def sleep_between_sections(self):
+        with self._lock:
+            x = self.state
+        time.sleep(0.01)               # outside the lock
+        with self._lock:
+            return x
+
+    def wait_on_held_condition(self):
+        with self._cond:
+            self._cond.wait(0.01)      # releases the held lock: exempt
+            return self.state
+
+    def spawn_worker(self):
+        # The closure's sleep runs when the WORKER runs, not while this
+        # lock is held — nested-def bodies are pruned from the summary.
+        with self._lock:
+            def worker():
+                time.sleep(0.5)
+            return worker

@@ -1,0 +1,13 @@
+"""Golden violation: a lock with no rank in lockorder.toml (GL003) —
+every new lock must take a declared place in the hierarchy."""
+
+import threading
+
+
+class Rogue:
+    def __init__(self):
+        self._unranked = threading.Lock()   # not in lockorder.toml: GL003
+
+    def use(self):
+        with self._unranked:
+            return 1

@@ -1,0 +1,10 @@
+"""Golden violation: the fixture config ranks ``gl004_stale.Gone._lock``
+but this module defines no such lock (GL004) — the declared hierarchy
+must describe the code that exists."""
+
+
+class Gone:
+    # The class survived a refactor; its _lock did not. The stale rank
+    # entry in lockorder.toml must be deleted with it.
+    def __init__(self):
+        self.state = None

@@ -1,0 +1,18 @@
+"""Golden violation: import-time device constants (GT001) — module
+level, class body, function default, and an import-time backend query,
+under an alias."""
+
+import jax
+import jax.numpy as xnp
+
+_TABLE = xnp.zeros((8,))                    # module level: GT001
+
+_DEVICES = jax.device_count()               # backend query: GT001
+
+
+class Holder:
+    SCALE = xnp.ones((4,)) * 2.0            # class body: GT001
+
+
+def score(x, bias=xnp.zeros((4,))):         # default arg: GT001
+    return x + bias

@@ -1,0 +1,18 @@
+"""Golden negative: device arrays built lazily inside functions, and
+numpy (host) constants at module level. Must produce NO GT001."""
+
+import jax.numpy as jnp
+import numpy as np
+
+_HOST_TABLE = np.zeros((8,))    # numpy at import time is fine
+
+
+def make_table():
+    return jnp.zeros((8,))      # device array built at call time
+
+
+class Holder:
+    SCALE = 2.0                 # python scalar
+
+    def table(self):
+        return jnp.ones((4,)) * self.SCALE

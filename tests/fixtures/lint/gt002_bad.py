@@ -1,0 +1,28 @@
+"""Golden violation: host syncs / Python side effects inside jit-traced
+code (GT002) — float() on a traced value, print(), a numpy pull, a
+wall-clock read, .item(), and the same in a function only REACHED from
+a jitted one."""
+
+import time
+
+import jax
+import numpy as np
+
+
+def _leaf(x):
+    print("tracing", x)          # side effect in traced code: GT002
+    return float(x) * 2.0        # host sync on a tracer: GT002
+
+
+@jax.jit
+def score(x):
+    t = time.time()              # baked into the trace: GT002
+    host = np.asarray(x)         # D2H pull: GT002
+    v = x.sum().item()           # host sync: GT002
+    return _leaf(x) + host.sum() + v + t
+
+
+def plain(y):
+    # Not jitted and not called from jit: none of these fire GT002.
+    print("host-side", y)
+    return float(y)

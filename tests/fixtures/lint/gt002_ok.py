@@ -1,0 +1,19 @@
+"""Golden negative: trace-safe jitted code — static-shape reads through
+float()/int(), jax.debug.print for tracing, pure jnp math. Must produce
+NO GT002."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def score(x):
+    scale = float(x.shape[0])        # static property: safe
+    n = int(x.ndim)                  # static property: safe
+    jax.debug.print("n={n}", n=n)    # the traced-side print
+    return jnp.sum(x) * scale
+
+
+def host_wrapper(batch):
+    # Host-side code around the jit boundary may sync freely.
+    return float(score(batch).sum())

@@ -1,0 +1,16 @@
+"""Golden violation: explicit host sync in production code (GT003) —
+block_until_ready belongs in bench/test paths."""
+
+import jax.numpy as jnp
+
+
+def warm(table):
+    out = jnp.sum(table)
+    out.block_until_ready()          # GT003
+    return out
+
+
+def warm_functional(table):
+    import jax
+
+    return jax.block_until_ready(jnp.sum(table))   # GT003

@@ -23,9 +23,18 @@ from gie_tpu.utils.costmodel import cycle_cost
 
 
 @pytest.mark.parametrize("name,cfg,ceiling_mb", [
-    # measured 27.5 MB on the round-5 HLO (threshold-descent topk +
-    # production donation semantics in the measurement)
-    ("default-topk", ProfileConfig(), 32.0),
+    # Re-baselined 2026-08 (PR 6): measured 35.0 MB / 50.2 Mflop on this
+    # container's jaxlib 0.4.36 CPU pipeline. Attribution (worktree
+    # sweep with hack/cost_analysis.py at the seed and every PR 1-5
+    # commit): bytes AND flops are bit-identical at all six points, so
+    # the 27.5 -> 35.0 MB step is the XLA version's fusion/accounting,
+    # not a code regression — the math never changed. Per-feature split
+    # on this backend: prefix sweep 4.5 MB, session affinity 5.4 MB,
+    # LoRA 1.6 MB; cost analysis charges 18.6 MB to state-operand
+    # traffic and 11.1 MB to outputs. Ceiling = measured + ~15% slack,
+    # same rule as the original calibration. If a future jaxlib drops
+    # the measurement back to ~27 MB, tighten this again.
+    ("default-topk", ProfileConfig(), 40.0),
     # measured 55.5 MB (8 OT iterations re-read the transport kernel)
     ("sinkhorn", ProfileConfig(picker="sinkhorn"), 64.0),
 ])

@@ -1,0 +1,64 @@
+"""Bounded ASan/UBSan fuzz smoke over the native libraries.
+
+The `make fuzz-smoke` contract as a pytest: build the sanitizer fuzz
+binaries (native/fuzz/, standalone driver — docs/ANALYSIS.md), export
+the seed corpora from the parity-test bodies, and run each harness for
+GIE_FUZZ_SECS seconds (default 30, the acceptance bound; CI can dial it
+down). A sanitizer finding aborts the binary non-zero and fails the
+test with the tail of its stderr.
+
+Slow tier: three libraries x the budget is ~90 s wall. Tier-1 still
+covers the native code through the parity suites (test_fieldscan,
+test_promparse_native, test_native); this module is the memory-safety
+layer on top.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+FUZZ_SECS = os.environ.get("GIE_FUZZ_SECS", "30")
+
+LIBS = ["jsonscan", "promparse", "chunker"]
+
+
+@pytest.fixture(scope="module")
+def fuzz_bins():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain for the sanitizer build")
+    build = subprocess.run(
+        ["make", "-C", NATIVE, "fuzz"], capture_output=True, text=True
+    )
+    if build.returncode != 0:
+        pytest.fail(f"sanitizer fuzz build failed:\n{build.stderr[-2000:]}")
+    seeds = subprocess.run(
+        [sys.executable, os.path.join(REPO, "hack", "fuzz_seeds.py")],
+        capture_output=True, text=True,
+    )
+    assert seeds.returncode == 0, seeds.stderr
+    return os.path.join(NATIVE, "fuzz", "bin")
+
+
+@pytest.mark.parametrize("lib", LIBS)
+def test_fuzz_smoke(fuzz_bins, lib):
+    corpus = os.path.join(NATIVE, "fuzz", "corpus", lib)
+    assert os.path.isdir(corpus), f"missing corpus {corpus}"
+    assert len(os.listdir(corpus)) > 0
+    proc = subprocess.run(
+        [os.path.join(fuzz_bins, f"fuzz_{lib}"),
+         f"-max_total_time={FUZZ_SECS}", "-seed=7", corpus],
+        capture_output=True, text=True,
+        timeout=int(float(FUZZ_SECS)) * 4 + 120,
+    )
+    assert proc.returncode == 0, (
+        f"fuzz_{lib} found a sanitizer/assert failure:\n"
+        f"{proc.stderr[-4000:]}"
+    )
+    assert "no findings" in proc.stderr

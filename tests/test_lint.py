@@ -1,0 +1,256 @@
+"""gie-lint meta-suite (ISSUE 6): the analyzers themselves are pinned —
+each rule fires on its golden-violation fixture and stays silent on the
+matching negative, the baseline machinery enforces its justification /
+no-stale-entries contract, and ``gie_tpu/`` at HEAD is CLEAN modulo the
+baseline (the tier-1 guarantee behind ``make lint``)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from gie_tpu.lint import baseline, tomlmini
+from gie_tpu.lint.model import Violation
+from gie_tpu.lint.runner import DEFAULT_BASELINE, run_paths
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+FIXTURE_CONFIG = os.path.join(FIXTURES, "lockorder.toml")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_fixture(stem: str, rule: str) -> list[Violation]:
+    """Analyze one fixture file, filtered to one rule family (fixtures
+    share a config, so other files' GL004 stale-rank noise is
+    expected and must be filtered, not asserted on)."""
+    violations, stale = run_paths(
+        [os.path.join(FIXTURES, f"{stem}.py")],
+        config=FIXTURE_CONFIG,
+        baseline_path="",
+        rules={rule},
+    )
+    assert stale == []
+    return violations
+
+
+# --------------------------------------------------------------------------
+# Golden violations: one positive + one negative per rule
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "stem,rule,expected_substrings",
+    [
+        ("gl001_bad", "GL001", [
+            "acquires gl001_bad.Outer._outer (rank 10) while holding "
+            "gl001_bad.Outer._inner (rank 20)",
+            "while holding gl001_bad.Helper._lock (rank 30) via",
+            "self-deadlock",
+            # `with self._inner, self._outer:` — the in-statement pair.
+            "inverted_one_statement",
+        ]),
+        ("gl002_bad", "GL002", [
+            "time.sleep",
+            "json.loads",
+            "http.client.HTTPConnection.getresponse",
+            "via gl002_bad.py:slow_helper",         # transitive chain
+            "numpy.asarray (device sync)",          # D2H under lock
+        ]),
+        ("gl003_bad", "GL003", ["gl003_bad.Rogue._unranked"]),
+        ("gl004_stale", "GL004", ["gl004_stale.Gone._lock"]),
+        ("gt001_bad", "GT001", ["import time"]),
+        ("gt002_bad", "GT002", [
+            "float() on a traced value",
+            "print() inside traced code",
+            "numpy.asarray() inside traced code",
+            "time.time() inside traced code",
+            ".item() inside traced code",
+            "called from jit via gt002_bad.py:score",   # reachability
+        ]),
+        ("gt003_bad", "GT003", ["block_until_ready"]),
+        ("ga001_bad", "GA001", [
+            "time.sleep",
+            "urllib.request.urlopen inside async function via",
+            "threading.Event.wait",
+        ]),
+    ],
+)
+def test_rule_fires_on_golden_fixture(stem, rule, expected_substrings):
+    violations = run_fixture(stem, rule)
+    assert violations, f"{rule} found nothing in {stem}.py"
+    rendered = "\n".join(v.render() for v in violations)
+    for sub in expected_substrings:
+        assert sub in rendered, (
+            f"{rule} on {stem}.py missing expected finding {sub!r}:\n"
+            f"{rendered}")
+
+
+def test_gt001_counts_every_import_time_shape():
+    # Module level, backend query, class body, default arg: all four.
+    assert len(run_fixture("gt001_bad", "GT001")) == 4
+
+
+@pytest.mark.parametrize(
+    "stem,rule",
+    [
+        ("gl001_ok", "GL001"),
+        ("gl002_ok", "GL002"),
+        ("gt001_ok", "GT001"),
+        ("gt002_ok", "GT002"),
+        ("ga001_ok", "GA001"),
+    ],
+)
+def test_rule_silent_on_negative_fixture(stem, rule):
+    violations = run_fixture(stem, rule)
+    assert violations == [], (
+        f"{rule} false positives in {stem}.py:\n"
+        + "\n".join(v.render() for v in violations))
+
+
+def test_gt002_does_not_flag_host_side_code():
+    # gt002_bad.plain uses print/float but is unreachable from jit.
+    assert not any(
+        v.qualname == "plain" for v in run_fixture("gt002_bad", "GT002"))
+
+
+# --------------------------------------------------------------------------
+# The repo itself is clean (the `make lint` gate)
+# --------------------------------------------------------------------------
+
+
+def test_gie_tpu_clean_modulo_baseline():
+    violations, stale = run_paths()
+    assert violations == [], (
+        "gie_tpu/ has unbaselined lint findings — fix them or "
+        "grandfather WITH justification in gie_tpu/lint/baseline.toml:\n"
+        + "\n".join(v.render() for v in violations))
+    assert stale == [], (
+        "stale baseline entries (no longer matching any finding):\n"
+        + "\n".join(f"{e.rule} at {e.where}" for e in stale))
+
+
+def test_every_repo_lock_is_ranked():
+    """The declared hierarchy covers every lock in gie_tpu/ — GL003
+    firing on HEAD would already fail the clean test, but this pins the
+    inverse too: the config names only locks that exist."""
+    from gie_tpu.lint.model import RepoIndex
+
+    idx = RepoIndex.build(
+        os.path.join(REPO, "gie_tpu"), package_prefix="gie_tpu.")
+    ranks = tomlmini.load(
+        os.path.join(REPO, "gie_tpu", "lint", "lockorder.toml"))["ranks"]
+    assert set(idx.locks) == set(ranks)
+
+
+def test_cli_exit_codes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    clean = subprocess.run(
+        [sys.executable, "-m", "gie_tpu.lint"],
+        cwd=REPO, capture_output=True, env=env)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    dirty = subprocess.run(
+        [sys.executable, "-m", "gie_tpu.lint",
+         os.path.join(FIXTURES, "gl002_bad.py"),
+         "--config", FIXTURE_CONFIG, "--no-baseline", "--rules", "GL002"],
+        cwd=REPO, capture_output=True, env=env)
+    assert dirty.returncode == 1, dirty.stdout + dirty.stderr
+
+
+# --------------------------------------------------------------------------
+# Baseline machinery
+# --------------------------------------------------------------------------
+
+
+def _violation(rule="GL002", where="f.py:C.m", msg="blocking call x"):
+    file, qualname = where.rsplit(":", 1)
+    return Violation(rule, file, 1, qualname, msg)
+
+
+def test_baseline_requires_justification(tmp_path):
+    p = tmp_path / "baseline.toml"
+    p.write_text(
+        '[[finding]]\nrule = "GL002"\nwhere = "f.py:C.m"\n'
+        'match = "x"\njustification = "   "\n')
+    with pytest.raises(baseline.BaselineError, match="justification"):
+        baseline.load(str(p))
+
+
+def test_baseline_covers_and_reports_stale(tmp_path):
+    p = tmp_path / "baseline.toml"
+    p.write_text(
+        '[[finding]]\nrule = "GL002"\nwhere = "f.py:C.m"\n'
+        'match = "blocking"\njustification = "legacy, tracked in #1"\n'
+        '[[finding]]\nrule = "GL001"\nwhere = "gone.py:X.y"\n'
+        'match = ""\njustification = "obsolete"\n')
+    entries = baseline.load(str(p))
+    remaining, stale = baseline.apply([_violation()], entries)
+    assert remaining == []                      # covered finding hidden
+    assert [e.where for e in stale] == ["gone.py:X.y"]   # stale caught
+
+
+def test_baseline_does_not_cover_new_findings(tmp_path):
+    p = tmp_path / "baseline.toml"
+    p.write_text(
+        '[[finding]]\nrule = "GL002"\nwhere = "f.py:C.m"\n'
+        'match = "blocking"\njustification = "legacy"\n')
+    new = _violation(where="other.py:D.n", msg="blocking call y")
+    remaining, _ = baseline.apply([new], baseline.load(str(p)))
+    assert remaining == [new]
+
+
+def test_rules_filter_does_not_strand_baseline_entries(tmp_path):
+    """--rules GL must not report a GT/GA baseline entry as stale: the
+    restricted run never computed those findings, so it cannot judge
+    their entries."""
+    p = tmp_path / "baseline.toml"
+    p.write_text(
+        '[[finding]]\nrule = "GT003"\nwhere = "x.py:C.m"\n'
+        'match = "block_until_ready"\njustification = "legacy bench"\n')
+    _, stale = run_paths(
+        [os.path.join(FIXTURES, "gl001_ok.py")],
+        config=FIXTURE_CONFIG,
+        baseline_path=str(p),
+        rules={"GL"},
+    )
+    assert stale == []
+
+
+def test_repo_baseline_is_loadable():
+    entries = baseline.load(DEFAULT_BASELINE)
+    for e in entries:
+        assert e.justification  # load() enforces; double-pin the contract
+
+
+# --------------------------------------------------------------------------
+# tomlmini: the config reader the whole suite leans on
+# --------------------------------------------------------------------------
+
+
+def test_tomlmini_subset():
+    doc = tomlmini.loads(
+        '# comment\n'
+        'top = "v"\n'
+        '[ranks]\n'
+        '"a.b.c" = 10\n'
+        'plain = 2.5\n'
+        'flag = true\n'
+        '[blocking]\n'
+        'calls = [\n    "time.sleep",  # trailing comment\n'
+        '    "json.loads",\n]\n'
+        '[[finding]]\nrule = "GL001"\n'
+        '[[finding]]\nrule = "GL002"\n')
+    assert doc["top"] == "v"
+    assert doc["ranks"]["a.b.c"] == 10
+    assert doc["ranks"]["plain"] == 2.5
+    assert doc["ranks"]["flag"] is True
+    assert doc["blocking"]["calls"] == ["time.sleep", "json.loads"]
+    assert [f["rule"] for f in doc["finding"]] == ["GL001", "GL002"]
+
+
+def test_tomlmini_rejects_garbage():
+    with pytest.raises(ValueError):
+        tomlmini.loads("not a toml line\n")
+    with pytest.raises(ValueError):
+        tomlmini.loads('x = [1, 2\n')   # unterminated array

@@ -1,0 +1,148 @@
+"""Dynamic lock-order confirmer (ISSUE 6): the instrumented Lock wrapper
+records REAL acquisition orders under real traffic and asserts them
+against the same lockorder.toml hierarchy the static analyzer enforces.
+The integration test drives the actual ScrapeEngine/MetricsStore pair —
+the one statically-proven nesting family — and additionally asserts the
+nesting was OBSERVED, so the consistency check cannot pass vacuously."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from gie_tpu.lint.dynamic import LockTracker, TrackedLock, default_ranks
+
+ENGINE_LOCK = "gie_tpu.metricsio.engine.ScrapeEngine._lock"
+STORE_LOCK = "gie_tpu.metricsio.store.MetricsStore._lock"
+
+
+# --------------------------------------------------------------------------
+# Tracker unit behavior
+# --------------------------------------------------------------------------
+
+
+class _Box:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+
+def test_tracker_records_inversion_and_order():
+    tracker = LockTracker(ranks={"t.a": 10, "t.b": 20})
+    box = _Box()
+    tracker.wrap(box, "a", "t.a")
+    tracker.wrap(box, "b", "t.b")
+
+    with box.a:           # rank 10 then 20: correct
+        with box.b:
+            pass
+    assert tracker.violations == []
+    assert ("t.a", "t.b") in tracker.observed()
+
+    with box.b:           # rank 20 then 10: inversion
+        with box.a:
+            pass
+    assert len(tracker.violations) == 1
+    v = tracker.violations[0]
+    assert (v.outer, v.inner) == ("t.b", "t.a")
+    with pytest.raises(AssertionError, match="rank inversion"):
+        tracker.assert_consistent()
+
+
+def test_tracker_is_per_thread():
+    tracker = LockTracker(ranks={"t.a": 10, "t.b": 20})
+    box = _Box()
+    tracker.wrap(box, "a", "t.a")
+    tracker.wrap(box, "b", "t.b")
+
+    # Thread 1 holds b while thread 2 takes a: no nesting, no violation.
+    barrier = threading.Barrier(2)
+
+    def hold_b():
+        with box.b:
+            barrier.wait()
+            barrier.wait()
+
+    t = threading.Thread(target=hold_b)
+    t.start()
+    barrier.wait()
+    with box.a:
+        pass
+    barrier.wait()
+    t.join()
+    tracker.assert_consistent()
+
+
+def test_tracked_lock_delegates_condition_api():
+    tracker = LockTracker(ranks={"t.c": 10})
+    holder = type("H", (), {})()
+    holder.c = threading.Condition()
+    tracker.wrap(holder, "c", "t.c")
+    assert isinstance(holder.c, TrackedLock)
+    with holder.c:
+        holder.c.wait(0.01)       # delegated through __getattr__
+        holder.c.notify_all()
+    tracker.assert_consistent()
+
+
+def test_wrap_is_idempotent():
+    tracker = LockTracker(ranks={"t.a": 10})
+    box = _Box()
+    first = tracker.wrap(box, "a", "t.a")
+    assert tracker.wrap(box, "a", "t.a") is first
+
+
+def test_default_ranks_load_the_repo_hierarchy():
+    ranks = default_ranks()
+    assert ranks[ENGINE_LOCK] < ranks[STORE_LOCK]  # engine wraps store
+
+
+# --------------------------------------------------------------------------
+# Integration: real engine/store traffic against the declared hierarchy
+# --------------------------------------------------------------------------
+
+
+def test_engine_store_traffic_matches_declared_hierarchy():
+    from gie_tpu.metricsio.engine import ScrapeEngine
+    from gie_tpu.metricsio.mappings import BY_NAME
+    from gie_tpu.metricsio.store import MetricsStore
+
+    store = MetricsStore()
+    payload = (
+        b"vllm:num_requests_running 2.0\n"
+        b"vllm:num_requests_waiting 1.0\n"
+        b"vllm:gpu_cache_usage_perc 0.5\n"
+    )
+    engine = ScrapeEngine(
+        store, interval_s=0.01, workers=2,
+        fetcher=lambda url: payload)
+    tracker = LockTracker(ranks=default_ranks())
+    tracker.wrap(engine, "_lock", ENGINE_LOCK)
+    tracker.wrap(store, "_lock", STORE_LOCK)
+    mapping = BY_NAME["vllm"]
+    try:
+        for slot in range(8):
+            engine.attach(slot, f"http://10.0.0.{slot}:9400/metrics",
+                          mapping)
+        deadline = time.monotonic() + 3.0
+        # Control-plane reads interleave with shard sweeps, like the
+        # runner's metrics exposition does.
+        while time.monotonic() < deadline:
+            store.pool_rows(list(range(8)))
+            engine.staleness_seconds()
+            if ((ENGINE_LOCK, STORE_LOCK) in tracker.observed()
+                    and store.pool_rows([0])[0].sum() > 0):
+                break
+            time.sleep(0.02)
+        engine.detach(3)
+    finally:
+        engine.close()
+
+    tracker.assert_consistent()
+    observed = tracker.observed()
+    assert (ENGINE_LOCK, STORE_LOCK) in observed, (
+        "engine->store nesting never observed — the integration drive "
+        f"went vacuous (saw: {sorted(observed)})")
+    assert (STORE_LOCK, ENGINE_LOCK) not in observed

@@ -40,3 +40,17 @@ def test_native_is_faster_on_large_batch():
         hashing.chunk_hashes(p)
     py_t = (time.perf_counter() - t0) * 8  # scale to 1024
     assert native_t < py_t
+
+
+def test_native_lib_path_variant_selection(monkeypatch):
+    """GIE_NATIVE_ASAN selects the sanitizer .so by VALUE: unset and "0"
+    both mean the production build (an accidental -asan pick fails to
+    load and silently drops every loader to the pure-Python path)."""
+    from gie_tpu.utils.nativelib import native_lib_path
+
+    monkeypatch.delenv("GIE_NATIVE_ASAN", raising=False)
+    assert native_lib_path("giechunker").endswith("/libgiechunker.so")
+    monkeypatch.setenv("GIE_NATIVE_ASAN", "0")
+    assert native_lib_path("giechunker").endswith("/libgiechunker.so")
+    monkeypatch.setenv("GIE_NATIVE_ASAN", "1")
+    assert native_lib_path("giechunker").endswith("/libgiechunker-asan.so")
