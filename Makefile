@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native native-asan generate lint fuzz-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
+.PHONY: all native native-asan generate lint fuzz-smoke chaos-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
 
 all: native generate
 
@@ -30,6 +30,14 @@ fuzz-smoke: native-asan
 	native/fuzz/bin/fuzz_jsonscan  -max_total_time=$(FUZZ_SECS) native/fuzz/corpus/jsonscan
 	native/fuzz/bin/fuzz_promparse -max_total_time=$(FUZZ_SECS) native/fuzz/corpus/promparse
 	native/fuzz/bin/fuzz_chunker   -max_total_time=$(FUZZ_SECS) native/fuzz/corpus/chunker
+
+# Seeded chaos pass (docs/RESILIENCE.md): the fast scenario suite that
+# also runs in tier-1, then the slow-marked mixed-fault soak — identical
+# seeds reproduce identical fault schedules, so a failure here is a real
+# resilience regression, never flake.
+chaos-smoke:
+	$(PY) -m pytest tests/test_chaos.py -q -m 'not slow'
+	$(PY) -m pytest tests/test_chaos.py -q -m slow
 
 # CRD manifests (reference `make generate`).
 generate:

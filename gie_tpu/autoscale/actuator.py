@@ -26,10 +26,20 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from gie_tpu.autoscale.recommender import Recommendation
+from gie_tpu.resilience import faults
+from gie_tpu.resilience.policy import BackoffPolicy, retry_call
 from gie_tpu.runtime import metrics as own_metrics
 from gie_tpu.runtime.logging import get_logger
 
 FIELD_MANAGER = "gie-tpu-autoscale"
+
+# One-shot patch retry (resilience/policy.py): before this policy a
+# failed SSA patch was retried only at the NEXT control cycle (seconds
+# away) — a transient apiserver blip cost a full actuation interval.
+# Three in-call attempts with a short jittered backoff absorb blips; a
+# real outage still degrades to "error" and the next cycle re-derives.
+PATCH_RETRY = BackoffPolicy(base_s=0.1, max_s=1.0)
+PATCH_ATTEMPTS = 3
 
 
 class ReplicaActuator:
@@ -97,7 +107,10 @@ class ReplicaActuator:
             return "dry_run"
         if self.client is None or not self.target:
             return "no_target"
-        try:
+        def _patch():
+            if faults.ENABLED:
+                # gie-chaos: a kube-API outage is a failing SSA patch.
+                faults.check("kube.patch", key=self.target or "")
             self.client._json(
                 "PATCH",
                 f"{self._path()}?fieldManager={FIELD_MANAGER}&force=true",
@@ -110,6 +123,17 @@ class ReplicaActuator:
                 },
                 content_type="application/apply-patch+yaml",
             )
+
+        try:
+            # retry_on=OSError: network-shaped failures only (URLError /
+            # ConnectionError / timeouts — what "apiserver blip" means).
+            # Deterministic rejections surface as ApiError (RuntimeError:
+            # 404 target, 403 RBAC, 422 schema) and must NOT burn 3
+            # patch attempts + sleeps per cycle on a request that can
+            # never succeed — they degrade to "error" immediately and
+            # the next cycle re-derives.
+            retry_call(_patch, PATCH_RETRY, attempts=PATCH_ATTEMPTS,
+                       retry_on=(OSError,))
         except Exception as e:
             # The loop must survive apiserver unavailability: the next
             # cycle re-derives the recommendation from fresh signals.

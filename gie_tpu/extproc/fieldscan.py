@@ -28,6 +28,8 @@ import math
 import threading
 from typing import Optional
 
+from gie_tpu.resilience import faults
+
 # Body fields carrying the client's output-token cap, by API generation —
 # the single source of truth for the (field, order) contract between the
 # native scanner, the fallback, and server._decode_tokens.
@@ -236,6 +238,15 @@ def scan(body: bytes) -> FieldScan:
     """The admission fast lane's body read: native when built, else (or on
     a native FALLBACK verdict) the single-parse Python reference. Always
     returns a FieldScan; behavior is identical either way."""
+    if faults.ENABLED:
+        # gie-chaos: an injected native-scanner failure exercises the
+        # degradation already built in — the honest single-parse fallback
+        # serves the request instead of failing admission. Disabled cost:
+        # one module-attribute load + falsy branch (the bench-extproc
+        # regression guard pins this).
+        v = faults.fire("native.scan")
+        if v.kind in (faults.ERROR, faults.CORRUPT):
+            return scan_py(body)
     result = scan_native(body)
     if result is None:
         return scan_py(body)

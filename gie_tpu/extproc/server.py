@@ -33,6 +33,8 @@ import grpc
 from google.protobuf.message import DecodeError as _DecodeError
 
 from gie_tpu.extproc import codec, envoy, fieldscan, metadata, pb
+from gie_tpu.resilience import deadline as deadline_mod
+from gie_tpu.resilience.deadline import DeadlineExceeded
 from gie_tpu.runtime import metrics as own_metrics
 from gie_tpu.runtime import tracing
 
@@ -52,6 +54,10 @@ NEEDED_REQUEST_HEADERS = frozenset({
     metadata.FLOW_FAIRNESS_ID_KEY,        # fair interleave (batching)
     metadata.TTFT_SLO_MS_KEY,             # SLO admission (batching)
     metadata.TEST_ENDPOINT_SELECTION_HEADER,
+    # Deadline propagation (resilience/deadline.py): the caller-pinned
+    # bound and Envoy's route timeout.
+    deadline_mod.GATEWAY_DEADLINE_HEADER,
+    deadline_mod.ENVOY_TIMEOUT_HEADER,
 })
 
 
@@ -81,6 +87,9 @@ class PickRequest:
     # RequestBatch.decode_len (via CHARS_PER_TOKEN) so request_cost and
     # the pd decode-side cost see generation length on the live path.
     decode_tokens: float = 0.0
+    # Monotonic request deadline (0.0 = none; resilience/deadline.py):
+    # the batching collector sheds queued picks past this with 503.
+    deadline_at: float = 0.0
 
 
 @dataclasses.dataclass(slots=True)
@@ -211,6 +220,8 @@ class RequestContext:
     # gie_extproc_admission_seconds label, so rollout dashboards compare
     # the two lanes' latency live.
     lane: str = "legacy"
+    # Monotonic request deadline from the deadline headers (0.0 = none).
+    deadline_at: float = 0.0
     pick_result: Optional[PickResult] = None
     target_endpoint: str = ""
     selected_pod_ip: str = ""
@@ -252,6 +263,7 @@ class RequestContext:
         self.headers = {}
         self.candidates = []
         self.lane = "legacy"
+        self.deadline_at = 0.0
         self.pick_result = None
         self.target_endpoint = ""
         self.selected_pod_ip = ""
@@ -384,6 +396,20 @@ _ADMISSION_LANES = {
 }
 
 
+def _shed_response(e: Exception) -> pb.ProcessingResponse:
+    """ImmediateResponse for a request the EPP will not schedule: 429 for
+    load shedding (ShedError, 004 README:80), 503 for an exhausted
+    request deadline (DeadlineExceeded — the client's own budget gave up,
+    per the protocol's unavailable semantics)."""
+    if isinstance(e, DeadlineExceeded):
+        return pb.ProcessingResponse(
+            immediate_response=envoy.make_immediate_response(
+                503, details="request deadline exceeded"))
+    return pb.ProcessingResponse(
+        immediate_response=envoy.make_immediate_response(
+            429, details="request shed"))
+
+
 class StreamingServer:
     """One instance serves all streams; Process is invoked per HTTP request
     (Envoy opens an ext-proc stream per request)."""
@@ -493,14 +519,8 @@ class StreamingServer:
                 if req.request_headers.end_of_stream:
                     try:
                         self._pick(ctx, None)
-                    except ShedError:
-                        stream.send(
-                            pb.ProcessingResponse(
-                                immediate_response=envoy.make_immediate_response(
-                                    429, details="request shed"
-                                )
-                            )
-                        )
+                    except (ShedError, DeadlineExceeded) as e:
+                        stream.send(_shed_response(e))
                         return
                     stream.send(self._headers_response(ctx))
                     _ADMISSION_LANES[ctx.lane].observe(
@@ -520,14 +540,8 @@ class StreamingServer:
                     admission_t0 = time.perf_counter()
                     try:
                         result = self._pick(ctx, bytes(body))
-                    except ShedError:
-                        stream.send(
-                            pb.ProcessingResponse(
-                                immediate_response=envoy.make_immediate_response(
-                                    429, details="request shed"
-                                )
-                            )
-                        )
+                    except (ShedError, DeadlineExceeded) as e:
+                        stream.send(_shed_response(e))
                         return
                     if headers_deferred:
                         stream.send(self._headers_response(ctx))
@@ -641,6 +655,13 @@ class StreamingServer:
                     envoy.get_header_value(h)
                 )
 
+        # Deadline propagation (resilience/deadline.py): resolve the
+        # monotonic budget once, at header time. The no-deadline common
+        # case costs two dict lookups.
+        if (deadline_mod.GATEWAY_DEADLINE_HEADER in ctx.headers
+                or deadline_mod.ENVOY_TIMEOUT_HEADER in ctx.headers):
+            ctx.deadline_at = deadline_mod.deadline_from_headers(ctx.headers)
+
         # Subset hint from filter metadata: string ("ip1,ip2") or array forms
         # (reference request.go:51-77 — both Envoy pathways supported).
         # Requests without filter metadata (the overwhelming majority) skip
@@ -730,6 +751,13 @@ class StreamingServer:
                which previously re-parsed the same bytes
                (bbr/chain.py:78 + codec.py:108).
         """
+        if ctx.deadline_at and deadline_mod.expired(ctx.deadline_at):
+            # Budget already exhausted at admission (it queued behind
+            # flow control / a slow hop upstream): shed with 503 before
+            # the scheduler charges a TPU cycle for an answer nobody is
+            # waiting for.
+            own_metrics.DEADLINE_SHED.labels(stage="admission").inc()
+            raise DeadlineExceeded("admission")
         bbr_headers: dict[str, str] = {}
         bbr_body: Optional[bytes] = None
         parsed: Optional[dict] = None
@@ -792,6 +820,7 @@ class StreamingServer:
                 body=bbr_body if bbr_body is not None else body,
                 model=model,
                 decode_tokens=_decode_tokens(ctx.headers, parsed, scan),
+                deadline_at=ctx.deadline_at,
             ),
             ctx.candidates,
         )
@@ -850,6 +879,12 @@ class StreamingServer:
         extra = ctx.pick_result
         if extra is not None and extra.extra_headers:
             set_headers.update(extra.extra_headers)
+        if ctx.deadline_at:
+            # Surface the remaining budget so downstream hops (the model
+            # server, a nested gateway) can inherit it.
+            rem_ms = max(
+                deadline_mod.remaining_s(ctx.deadline_at), 0.0) * 1000.0
+            set_headers[deadline_mod.REMAINING_HEADER] = str(int(rem_ms))
         if self.fast_lane:
             return self._headers_templates.build(
                 set_headers, ctx.target_endpoint

@@ -5,7 +5,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from gie_tpu.lint import asynclint, baseline, locks, tomlmini, tracesafe
+from gie_tpu.lint import (
+    asynclint, baseline, daemonloop, locks, tomlmini, tracesafe)
 from gie_tpu.lint.model import RepoIndex, Violation
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -45,6 +46,7 @@ def run_paths(
     violations += locks.run(index, cfg, config_file=os.path.basename(config))
     violations += tracesafe.run(index, cfg)
     violations += asynclint.run(index, cfg)
+    violations += daemonloop.run(index, cfg)
     if rules is not None:
         violations = [
             v for v in violations

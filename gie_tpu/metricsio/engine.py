@@ -55,6 +55,8 @@ from typing import Optional
 
 from gie_tpu.metricsio.mappings import ServerMapping
 from gie_tpu.metricsio.store import MetricsStore
+from gie_tpu.resilience import faults
+from gie_tpu.resilience.policy import JITTER_SYMMETRIC, Backoff, BackoffPolicy
 from gie_tpu.utils.lora import LoraRegistry
 
 
@@ -71,10 +73,11 @@ class _Endpoint:
 
     __slots__ = (
         "slot", "url", "mapping", "host", "port", "path", "conn",
-        "due", "fail_streak", "last_success", "attached_at", "dead",
+        "due", "backoff", "last_success", "attached_at", "dead",
     )
 
-    def __init__(self, slot: int, url: str, mapping: ServerMapping):
+    def __init__(self, slot: int, url: str, mapping: ServerMapping,
+                 backoff: Backoff):
         self.slot = slot
         self.url = url
         self.mapping = mapping
@@ -85,10 +88,17 @@ class _Endpoint:
             f"?{parts.query}" if parts.query else "")
         self.conn: Optional[http.client.HTTPConnection] = None
         self.due = 0.0             # monotonic deadline for the next scrape
-        self.fail_streak = 0
+        # Shared resilience policy (gie_tpu/resilience/policy.py): the
+        # per-endpoint failure-streak state machine that used to be a bare
+        # counter plus inline 2**min(streak, 20) arithmetic here.
+        self.backoff = backoff
         self.last_success = 0.0    # monotonic; 0 = never scraped
         self.attached_at = time.monotonic()
         self.dead = False          # set under the engine lock on detach
+
+    @property
+    def fail_streak(self) -> int:
+        return self.backoff.failures
 
     def close_conn(self) -> None:
         conn, self.conn = self.conn, None
@@ -125,6 +135,7 @@ class ScrapeEngine:
         max_backoff_s: float = 1.0,
         timeout_s: Optional[float] = None,
         jitter: float = 0.1,
+        breaker_board=None,
     ):
         if interval_s <= 0:
             raise ValueError("interval_s must be > 0")
@@ -138,6 +149,22 @@ class ScrapeEngine:
         # Backoff never caps below the base interval (an operator running
         # a slow 2 s poll must not see failures SPEED polling up).
         self.max_backoff_s = max(max_backoff_s, interval_s)
+        # Shared jittered-backoff policy (resilience/policy.py) replacing
+        # the hand-rolled streak-exponent arithmetic: same shape — double
+        # per consecutive failure, exponent capped at 20, symmetric
+        # jitter, snap back to the base cadence on success (parity pinned
+        # by tests/test_resilience.py).
+        self._backoff_policy = BackoffPolicy(
+            base_s=interval_s,
+            max_s=self.max_backoff_s,
+            jitter=jitter,
+            jitter_mode=JITTER_SYMMETRIC,
+            max_exponent=20,
+        )
+        # Optional resilience.BreakerBoard: fetch outcomes feed the
+        # per-endpoint circuit breakers the pick path's candidate filter
+        # reads (docs/RESILIENCE.md).
+        self.breaker_board = breaker_board
         # Connect/read timeout: a SYN-black-holed pod (typical k8s death —
         # no RST) blocks its shard for the FULL timeout per attempt, so
         # the default scales with the poll cadence instead of inheriting
@@ -147,7 +174,6 @@ class ScrapeEngine:
         # backends.
         self.timeout_s = (timeout_s if timeout_s is not None
                           else min(2.0, max(5.0 * interval_s, 0.25)))
-        self.jitter = jitter
         self._lock = threading.Lock()
         self._live: dict[int, _Endpoint] = {}
         self._fetches = 0        # keep-alive path attempts (engine lock)
@@ -182,7 +208,8 @@ class ScrapeEngine:
                 # old state is dropped by its shard; the row survives
                 # (same pod identity, new address).
                 prev.dead = True
-            ep = _Endpoint(slot, url, mapping)
+            ep = _Endpoint(slot, url, mapping,
+                           Backoff(self._backoff_policy))
             # Phase-stagger the first scrape so a pool attached in one
             # reconcile sweep spreads over the interval instead of
             # thundering every tick in lockstep.
@@ -204,6 +231,10 @@ class ScrapeEngine:
             if ep is not None:
                 ep.dead = True
             self.store.remove(slot)
+        if self.breaker_board is not None:
+            # Breaker history must not outlive the endpoint: a reused
+            # slot starts CLOSED.
+            self.breaker_board.drop(slot)
         if ep is not None:
             self._shard_for(slot).wake.set()
 
@@ -261,6 +292,14 @@ class ScrapeEngine:
         """Keep-alive GET with a single fresh-connection retry (an idle
         keep-alive may be closed server-side between scrapes; only the
         retry's failure is a real endpoint failure)."""
+        if faults.ENABLED:
+            # gie-chaos fault points (resilience/faults.py): per-endpoint
+            # added latency / hang, then the fetch failure itself. Keyed
+            # by URL so a scenario can target a subset of the pool and a
+            # seed reproduces the same per-endpoint schedule.
+            faults.check("endpoint.slow", key=ep.url)
+            faults.check("endpoint.hang", key=ep.url)
+            faults.check("scrape.fetch", key=ep.url)
         if self.fetcher is not None:
             return self.fetcher(ep.url)
         fresh = ep.conn is None
@@ -289,9 +328,6 @@ class ScrapeEngine:
                 # else: stale keep-alive; retry once on a new connection.
         raise AssertionError("unreachable")
 
-    def _jittered(self, base: float) -> float:
-        return base * (1.0 + random.uniform(-self.jitter, self.jitter))
-
     def _scrape(self, ep: _Endpoint):
         """Fetch + parse one endpoint; reschedules ``ep.due``. Returns the
         store row tuple or None (failure / empty exposition)."""
@@ -307,27 +343,24 @@ class ScrapeEngine:
             # Unreachable endpoint: leave the last row (staleness shows up
             # via METRICS_AGE_S; the reference keeps stale metrics rather
             # than evicting) and back the poll off so a dead pod stops
-            # taxing the shard budget its live peers need.
-            ep.fail_streak += 1
-            # Exponent capped: the streak itself keeps counting (it is an
-            # observability signal), but 2.0**streak overflows a float
-            # past ~1024 consecutive failures — a pod down for 20 minutes
-            # must not crash its shard.
-            backoff = min(
-                self.interval_s * (2.0 ** min(ep.fail_streak, 20)),
-                self.max_backoff_s,
-            )
-            ep.due = time.monotonic() + self._jittered(backoff)
+            # taxing the shard budget its live peers need. The delay
+            # shape (exponent capped at 20, symmetric jitter, max_s
+            # ceiling) lives in the shared policy module now.
+            ep.due = time.monotonic() + ep.backoff.fail()
+            if self.breaker_board is not None:
+                self.breaker_board.record(ep.slot, False)
             return None
         done = time.monotonic()
         own_metrics.SCRAPE_FETCH.observe(done - t0)
         own_metrics.SCRAPE_STALENESS.observe(
             done - (ep.last_success or ep.attached_at))
         ep.last_success = done
-        ep.fail_streak = 0  # snap back to the base cadence
-        # Next deadline keyed off the fetch START, matching the legacy
-        # interval - elapsed pacing; never sooner than 1 ms out.
-        ep.due = max(t0 + self._jittered(self.interval_s), done + 0.001)
+        if self.breaker_board is not None:
+            self.breaker_board.record(ep.slot, True)
+        # Snap back to the base cadence; next deadline keyed off the fetch
+        # START, matching the legacy interval - elapsed pacing; never
+        # sooner than 1 ms out.
+        ep.due = max(t0 + ep.backoff.ok(), done + 0.001)
         if not metrics:
             return None
         return (ep, metrics, active, waiting)
@@ -357,6 +390,8 @@ class ScrapeEngine:
         own_metrics.SCRAPE_ENDPOINTS.set(n_live)
         own_metrics.SCRAPE_FAILS_MAX.set(streak)
         own_metrics.SCRAPE_REUSE.set(reuse)
+        if self.breaker_board is not None:
+            own_metrics.BREAKER_OPEN.set(self.breaker_board.open_count())
 
 
 class _Shard:

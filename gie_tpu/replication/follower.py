@@ -25,6 +25,8 @@ from typing import Callable, Optional
 
 from gie_tpu.replication import codec
 from gie_tpu.replication.publisher import DIGEST_PATH, EPOCH_HEADER, ERA_HEADER
+from gie_tpu.resilience import faults
+from gie_tpu.resilience.policy import Backoff, BackoffPolicy
 from gie_tpu.runtime.logging import get_logger
 
 # poll_once outcomes (metric label values; see runtime/metrics.py).
@@ -86,7 +88,16 @@ class FollowerSync:
         self.fetch_errors = 0
         self.last_delta = False        # last install was a delta frame
         self._want_full = True
-        self._backoff = interval_s
+        # Shared jittered-backoff policy (resilience/policy.py) replacing
+        # the hand-rolled double-from-base arithmetic: same shape —
+        # interval*2**streak capped at backoff_max_s, jitter strictly
+        # upward from this follower's seeded RNG (parity pinned by
+        # tests/test_resilience.py).
+        self._backoff = Backoff(
+            BackoffPolicy(base_s=interval_s, max_s=max(backoff_max_s,
+                                                       interval_s),
+                          jitter=jitter),
+            rng=self._rng)
         self._next_poll = 0.0          # monotonic deadline
 
     # ------------------------------------------------------------------ #
@@ -103,13 +114,7 @@ class FollowerSync:
         return max(self.leader_epoch - self.installed_epoch, 0)
 
     def _schedule(self, now: float, *, failed: bool) -> None:
-        if failed:
-            self._backoff = min(
-                max(self._backoff, self.interval_s) * 2.0,
-                self.backoff_max_s)
-        else:
-            self._backoff = self.interval_s
-        delay = self._backoff * (1.0 + self.jitter * self._rng.random())
+        delay = self._backoff.fail() if failed else self._backoff.ok()
         self._next_poll = now + delay
 
     def _http_fetch(self, base_url, since, era, etag):
@@ -148,6 +153,13 @@ class FollowerSync:
         if not self._want_full and self.installed_era is not None:
             since = self.installed_epoch
         try:
+            if faults.ENABLED:
+                # gie-chaos: a replication partition is a failing digest
+                # poll. FaultError is ConnectionError-shaped, so the
+                # handler below absorbs it into FETCH_ERROR + backoff —
+                # exactly the real-world path (and injected transports
+                # see the same schedule the HTTP one would).
+                faults.check("replication.poll", key=url)
             status, headers, body = self._fetch(
                 url, since, self.installed_era, self.last_etag)
         except Exception as e:

@@ -32,6 +32,7 @@ import zlib
 from typing import Callable, Optional
 
 from gie_tpu.replication.codec import build_digest, encode_section
+from gie_tpu.resilience import faults
 from gie_tpu.runtime.logging import get_logger
 
 DIGEST_PATH = "/replication/digest"
@@ -114,6 +115,15 @@ class StatePublisher:
             # the leader's, and chaining syncs through it would let stale
             # state win the anti-entropy race.
             return 503, {}, b"not leader"
+        verdict = None
+        if faults.ENABLED:
+            # gie-chaos: drawn OUTSIDE the publisher lock (a latency/hang
+            # verdict sleeps in fire()). ERROR models a leader that stops
+            # serving; CORRUPT flips a byte in the outgoing frame — the
+            # codec's CRC guard on the follower is what must absorb it.
+            verdict = faults.fire("replication.publish")
+            if verdict.kind == faults.ERROR:
+                return 503, {}, b"injected fault"
         with self._lock:
             if self._epoch == 0:
                 return 503, {}, b"no digest published yet"
@@ -140,7 +150,11 @@ class StatePublisher:
             else:
                 blob = build_digest(self._epoch, dict(self._payloads))
             headers["Content-Type"] = "application/octet-stream"
-            return 200, headers, blob
+        if verdict is not None and verdict.kind == faults.CORRUPT:
+            flipped = bytearray(blob)
+            flipped[len(flipped) // 2] ^= 0xFF
+            blob = bytes(flipped)
+        return 200, headers, blob
 
     def status(self) -> dict:
         with self._lock:

@@ -1,0 +1,54 @@
+"""gie-chaos + unified resilience layer (docs/RESILIENCE.md).
+
+Three pieces, one contract:
+
+  faults    seeded deterministic fault injection — named fault points
+            woven into the scrape engine, replication, the autoscale
+            actuator, the native admission scan, and the scheduler
+            dispatch path; strictly a module-flag check when disabled.
+  policy    the ONE jittered-backoff/retry implementation every daemon
+            loop uses (replication follower, scrape engine, autoscale
+            actuator) instead of three hand-rolled copies.
+  breaker   per-endpoint circuit breakers (error-streak open, half-open
+            probe, hysteretic close) feeding the pick path's candidate
+            filter and the scrape engine.
+  deadline  request deadline propagation: Envoy header -> admission ->
+            pick -> response; budget-exhausted requests shed with 503
+            before they burn TPU cycles.
+  ladder    the pick-path degradation ladder: full TPU pick ->
+            bounded-staleness cached-snapshot pick -> weighted
+            round-robin on last-known-good rows -> static subset,
+            entered on dispatch errors / metrics blackout / sustained
+            pick-latency breach, exited hysteretically.
+"""
+
+from gie_tpu.resilience.breaker import (        # noqa: F401
+    BreakerBoard,
+    BreakerConfig,
+    BreakerState,
+    CircuitBreaker,
+)
+from gie_tpu.resilience.deadline import (       # noqa: F401
+    DEADLINE_HEADERS,
+    DeadlineExceeded,
+    deadline_from_headers,
+    remaining_s,
+)
+from gie_tpu.resilience.faults import (         # noqa: F401
+    CATALOG,
+    FaultError,
+    FaultInjector,
+    FaultRule,
+    Verdict,
+)
+from gie_tpu.resilience.ladder import (         # noqa: F401
+    DegradationLadder,
+    LadderConfig,
+    ResilienceState,
+    Rung,
+)
+from gie_tpu.resilience.policy import (         # noqa: F401
+    Backoff,
+    BackoffPolicy,
+    retry_call,
+)

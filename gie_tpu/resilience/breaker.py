@@ -1,0 +1,189 @@
+"""Per-endpoint circuit breakers.
+
+A persistently failing endpoint today costs the pool forever: the
+scrape engine backs off its polls, but the PICK path keeps routing to
+it on stale last-known-good metrics until the datastore evicts the pod.
+The breaker closes that gap: an error streak OPENS the endpoint's
+breaker (the pick path's candidate filter drops it, the scrape engine
+clamps it to its slowest cadence), a dwell later it goes HALF_OPEN (one
+subsystem probe is allowed through), and only a hysteretic streak of
+successes CLOSES it again — one flapping success cannot un-quarantine a
+sick pod.
+
+State transitions are driven by whoever observes endpoint health — the
+scrape engine feeds fetch outcomes per slot — and read by everyone else
+through :class:`BreakerBoard`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+
+class BreakerState:
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+@dataclasses.dataclass(frozen=True)
+class BreakerConfig:
+    open_after: int = 5        # consecutive failures that OPEN
+    open_s: float = 2.0        # dwell before the half-open probe window
+    close_after: int = 2       # consecutive half-open successes to CLOSE
+
+    def __post_init__(self):
+        if self.open_after < 1 or self.close_after < 1 or self.open_s < 0:
+            raise ValueError("breaker thresholds must be positive")
+
+
+class CircuitBreaker:
+    """One endpoint's breaker. Not thread-safe on its own — the board
+    serializes access (one short lock per record/allow, far off any hot
+    path: outcomes arrive at scrape cadence, reads at pick cadence only
+    while at least one breaker is non-closed)."""
+
+    __slots__ = ("cfg", "clock", "state", "fail_streak", "ok_streak",
+                 "opened_at", "transitions")
+
+    def __init__(self, cfg: BreakerConfig,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg
+        self.clock = clock
+        self.state = BreakerState.CLOSED
+        self.fail_streak = 0
+        self.ok_streak = 0
+        self.opened_at = 0.0
+        self.transitions = 0
+
+    def _to(self, state: str) -> None:
+        if state != self.state:
+            self.state = state
+            self.transitions += 1
+            if state == BreakerState.OPEN:
+                self.opened_at = self.clock()
+
+    def record(self, ok: bool) -> None:
+        if ok:
+            self.fail_streak = 0
+            if self.state == BreakerState.HALF_OPEN:
+                self.ok_streak += 1
+                if self.ok_streak >= self.cfg.close_after:
+                    self._to(BreakerState.CLOSED)
+            elif self.state == BreakerState.OPEN:
+                # A success observed while OPEN (e.g. a data-plane
+                # fallback served): treat as an early probe result.
+                self.ok_streak = 1
+                self._to(BreakerState.HALF_OPEN)
+            return
+        self.ok_streak = 0
+        self.fail_streak += 1
+        if self.state == BreakerState.HALF_OPEN:
+            self._to(BreakerState.OPEN)   # probe failed: dwell again
+        elif (self.state == BreakerState.CLOSED
+              and self.fail_streak >= self.cfg.open_after):
+            self._to(BreakerState.OPEN)
+
+    def allow(self) -> bool:
+        """May traffic/probes reach this endpoint right now? OPEN flips
+        itself to HALF_OPEN once the dwell elapses (clock-driven, so a
+        quiet period still lets the probe window arrive)."""
+        if self.state == BreakerState.CLOSED:
+            return True
+        if self.state == BreakerState.OPEN:
+            if self.clock() - self.opened_at >= self.cfg.open_s:
+                self.ok_streak = 0
+                self._to(BreakerState.HALF_OPEN)
+                return True
+            return False
+        return True  # HALF_OPEN: probes flow; outcomes decide
+
+
+class BreakerBoard:
+    """Keyed breaker registry (key = endpoint slot). ``has_open`` is the
+    pick path's cheap guard: a plain bool read, maintained on every
+    state transition, so the per-request candidate filter costs one
+    attribute check while the whole pool is healthy."""
+
+    def __init__(self, cfg: Optional[BreakerConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg if cfg is not None else BreakerConfig()
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._breakers: dict[int, CircuitBreaker] = {}
+        self.has_open = False
+
+    def _refresh_has_open(self) -> None:
+        self.has_open = any(
+            b.state != BreakerState.CLOSED
+            for b in self._breakers.values())
+
+    def record(self, key: int, ok: bool) -> None:
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None:
+                if ok:
+                    return  # healthy unknown endpoint: nothing to track
+                b = CircuitBreaker(self.cfg, self.clock)
+                self._breakers[key] = b
+            before = b.state
+            b.record(ok)
+            if b.state != before:
+                self._refresh_has_open()
+
+    def allow(self, key: int) -> bool:
+        if not self.has_open:
+            return True
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None:
+                return True
+            before = b.state
+            verdict = b.allow()
+            if b.state != before:
+                self._refresh_has_open()
+            return verdict
+
+    def quarantined(self, key: int) -> bool:
+        """Read-only data-plane check: is this endpoint non-CLOSED?
+
+        Unlike :meth:`allow`, this never advances OPEN to HALF_OPEN —
+        the half-open probe budget belongs to the subsystem that records
+        outcomes (the scrape engine), not to data-plane picks: a pick
+        admitted as a "probe" whose outcome is never recorded would
+        re-expose live traffic to a sick endpoint without ever helping
+        the breaker close.
+        """
+        if not self.has_open:
+            return False
+        with self._lock:
+            b = self._breakers.get(key)
+            return b is not None and b.state != BreakerState.CLOSED
+
+    def state(self, key: int) -> str:
+        with self._lock:
+            b = self._breakers.get(key)
+            return b.state if b is not None else BreakerState.CLOSED
+
+    def states(self) -> dict[int, str]:
+        """Non-closed breakers only (the health/ops report)."""
+        with self._lock:
+            return {
+                k: b.state for k, b in self._breakers.items()
+                if b.state != BreakerState.CLOSED
+            }
+
+    def open_count(self) -> int:
+        with self._lock:
+            return sum(1 for b in self._breakers.values()
+                       if b.state == BreakerState.OPEN)
+
+    def drop(self, key: int) -> None:
+        """Endpoint evicted: its breaker history must not outlive it (a
+        reused slot starts CLOSED)."""
+        with self._lock:
+            if self._breakers.pop(key, None) is not None:
+                self._refresh_has_open()

@@ -1,0 +1,248 @@
+"""Pick-path degradation ladder.
+
+The batched TPU pick is the best scheduler this gateway has — and the
+only one the seed had. A device-dispatch failure, a metrics blackout,
+or a pick path suddenly taking seconds used to mean UNAVAILABLE for
+every request until a human intervened. The ladder gives the pick path
+defined degraded modes instead, each strictly dumber and strictly more
+dependable than the one above:
+
+  FULL         the batched device cycle (scorers, prefix affinity, OT)
+  CACHED       host-side pick over the bounded-staleness metrics rows
+               (least queue+KV, assumed-load spread within the wave) —
+               for when the DEVICE is sick but the data is fresh
+  ROUND_ROBIN  smooth weighted round-robin over last-known-good rows —
+               for when the data went dark too (metrics blackout)
+  STATIC       plain rotation over a fixed subset of live endpoints —
+               the "never 503 the whole pool" floor
+
+Descent is immediate (an error streak, a blackout, a latency breach);
+ascent is hysteretic: a minimum dwell on the current rung plus a streak
+of successful full-path probes, so a flapping device cannot oscillate
+the pool between scheduling regimes. `gie_degraded_mode` exports the
+current rung; the health endpoint's "resilience" sub-service reports it
+with breaker states.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+from typing import Callable, Optional
+
+from gie_tpu.resilience.breaker import BreakerBoard
+
+
+class Rung(enum.IntEnum):
+    FULL = 0
+    CACHED = 1
+    ROUND_ROBIN = 2
+    STATIC = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class LadderConfig:
+    # Descent triggers.
+    dispatch_error_streak: int = 3    # consecutive device errors -> down
+    blackout_stale_s: float = 5.0     # metrics older than this -> RR floor
+    latency_breach_s: float = 1.0     # a "slow" full pick
+    latency_breach_streak: int = 8    # consecutive slow picks -> CACHED
+    # Hysteretic ascent.
+    recover_streak: int = 4           # successful probes to climb one rung
+    min_dwell_s: float = 2.0          # min time on a rung before climbing
+    probe_interval_s: float = 1.0     # full-path probe cadence while down
+    # Blackout recovery hysteresis: staleness must fall back below
+    # blackout_stale_s * this fraction before the RR floor lifts.
+    blackout_recover_fraction: float = 0.5
+
+    def __post_init__(self):
+        if (self.dispatch_error_streak < 1 or self.recover_streak < 1
+                or self.latency_breach_streak < 1):
+            raise ValueError("ladder streaks must be >= 1")
+        if not (0.0 < self.blackout_recover_fraction <= 1.0):
+            raise ValueError("blackout_recover_fraction must be in (0, 1]")
+
+
+class DegradationLadder:
+    """Thread-safe rung state machine. ``note_*`` feeds come from the
+    batching collector (dispatch outcomes, per-wave) and whoever owns a
+    staleness clock (the scrape engine via ResilienceState.observe);
+    ``rung()`` is read per wave, never per request."""
+
+    def __init__(
+        self,
+        cfg: Optional[LadderConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        on_change: Optional[Callable[[int], None]] = None,
+    ):
+        self.cfg = cfg if cfg is not None else LadderConfig()
+        self.clock = clock
+        self.on_change = on_change
+        self._lock = threading.Lock()
+        self._level = Rung.FULL          # error-driven component
+        self._blackout_floor = Rung.FULL  # staleness-driven component
+        self._err_streak = 0
+        self._ok_streak = 0
+        self._slow_streak = 0
+        self._changed_at = clock()
+        self._last_probe = 0.0
+        self.transitions: list[tuple[float, int]] = []  # (t, rung) trace
+
+    # -- reads -------------------------------------------------------------
+
+    def rung(self) -> Rung:
+        with self._lock:
+            return self._effective()
+
+    def _effective(self) -> Rung:
+        return Rung(max(self._level, self._blackout_floor))
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "rung": int(self._effective()),
+                "rung_name": self._effective().name,
+                "level": int(self._level),
+                "blackout_floor": int(self._blackout_floor),
+                "error_streak": self._err_streak,
+                "since_s": max(self.clock() - self._changed_at, 0.0),
+            }
+
+    # -- feeds -------------------------------------------------------------
+
+    def _set(self, level: Optional[Rung] = None,
+             floor: Optional[Rung] = None) -> None:
+        """Caller holds the lock. Records transitions of the EFFECTIVE
+        rung and fires on_change for them."""
+        before = self._effective()
+        if level is not None:
+            self._level = level
+        if floor is not None:
+            self._blackout_floor = floor
+        after = self._effective()
+        if after != before:
+            self._changed_at = self.clock()
+            self.transitions.append((self._changed_at, int(after)))
+            if self.on_change is not None:
+                self.on_change(int(after))
+
+    def note_dispatch_error(self) -> None:
+        """A device dispatch/materialize failure (full path only)."""
+        with self._lock:
+            self._ok_streak = 0
+            self._err_streak += 1
+            if (self._err_streak >= self.cfg.dispatch_error_streak
+                    and self._level < Rung.STATIC):
+                self._err_streak = 0
+                self._set(level=Rung(self._level + 1))
+
+    def note_dispatch_ok(self, latency_s: float = 0.0) -> None:
+        """A successful full-path wave (steady state or probe)."""
+        cfg = self.cfg
+        with self._lock:
+            self._err_streak = 0
+            if latency_s > cfg.latency_breach_s:
+                # A breaching wave is NOT a recovery signal: counting it
+                # toward the ascent streak would let a consistently-slow
+                # device climb back to FULL, route the pool through the
+                # breached path until the slow streak demotes it again,
+                # and oscillate forever — the exact flap the hysteresis
+                # exists to prevent. Slow probes keep the ladder down.
+                self._ok_streak = 0
+                self._slow_streak += 1
+                if (self._slow_streak >= cfg.latency_breach_streak
+                        and self._level < Rung.CACHED):
+                    # Sustained pick-latency breach: the full path is
+                    # technically alive but violating its budget — the
+                    # cached pick answers in microseconds instead.
+                    self._slow_streak = 0
+                    self._set(level=Rung.CACHED)
+                return
+            self._slow_streak = 0
+            if self._level == Rung.FULL:
+                return
+            self._ok_streak += 1
+            if (self._ok_streak >= cfg.recover_streak
+                    and self.clock() - self._changed_at >= cfg.min_dwell_s):
+                self._ok_streak = 0
+                self._set(level=Rung(self._level - 1))
+
+    def note_metrics_staleness(self, stale_s: float) -> None:
+        """Ingestion-side staleness (the scrape engine's own clocks).
+        A blackout floors the ladder at ROUND_ROBIN — the cached rows
+        CACHED picks from are exactly what went stale."""
+        cfg = self.cfg
+        with self._lock:
+            if stale_s > cfg.blackout_stale_s:
+                if self._blackout_floor < Rung.ROUND_ROBIN:
+                    self._set(floor=Rung.ROUND_ROBIN)
+            elif (self._blackout_floor > Rung.FULL
+                  and stale_s < cfg.blackout_stale_s
+                  * cfg.blackout_recover_fraction):
+                self._set(floor=Rung.FULL)
+
+    def should_probe(self) -> bool:
+        """While degraded by LEVEL, let one wave through the full path
+        every probe interval — its outcome is the ascent signal. A pure
+        blackout floor is not probed here (the full path would still
+        score on dark data); it lifts from the staleness feed."""
+        with self._lock:
+            if self._level == Rung.FULL:
+                return False
+            now = self.clock()
+            if now - self._last_probe >= self.cfg.probe_interval_s:
+                self._last_probe = now
+                return True
+            return False
+
+
+class ResilienceState:
+    """The bundle the runner threads through the stack: one breaker
+    board (scrape engine writes, pick path reads), one ladder (batching
+    collector drives), one staleness source (engine clocks), and the
+    static-subset size for the bottom rung."""
+
+    def __init__(
+        self,
+        board: Optional[BreakerBoard] = None,
+        ladder: Optional[DegradationLadder] = None,
+        staleness_fn: Optional[Callable[[], float]] = None,
+        static_subset: int = 4,
+        on_change: Optional[Callable[[int], None]] = None,
+    ):
+        self.board = board if board is not None else BreakerBoard()
+        self.ladder = ladder if ladder is not None else DegradationLadder(
+            on_change=on_change)
+        if ladder is None and on_change is None:
+            # Default observability: the ladder drives gie_degraded_mode
+            # directly (runtime.metrics is import-light).
+            from gie_tpu.runtime import metrics as own_metrics
+
+            self.ladder.on_change = (
+                lambda r: own_metrics.DEGRADED_MODE.set(r))
+        self.staleness_fn = staleness_fn
+        self.static_subset = max(static_subset, 1)
+
+    def observe(self) -> None:
+        """Per-wave tick from the batching collector: fold the staleness
+        clock into the ladder. Cheap (one callable + one lock) and wave-
+        cadence, never request-cadence."""
+        if self.staleness_fn is not None:
+            try:
+                self.ladder.note_metrics_staleness(float(self.staleness_fn()))
+            except Exception:
+                pass  # a broken staleness source must not fail picks
+
+    def healthy(self) -> bool:
+        """The health endpoint's 'resilience' sub-service predicate."""
+        return (self.ladder.rung() == Rung.FULL
+                and not self.board.has_open)
+
+    def report(self) -> dict:
+        return {
+            **self.ladder.report(),
+            "breakers": self.board.states(),
+            "breakers_open": self.board.open_count(),
+        }

@@ -27,9 +27,14 @@ HEALTH_SERVICE = "grpc.health.v1.Health"
 # replication manager is wired) = this replica is a warm takeover target:
 # leading, or synced within the staleness bound (docs/REPLICATION.md) —
 # the probe a rollout controller asks before trusting a standby.
+# "resilience" (when the resilience layer is wired) = the pick path is on
+# the FULL ladder rung with no open circuit breakers; NOT_SERVING means
+# degraded-but-serving (docs/RESILIENCE.md) — an alerting signal, never a
+# traffic gate (readiness stays SERVING on purpose while degraded).
 LIVENESS_SERVICE = "liveness"
 READINESS_SERVICE = "readiness"
 REPLICATION_SERVICE = "replication"
+RESILIENCE_SERVICE = "resilience"
 
 SERVING = health_pb2.HealthCheckResponse.SERVING
 NOT_SERVING = health_pb2.HealthCheckResponse.NOT_SERVING
@@ -42,9 +47,11 @@ class HealthService:
         self,
         ready_fn: Callable[[], bool],
         replication_fn: Callable[[], bool] | None = None,
+        resilience_fn: Callable[[], bool] | None = None,
     ):
         self.ready_fn = ready_fn
         self.replication_fn = replication_fn
+        self.resilience_fn = resilience_fn
 
     def _status(self, service: str) -> int:
         if service == LIVENESS_SERVICE:
@@ -53,6 +60,10 @@ class HealthService:
             if self.replication_fn is None:
                 return health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
             return SERVING if self.replication_fn() else NOT_SERVING
+        if service == RESILIENCE_SERVICE:
+            if self.resilience_fn is None:
+                return health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+            return SERVING if self.resilience_fn() else NOT_SERVING
         known = ("", READINESS_SERVICE, EXTPROC_SERVICE, HEALTH_SERVICE)
         if service not in known:
             return health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
@@ -94,6 +105,7 @@ def start_dedicated_health_server(
     ready_fn: Callable[[], bool],
     port: int,
     replication_fn: Callable[[], bool] | None = None,
+    resilience_fn: Callable[[], bool] | None = None,
 ) -> tuple[grpc.Server, int]:
     """The dedicated health listener, started BEFORE the manager/cache sync
     so probes get NOT_SERVING instead of connection refused (reference
@@ -103,7 +115,8 @@ def start_dedicated_health_server(
     # Watch handlers hold a worker for their stream's lifetime; size the
     # pool so long-lived watchers cannot starve Check probes.
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=10))
-    HealthService(ready_fn, replication_fn).add_to_server(server)
+    HealthService(ready_fn, replication_fn, resilience_fn).add_to_server(
+        server)
     bound = server.add_insecure_port(f"0.0.0.0:{port}")
     if bound == 0:
         raise OSError(f"failed to bind health port {port}")

@@ -214,6 +214,33 @@ REPLICATION_SYNCS = prom.Counter(
     ["outcome"],
     registry=REGISTRY,
 )
+# Unified resilience layer (gie_tpu/resilience, docs/RESILIENCE.md): the
+# degradation ladder's current rung (0 = full TPU pick, 1 = cached-
+# snapshot pick, 2 = weighted round-robin, 3 = static subset), breaker
+# quarantine, deadline shedding, and degraded-pick volume.
+DEGRADED_MODE = prom.Gauge(
+    "gie_degraded_mode",
+    "Pick-path degradation ladder rung (0 full TPU pick, 1 cached-"
+    "snapshot pick, 2 weighted round-robin, 3 static subset)",
+    registry=REGISTRY,
+)
+DEGRADED_PICKS = prom.Counter(
+    "gie_degraded_picks_total",
+    "Picks served by a degraded ladder rung",
+    ["rung"],  # cached|round_robin|static
+    registry=REGISTRY,
+)
+BREAKER_OPEN = prom.Gauge(
+    "gie_breaker_open_endpoints",
+    "Endpoints currently quarantined by an OPEN circuit breaker",
+    registry=REGISTRY,
+)
+DEADLINE_SHED = prom.Counter(
+    "gie_deadline_shed_total",
+    "Requests shed with 503 because their propagated deadline expired",
+    ["stage"],  # admission|queue
+    registry=REGISTRY,
+)
 
 
 _POOL_SNAPSHOT = {"fn": lambda: {}, "registered": False,

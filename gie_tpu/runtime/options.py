@@ -110,6 +110,21 @@ class Options:
     # a standby that has not confirmed the leader's state within this
     # window reports NOT_SERVING (it would promote cold-ish).
     replication_stale_after_s: float = 10.0
+    # Unified resilience layer (gie_tpu/resilience, docs/RESILIENCE.md):
+    # per-endpoint circuit breakers fed by scrape outcomes, the pick-path
+    # degradation ladder (full TPU pick -> cached-snapshot -> weighted
+    # round-robin -> static subset), and the "resilience" health
+    # sub-service. On by default; --no-resilience restores seed behavior
+    # (device/dispatch failures fail the affected wave's requests).
+    resilience: bool = True
+    # STATIC rung pool size: the fixed endpoint subset the bottom ladder
+    # rung rotates over.
+    resilience_static_subset: int = 4
+    # gie-chaos fault injection (resilience/faults.py): repeatable
+    # "point=kind:prob[:arg],..." specs plus the schedule seed. Empty =
+    # injection disabled (zero hot-path cost beyond one flag check).
+    fault_specs: list = dataclasses.field(default_factory=list)
+    fault_seed: int = 0
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -253,6 +268,28 @@ class Options:
                             dest="objectives", metavar="NAME=CRITICALITY",
                             help="register an InferenceObjective "
                                  "(repeatable), e.g. premium-chat=3")
+        parser.add_argument("--resilience", dest="resilience",
+                            action="store_true", default=d.resilience,
+                            help="circuit breakers + pick-path "
+                                 "degradation ladder (docs/RESILIENCE.md)")
+        parser.add_argument("--no-resilience", dest="resilience",
+                            action="store_false",
+                            help="disable the resilience layer (seed "
+                                 "behavior: device failures fail the "
+                                 "affected wave)")
+        parser.add_argument("--resilience-static-subset", type=int,
+                            default=d.resilience_static_subset,
+                            help="endpoint pool size of the STATIC "
+                                 "ladder rung")
+        parser.add_argument("--fault", action="append", default=[],
+                            dest="fault_specs",
+                            metavar="POINT=KIND:PROB[:ARG],...",
+                            help="gie-chaos fault injection spec "
+                                 "(repeatable), e.g. "
+                                 "scrape.fetch=error:0.2,latency:0.1:80ms")
+        parser.add_argument("--fault-seed", type=int, default=d.fault_seed,
+                            help="seed for the deterministic fault "
+                                 "schedule")
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "Options":
@@ -298,6 +335,10 @@ class Options:
             replication_advertise=args.replication_advertise,
             replication_interval_s=args.replication_interval_s,
             replication_stale_after_s=args.replication_stale_after_s,
+            resilience=args.resilience,
+            resilience_static_subset=args.resilience_static_subset,
+            fault_specs=list(args.fault_specs),
+            fault_seed=args.fault_seed,
         )
 
     def validate(self) -> None:
@@ -359,6 +400,15 @@ class Options:
                 raise ValueError("--autoscale-interval-s must be > 0")
             if self.autoscale_ttft_slo_ms < 0:
                 raise ValueError("--autoscale-ttft-slo-ms must be >= 0")
+        if self.resilience_static_subset < 1:
+            raise ValueError("--resilience-static-subset must be >= 1")
+        if self.fault_specs:
+            from gie_tpu.resilience import faults as _faults
+
+            try:
+                _faults.parse_spec(self.fault_specs)
+            except ValueError as e:
+                raise ValueError(f"--fault: {e}") from None
         for spec in self.objectives:
             name, sep, crit = spec.partition("=")
             if not sep or not name:

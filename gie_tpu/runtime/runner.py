@@ -123,6 +123,16 @@ class ExtProcServerRunner:
                 self.scheduler.gate_latency_column(self.trainer.confidence())
         self.metrics_store = MetricsStore()
         self.mapping = BY_NAME[opts.model_server_type]
+        # Unified resilience layer (gie_tpu/resilience, docs/RESILIENCE.md):
+        # one breaker board (scrape outcomes write, pick path reads), one
+        # degradation ladder (batching collector drives), the scrape
+        # engine's own staleness clock as the blackout signal.
+        self.resilience = None
+        if opts.resilience:
+            from gie_tpu.resilience import ResilienceState
+
+            self.resilience = ResilienceState(
+                static_subset=opts.resilience_static_subset)
         # Multiplexed keep-alive scrape engine (metricsio/engine.py,
         # docs/METRICSIO.md): a fixed shard pool polls every endpoint at
         # the fast-poll cadence; attach/detach below are O(1) so endpoint
@@ -134,7 +144,15 @@ class ExtProcServerRunner:
             lora=self.lora_registry,
             interval_s=opts.scrape_interval_ms / 1000.0,
             workers=opts.scrape_workers or None,
+            breaker_board=(self.resilience.board
+                           if self.resilience is not None else None),
         )
+        if self.resilience is not None:
+            # The engine's last-success clocks are the blackout signal:
+            # they cover ingestion-side outages (every endpoint
+            # unreachable and backing off, a wedged shard) that row ages
+            # alone miss.
+            self.resilience.staleness_fn = self.scraper.staleness_seconds
         self.datastore = Datastore(on_slot_reclaimed=self._slot_reclaimed)
         self._overflow_logged = 0
         self.picker = BatchingTPUPicker(
@@ -150,6 +168,7 @@ class ExtProcServerRunner:
             # background-compiles its remaining N buckets, so a load spike
             # never stalls the dispatcher on first-use jit (ROADMAP item).
             background_warm=True,
+            resilience=self.resilience,
         )
         own_metrics.register_pool_aggregates(self._pool_snapshot)
         self._train_stop = threading.Event()
@@ -448,9 +467,23 @@ class ExtProcServerRunner:
                 advertise=self.replication.advertise,
                 interval_s=self.opts.replication_interval_s,
             )
+        if self.opts.fault_specs:
+            # gie-chaos (resilience/faults.py): arm the seeded injector.
+            # Operator-driven chaos experiments only — production runs
+            # leave this off and pay one flag check per woven site.
+            from gie_tpu.resilience import faults
+
+            faults.install(faults.FaultInjector(
+                self.opts.fault_seed,
+                faults.parse_spec(self.opts.fault_specs)))
+            self.log.info("fault injection armed",
+                          seed=self.opts.fault_seed,
+                          specs=self.opts.fault_specs)
         self.health_server, _ = start_dedicated_health_server(
             self.ready, self.opts.grpc_health_port,
             self.replication.healthy if self.replication is not None
+            else None,
+            self.resilience.healthy if self.resilience is not None
             else None,
         )
         try:
@@ -464,6 +497,8 @@ class ExtProcServerRunner:
         HealthService(
             self.ready,
             self.replication.healthy if self.replication is not None
+            else None,
+            self.resilience.healthy if self.resilience is not None
             else None,
         ).add_to_server(server)
         addr = f"0.0.0.0:{self.opts.grpc_port}"
@@ -578,6 +613,10 @@ class ExtProcServerRunner:
             self.kv_events_server.close()
         self.picker.close()
         self.scraper.close()
+        if self.opts.fault_specs:
+            from gie_tpu.resilience import faults
+
+            faults.uninstall()
         if self.elector is not None:
             self.elector.stop()
         if self._cert_reloader is not None:

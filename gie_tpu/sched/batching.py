@@ -38,6 +38,9 @@ from gie_tpu.extproc.server import (
     ShedError,
 )
 from gie_tpu.extproc import metadata as mdkeys
+from gie_tpu.resilience import deadline as deadline_mod
+from gie_tpu.resilience import faults
+from gie_tpu.resilience.ladder import ResilienceState, Rung
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.hashing import batch_chunk_hashes
 from gie_tpu.models.latency import host_features
@@ -232,6 +235,7 @@ class BatchingTPUPicker:
         queue_max_age_s: float = 0.0,
         pipeline_depth=2,
         background_warm: bool = False,
+        resilience: Optional[ResilienceState] = None,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
@@ -335,6 +339,17 @@ class BatchingTPUPicker:
         self.background_warm = background_warm
         self._warmed_lattices: set[tuple[int, int]] = set()
         self._warm_threads: list[threading.Thread] = []
+        # Unified resilience layer (gie_tpu/resilience, docs/RESILIENCE.md):
+        # breaker board filtering candidates, degradation ladder deciding
+        # per WAVE whether this wave takes the full device path, a probe
+        # wave, or a host-side degraded pick. None = seed behavior.
+        self.resilience = resilience
+        # Smooth-weighted-round-robin credit per slot and the static-
+        # subset rotation cursor (degraded rungs; collector/completer
+        # threads only — the two never pick the same wave).
+        self._wrr_credit: dict[int, float] = {}
+        self._static_rr = 0
+        self._degraded_lock = threading.Lock()
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
         self._completer = threading.Thread(
@@ -668,6 +683,23 @@ class BatchingTPUPicker:
         # Timed-out callers are gone: scheduling their items would charge
         # assumed load with no served feedback to ever release it.
         batch = [it for it in batch if not it.abandoned]
+        if batch:
+            # Deadline propagation (resilience/deadline.py): a pick whose
+            # request budget expired while queued sheds with 503 BEFORE
+            # the wave charges any device work — nobody is waiting for
+            # the answer. Requests without a deadline header carry 0.0
+            # and cost one float compare here.
+            now = time.monotonic()
+            kept: list[_Pending] = []
+            for it in batch:
+                d = it.req.deadline_at
+                if d and now >= d:
+                    it.error = deadline_mod.DeadlineExceeded("queue")
+                    it.event.set()
+                    own_metrics.DEADLINE_SHED.labels(stage="queue").inc()
+                else:
+                    kept.append(it)
+            batch = kept
         if self.queue_max_age_s > 0 and batch:
             # Age bound: a non-critical pick that has waited beyond the
             # bound sheds with 429 instead of occupying a wave slot —
@@ -716,6 +748,32 @@ class BatchingTPUPicker:
             batch = runnable
             if not batch:
                 return held
+        rs = self.resilience
+        if rs is not None:
+            # Per-WAVE resilience decision (never per request): fold the
+            # staleness clock into the ladder, then either serve this
+            # wave host-side on the current degraded rung or let it
+            # through the full device path (always when FULL; as a probe
+            # at probe cadence while level-degraded).
+            rs.observe()
+            rung = rs.ladder.rung()
+            if rung != Rung.FULL and not rs.ladder.should_probe():
+                self._degraded_pick(batch, rung)
+                return held
+            if rs.board.has_open:
+                # Breaker candidate filter: quarantined endpoints drop
+                # out of each item's candidate set — unless that would
+                # empty it (availability beats quarantine; the breaker's
+                # own half-open probes need traffic to heal).
+                for it in batch:
+                    allowed = [ep for ep in it.candidates
+                               if not rs.board.quarantined(
+                                   getattr(ep, "slot", -1))]
+                    if allowed and len(allowed) < len(it.candidates):
+                        it.candidates = allowed
+                        it.cand_slots = np.fromiter(
+                            (getattr(ep, "slot", -1) for ep in allowed),
+                            np.int64, len(allowed))
         t0 = time.perf_counter()
         n = len(batch)
         endpoints = self.datastore.endpoints()
@@ -729,8 +787,21 @@ class BatchingTPUPicker:
         # state; the copy is ordered after this cycle and before the next
         # under the scheduler lock, and survives the next cycle's buffer
         # donation).
-        pending = self.scheduler.pick_async(
-            reqs, eps, snapshot_load=self.trainer is not None)
+        try:
+            if faults.ENABLED:
+                faults.check("device.dispatch")
+            pending = self.scheduler.pick_async(
+                reqs, eps, snapshot_load=self.trainer is not None)
+        except Exception:
+            if rs is None:
+                raise  # seed behavior: the collector fails the waiters
+            # Device dispatch failed: feed the ladder and serve THIS wave
+            # host-side at CACHED or worse — a sick device must cost a
+            # slower pick, never an UNAVAILABLE storm.
+            rs.ladder.note_dispatch_error()
+            self._degraded_pick(
+                batch, Rung(max(rs.ladder.rung(), Rung.CACHED)))
+            return held
         lattice = (mb, int(reqs.chunk_hashes.shape[1]))
         if self.background_warm and lattice not in self._warmed_lattices:
             self._warmed_lattices.add(lattice)
@@ -766,7 +837,24 @@ class BatchingTPUPicker:
         # wave's waiters, then the next wave is served regardless — device
         # fault isolation at wave granularity.
         while True:
-            wave = self._waves.get()
+            # Bounded receive (GR001): the sentinel is the normal exit,
+            # but if close()'s put times out (queue full, wedged pipeline)
+            # the loop must still observe shutdown rather than park
+            # forever. _closed alone is NOT an exit condition — close()
+            # flips it before the dispatcher drains, and a dispatcher
+            # wedged in a first-use jit compile still pushes its
+            # already-collected waves afterward (the drain-don't-abandon
+            # contract). Exit only once the dispatcher is gone AND the
+            # queue is verifiably empty: with the producer dead, the
+            # queue can only shrink, so the snapshot is sound (close()
+            # fails any residual orphans after we exit).
+            try:
+                wave = self._waves.get(timeout=1.0)
+            except queue.Empty:
+                if (self._closed and not self._worker.is_alive()
+                        and self._waves.empty()):
+                    return
+                continue
             if wave is _CLOSE:
                 return
             # Release the in-flight slot at PICKUP, not completion: the
@@ -796,9 +884,27 @@ class BatchingTPUPicker:
         """Materialize one wave's device results and fan them out."""
         batch, plen, dlen, lora = wave.batch, wave.plen, wave.dlen, wave.lora
         t0 = time.perf_counter()
-        result = wave.pending.materialize()
+        try:
+            result = wave.pending.materialize()
+        except Exception:
+            if self.resilience is None:
+                raise  # seed behavior: _completer_loop fails the waiters
+            # The dispatched cycle died on device: descend the ladder and
+            # serve this wave's waiters host-side instead of failing them
+            # — wave fault isolation upgraded from "contained" to
+            # "answered".
+            self.resilience.ladder.note_dispatch_error()
+            self._degraded_pick(
+                batch,
+                Rung(max(self.resilience.ladder.rung(), Rung.CACHED)))
+            return
         wait_s = time.perf_counter() - t0
         own_metrics.DEVICE_WAIT.observe(wait_s)
+        if self.resilience is not None:
+            # Full-path success (steady state or a probe wave while
+            # degraded): the ladder's ascent signal, with the device wait
+            # as the pick-latency-breach clock.
+            self.resilience.ladder.note_dispatch_ok(latency_s=wait_s)
         if self._depth_auto:
             self._cycle_ewma = (wait_s if self._cycle_ewma == 0.0
                                 else 0.9 * self._cycle_ewma + 0.1 * wait_s)
@@ -840,6 +946,18 @@ class BatchingTPUPicker:
                 picked_slots = [
                     int(s) for s in indices[i] if s >= 0 and s in by_slot
                 ]
+                rs = self.resilience
+                if rs is not None and rs.board.has_open and picked_slots:
+                    # The subset mask constrained the PRIMARY at dispatch,
+                    # but the ranked fallback tail spans the whole pool —
+                    # a quarantined endpoint must not ride along as a
+                    # data-plane failover target. Keep the raw list only
+                    # if filtering would empty it (availability beats
+                    # quarantine, same rule as the dispatch-side filter).
+                    healthy = [s for s in picked_slots
+                               if not rs.board.quarantined(s)]
+                    if healthy:
+                        picked_slots = healthy
                 picked = [by_slot[s].hostport for s in picked_slots]
                 if not picked:
                     own_metrics.PICKS.labels(outcome="unavailable").inc()
@@ -898,6 +1016,116 @@ class BatchingTPUPicker:
             if item.result is not None:
                 own_metrics.PICKS.labels(outcome="ok").inc()
             item.event.set()
+
+    # -- degraded pick path (resilience ladder rungs 1-3) ------------------
+
+    _RUNG_LABELS = {
+        Rung.CACHED: "cached",
+        Rung.ROUND_ROBIN: "round_robin",
+        Rung.STATIC: "static",
+    }
+
+    def _degraded_pick(self, batch: list[_Pending], rung: Rung) -> None:
+        """Serve one wave entirely host-side on a degraded ladder rung
+        (docs/RESILIENCE.md):
+
+          CACHED       least (queue-depth + scaled KV) over the bounded-
+                       staleness metrics rows, with an in-wave spread so
+                       a burst does not pile onto one endpoint.
+          ROUND_ROBIN  smooth weighted round-robin on the last-known-good
+                       rows (weights from queue depth; stale data is only
+                       trusted as a static weight, not a live signal).
+          STATIC       plain rotation over a fixed subset of live
+                       endpoints — the "never 503 the whole pool" floor.
+
+        No device state is touched: nothing is charged (charged_slot = -1
+        makes observe_served's slot-match guard skip the release), no
+        prefix inserts, no tick. Called from the dispatcher (rung gate,
+        dispatch failure) or the completer (materialize failure), never
+        both for one wave; the shared WRR/rotation cursors are behind
+        _degraded_lock."""
+        endpoints = self.datastore.endpoints()
+        by_slot = {ep.slot: ep for ep in endpoints}
+        rs = self.resilience
+        if rs is not None and rs.board.has_open and len(by_slot) > 1:
+            allowed = {s for s in by_slot if not rs.board.quarantined(s)}
+            if allowed:  # quarantine never empties the pool
+                by_slot = {s: ep for s, ep in by_slot.items()
+                           if s in allowed}
+        live = sorted(by_slot)
+        if not live:
+            for item in batch:
+                item.error = ExtProcError(
+                    grpc.StatusCode.UNAVAILABLE, "no endpoints available")
+                item.event.set()
+                own_metrics.PICKS.labels(outcome="unavailable").inc()
+            return
+        label = self._RUNG_LABELS.get(rung, "static")
+        # Last-known-good rows: queue depth + KV utilization, read once
+        # per wave. On the RR/STATIC rungs these may be arbitrarily stale
+        # — they only shape static weights there.
+        rows, _ages = self.metrics_store.pool_rows(live)
+        queue = rows[:, C.Metric.QUEUE_DEPTH].astype(np.float64)
+        kv = rows[:, C.Metric.KV_CACHE_UTIL].astype(np.float64)
+        col_of = {s: i for i, s in enumerate(live)}
+        with self._degraded_lock:
+            # Slot hygiene: WRR credit/debt must not outlive the endpoint
+            # that earned it — a reclaimed slot's NEW pod starts at zero
+            # instead of inheriting the old pod's debt, and the dict
+            # stays bounded by the pool (prune against the unfiltered
+            # endpoint set so a merely-quarantined slot keeps its credit).
+            if self._wrr_credit:
+                pool_slots = {ep.slot for ep in endpoints}
+                for s in [s for s in self._wrr_credit
+                          if s not in pool_slots]:
+                    del self._wrr_credit[s]
+            if rung == Rung.STATIC:
+                subset = live[: max(
+                    rs.static_subset if rs is not None else 4, 1)]
+            for item in batch:
+                cands = [int(s) for s in item.cand_slots if s in by_slot]
+                if not cands:
+                    cands = live
+                if rung == Rung.CACHED:
+                    # Fresh-enough data: least queue+KV now, plus an
+                    # in-wave +1 spread per assignment.
+                    scores = [queue[col_of[s]] + 8.0 * kv[col_of[s]]
+                              for s in cands]
+                    order = sorted(range(len(cands)),
+                                   key=lambda j: (scores[j], cands[j]))
+                    picked = [cands[j] for j in order]
+                    queue[col_of[picked[0]]] += 1.0
+                elif rung == Rung.ROUND_ROBIN:
+                    # Smooth WRR: weight ~ 1/(1+queue) from the last good
+                    # rows; every candidate gains its weight, the winner
+                    # pays the pot back — long-run shares track weights
+                    # with no starvation.
+                    weights = {s: 1.0 / (1.0 + max(queue[col_of[s]], 0.0))
+                               for s in cands}
+                    for s, w in weights.items():
+                        self._wrr_credit[s] = (
+                            self._wrr_credit.get(s, 0.0) + w)
+                    picked = sorted(
+                        cands,
+                        key=lambda s: (-self._wrr_credit[s], s))
+                    self._wrr_credit[picked[0]] -= sum(weights.values())
+                else:  # STATIC
+                    pool = [s for s in cands if s in subset] or cands
+                    self._static_rr += 1
+                    first = pool[self._static_rr % len(pool)]
+                    picked = [first] + [s for s in pool if s != first]
+                res = PickResult(
+                    endpoint=by_slot[picked[0]].hostport,
+                    fallbacks=[by_slot[s].hostport for s in picked[1:4]],
+                )
+                res.assumed_cost = 0.0
+                res.charged_slot = -1  # nothing charged: skip the release
+                item.result = res
+                own_metrics.DEGRADED_PICKS.labels(rung=label).inc()
+                own_metrics.PICKS.labels(outcome="ok").inc()
+                own_metrics.PICK_LATENCY.observe(
+                    time.monotonic() - item.enqueued_at)
+                item.event.set()
 
     def _slo_admission(self, batch: list[_Pending]) -> None:
         """Predictive SLO shedding (006 README:27-36 SLO dimension): after

@@ -400,6 +400,16 @@ class SimCluster:
                     scheduler.set_predictor_params(trainer.params)
                     scheduler.gate_latency_column(trainer.confidence())
                 next_train = clock + train_every_s
+        if kv_agg is not None:
+            # Drain in-flight events before the run is scored: event
+            # correctness is only defined modulo propagation delay (one
+            # scrape interval), and the final window's stored/removed
+            # batches are still sitting in the aggregator when the clock
+            # stops. Without this drain the index "claims" exactly the
+            # chunks whose eviction events were pending at cutoff — under
+            # hard churn (64-chunk caches) that read as ~25% stale
+            # affinity when the steady-state answer is 0%.
+            kv_agg.flush()
 
         # --- stats ---------------------------------------------------------
         if not completions:

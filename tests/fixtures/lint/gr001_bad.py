@@ -1,0 +1,42 @@
+"""Golden violation: unbounded blocking receives in daemon loops
+(GR001) — a bare queue get, an event wait with no timeout, a socket
+recv (which has no per-call bound at all), and a declared lock acquired
+without a timeout, each inside a ``while`` loop."""
+
+import queue
+import socket
+import threading
+
+
+class Loop:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.q = queue.Queue()
+        self.stop = threading.Event()
+        # Annotated: `socket.socket` is lowercase, so the constructor
+        # heuristic alone would leave the receiver unresolved (and GR001
+        # never guesses) — the annotation is what types it.
+        self.sock: socket.socket = socket.socket()
+
+    def drain_forever(self):
+        while True:
+            item = self.q.get()                # GR001: no timeout
+            del item
+
+    def wait_forever(self):
+        while not self.stop.is_set():
+            self.stop.wait()                   # GR001: no timeout
+
+    def recv_forever(self):
+        while True:
+            data = self.sock.recv(4096)        # GR001: no bound exists
+            if not data:
+                return
+
+    def lock_forever(self):
+        while True:
+            self._lock.acquire()               # GR001: no timeout
+            try:
+                pass
+            finally:
+                self._lock.release()

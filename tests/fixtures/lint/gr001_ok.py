@@ -1,0 +1,55 @@
+"""GR001 negatives: every receive in a daemon loop carries an explicit
+bound (or opts out of blocking), Condition.wait is exempt (it releases
+the lock it waits on and is notify-driven), and blocking calls OUTSIDE
+a loop are not the rule's business."""
+
+import queue
+import threading
+
+
+class Loop:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self.q = queue.Queue()
+        self.stop = threading.Event()
+        self.items = []
+
+    def drain_bounded(self):
+        while True:
+            try:
+                item = self.q.get(timeout=0.5)      # bounded
+            except queue.Empty:
+                if self.stop.is_set():
+                    return
+                continue
+            self.items.append(item)
+
+    def drain_nonblocking(self):
+        while not self.stop.wait(0.1):              # positional timeout
+            try:
+                self.items.append(self.q.get(block=False))
+            except queue.Empty:
+                pass
+
+    def lock_bounded(self):
+        while not self.stop.is_set():
+            if self._lock.acquire(timeout=1.0):     # bounded
+                try:
+                    pass
+                finally:
+                    self._lock.release()
+
+    def cond_loop(self):
+        # Condition.wait is EXEMPT: it releases the lock while waiting
+        # and the paired notify under the same lock is its liveness
+        # contract — a timeout would only paper over a missing notify.
+        with self._cond:
+            while not self.items:
+                self._cond.wait()
+        return self.items[0]
+
+    def one_shot(self):
+        # Outside a loop: a single blocking get is a deliberate join
+        # point, not a daemon loop that can never observe shutdown.
+        return self.q.get()

@@ -1,0 +1,435 @@
+"""gie-chaos scenario suite (ISSUE 7, docs/RESILIENCE.md).
+
+Seeded, deterministic fault schedules driven through the REAL stack —
+scrape engine, circuit breakers, batching picker, degradation ladder,
+replication follower, autoscale actuator — asserting the acceptance
+criteria: under correlated endpoint failure (>=25% of the pool), a
+metrics blackout, a replication partition, and a kube-API outage, the
+EPP serves continuously (no crash, no unbounded error rate),
+``gie_degraded_mode`` transitions down AND back up the ladder, and
+identical seeds reproduce identical fault schedules bit-for-bit.
+
+Fast scenarios run in the tier-1 gate; the longer mixed-fault soak is
+``slow``-marked (``make chaos-smoke`` runs both).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool, Pod
+from gie_tpu.extproc.server import PickRequest
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.metricsio.engine import ScrapeEngine
+from gie_tpu.metricsio.mappings import VLLM
+from gie_tpu.resilience import faults
+from gie_tpu.resilience.breaker import BreakerBoard, BreakerConfig, BreakerState
+from gie_tpu.resilience.faults import FaultInjector, FaultRule
+from gie_tpu.resilience.ladder import (
+    DegradationLadder, LadderConfig, ResilienceState, Rung)
+from gie_tpu.runtime import metrics as own_metrics
+from gie_tpu.sched import ProfileConfig, Scheduler
+from gie_tpu.sched.batching import BatchingTPUPicker
+
+from tests.test_metricsio_sim import VLLM_TEXT
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _fast_ladder(**kw):
+    cfg = dict(dispatch_error_streak=2, blackout_stale_s=0.35,
+               latency_breach_s=5.0, latency_breach_streak=50,
+               recover_streak=2, min_dwell_s=0.05, probe_interval_s=0.01,
+               blackout_recover_fraction=0.5)
+    cfg.update(kw)
+    return DegradationLadder(LadderConfig(**cfg))
+
+
+def _cluster(n_pods, rs):
+    sched = Scheduler(ProfileConfig(load_decay=1.0))
+    ms = MetricsStore()
+    ds = Datastore(on_slot_reclaimed=lambda s: (sched.evict_endpoint(s),
+                                                ms.remove(s)))
+    ds.pool_set(EndpointPool({"app": "x"}, [8000], "default"))
+    for i in range(n_pods):
+        ds.pod_update_or_add(
+            Pod(name=f"p{i}", labels={"app": "x"}, ip=f"10.9.1.{i + 1}"))
+    picker = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.01,
+                               resilience=rs)
+    return sched, ds, ms, picker
+
+
+def _degraded_gauge() -> float:
+    v = own_metrics.REGISTRY.get_sample_value("gie_degraded_mode")
+    return -1.0 if v is None else v
+
+
+# --------------------------------------------------------------------------
+# Scenario: correlated endpoint death (2 of 8 = 25% of the pool)
+# --------------------------------------------------------------------------
+
+
+def test_correlated_endpoint_death_quarantines_and_recovers():
+    rs = ResilienceState(
+        board=BreakerBoard(BreakerConfig(open_after=3, open_s=1.0,
+                                         close_after=2)),
+        ladder=_fast_ladder())
+    sched, ds, ms, picker = _cluster(8, rs)
+    eps = ds.endpoints()
+    sick = sorted(eps, key=lambda e: e.slot)[:2]          # >= 25% of pool
+    sick_ips = {e.hostport.split(":")[0] for e in sick}
+    sick_hostports = {e.hostport for e in sick}
+
+    # JIT warm-up OUTSIDE the fault window: the first pick compiles the
+    # device cycle (seconds) — armed first, the bounded fault schedule
+    # would burn out and the breakers re-close before a wave ever ran.
+    picker.pick(PickRequest(headers={}, body=b"x"), eps)
+    faults.install(FaultInjector(101, {
+        "scrape.fetch": FaultRule(p_error=1.0, keys=tuple(sick_ips),
+                                  max_fires=12),
+    }))
+    eng = ScrapeEngine(ms, interval_s=0.01, max_backoff_s=0.04,
+                       fetcher=lambda u: VLLM_TEXT, workers=2,
+                       breaker_board=rs.board)
+    try:
+        for e in eps:
+            eng.attach(e.slot, f"http://{e.hostport}/metrics", VLLM)
+        # The correlated failure opens both sick endpoints' breakers.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and rs.board.open_count() < 2:
+            time.sleep(0.01)
+        assert rs.board.open_count() == 2, "breakers never opened"
+        # The EPP keeps serving, and routes AROUND the quarantined pods.
+        for _ in range(6):
+            res = picker.pick(PickRequest(headers={}, body=b"x"),
+                              ds.endpoints())
+            assert res.endpoint not in sick_hostports
+            assert not sick_hostports & set(res.fallbacks)
+        # The fault schedule exhausts; scrapes succeed again; the
+        # breakers half-open on their dwell and close hysteretically.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and rs.board.has_open:
+            time.sleep(0.01)
+        assert not rs.board.has_open, "breakers never re-closed"
+        assert rs.board.state(sick[0].slot) == BreakerState.CLOSED
+        # Post-recovery picks may use the whole pool again.
+        res = picker.pick(PickRequest(headers={}, body=b"x"),
+                          ds.endpoints())
+        assert ":" in res.endpoint
+    finally:
+        eng.close()
+        picker.close()
+
+
+# --------------------------------------------------------------------------
+# Scenario: metrics blackout -> ROUND_ROBIN floor -> hysteretic lift
+# --------------------------------------------------------------------------
+
+
+def test_metrics_blackout_floors_ladder_and_lifts_on_recovery():
+    board = BreakerBoard(BreakerConfig(open_after=1000))  # not the subject
+    rs = ResilienceState(board=board, ladder=_fast_ladder())
+    rs.ladder.on_change = lambda r: own_metrics.DEGRADED_MODE.set(r)
+    own_metrics.DEGRADED_MODE.set(0)
+    sched, ds, ms, picker = _cluster(4, rs)
+
+    # JIT warm-up outside the fault window (see the correlated-death
+    # scenario): the bounded blackout must develop while waves flow.
+    picker.pick(PickRequest(headers={}, body=b"x"), ds.endpoints())
+    faults.install(FaultInjector(202, {
+        # Every endpoint goes dark after its first successful scrape.
+        "scrape.fetch": FaultRule(p_error=1.0, after=1, max_fires=30),
+    }))
+    eng = ScrapeEngine(ms, interval_s=0.01, max_backoff_s=0.04,
+                       fetcher=lambda u: VLLM_TEXT, workers=2,
+                       breaker_board=board)
+    rs.staleness_fn = eng.staleness_seconds
+    try:
+        for e in ds.endpoints():
+            eng.attach(e.slot, f"http://{e.hostport}/metrics", VLLM)
+        served = 0
+        deadline = time.monotonic() + 6.0
+        # Continuous pick load while the blackout develops: the ladder
+        # must floor at ROUND_ROBIN without a single failed pick.
+        while (time.monotonic() < deadline
+               and rs.ladder.rung() != Rung.ROUND_ROBIN):
+            res = picker.pick(PickRequest(headers={}, body=b"x"),
+                              ds.endpoints())
+            assert ":" in res.endpoint
+            served += 1
+            time.sleep(0.005)
+        assert rs.ladder.rung() == Rung.ROUND_ROBIN, "blackout never floored"
+        assert _degraded_gauge() == 2.0       # gie_degraded_mode follows
+        # Picks keep flowing while degraded.
+        for _ in range(5):
+            assert ":" in picker.pick(
+                PickRequest(headers={}, body=b"x"), ds.endpoints()).endpoint
+        # The fault schedule dries up, scrapes land again, staleness
+        # falls under the recovery fraction, and the floor LIFTS.
+        deadline = time.monotonic() + 6.0
+        while (time.monotonic() < deadline
+               and rs.ladder.rung() != Rung.FULL):
+            assert ":" in picker.pick(
+                PickRequest(headers={}, body=b"x"), ds.endpoints()).endpoint
+            time.sleep(0.005)
+        assert rs.ladder.rung() == Rung.FULL, "blackout floor never lifted"
+        assert _degraded_gauge() == 0.0
+        # The transition trace shows down AND back up: 2 -> 0.
+        rungs = [r for _, r in rs.ladder.transitions]
+        assert 2 in rungs and rungs[-1] == 0
+    finally:
+        eng.close()
+        picker.close()
+
+
+# --------------------------------------------------------------------------
+# Scenario: device dispatch failure -> CACHED descent -> probe recovery
+# --------------------------------------------------------------------------
+
+
+def _run_device_chaos(seed: int):
+    rs = ResilienceState(ladder=_fast_ladder(), on_change=None)
+    rs.ladder.on_change = None
+    sched, ds, ms, picker = _cluster(3, rs)
+    faults.install(FaultInjector(seed, {
+        "device.dispatch": FaultRule(p_error=1.0, after=2, max_fires=4),
+    }))
+    try:
+        outcomes = []
+        deepest = Rung.FULL
+        for _ in range(30):
+            res = picker.pick(PickRequest(headers={}, body=b"x"),
+                              ds.endpoints())
+            outcomes.append(res.endpoint)
+            deepest = max(deepest, rs.ladder.rung())
+            if rs.ladder.rung() != Rung.FULL:
+                time.sleep(0.02)   # give probes their cadence
+        deadline = time.monotonic() + 5.0
+        while (time.monotonic() < deadline
+               and rs.ladder.rung() != Rung.FULL):
+            picker.pick(PickRequest(headers={}, body=b"x"), ds.endpoints())
+            time.sleep(0.02)
+        log = list(faults.installed().log)
+        return outcomes, deepest, rs.ladder.rung(), log
+    finally:
+        picker.close()
+        faults.uninstall()
+
+
+def test_device_dispatch_chaos_degrades_recovers_and_is_deterministic():
+    outcomes, deepest, final, log1 = _run_device_chaos(seed=7)
+    # Every pick was served (30 picks, zero failures)...
+    assert len(outcomes) == 30 and all(":" in e for e in outcomes)
+    # ...the ladder genuinely descended on the dispatch errors...
+    assert deepest >= Rung.CACHED
+    # ...and hysteretically climbed back to FULL once the device healed.
+    assert final == Rung.FULL
+    assert log1, "the schedule must actually have fired"
+    assert all(p == "device.dispatch" for p, _k, _v in log1)
+    # Identical seed -> bit-identical fault schedule (single dispatcher
+    # thread: the global log order IS the per-stream order).
+    _outcomes2, _deepest2, _final2, log2 = _run_device_chaos(seed=7)
+    assert log1 == log2
+    # A different seed draws the same all-error schedule here (p=1.0) —
+    # determinism is about the schedule, not the probabilities.
+
+
+# --------------------------------------------------------------------------
+# Scenario: replication partition -> backoff -> catch-up
+# --------------------------------------------------------------------------
+
+
+def _run_partition(seed: int):
+    from gie_tpu.replication import FollowerSync, StatePublisher
+    from gie_tpu.replication import follower as fol_mod
+
+    state = {"x": np.arange(4.0)}
+    pub = StatePublisher({"s": lambda: dict(state)}, era="era-chaos")
+    pub.refresh()
+    fol = FollowerSync(
+        lambda: "mem://", lambda s, delta: True, interval_s=0.05,
+        fetch=lambda *a: pub.serve(since=a[1], era=a[2],
+                                   if_none_match=a[3]),
+        seed=3)
+    faults.install(FaultInjector(seed, {
+        "replication.poll": FaultRule(p_error=1.0, after=1, max_fires=5),
+    }))
+    try:
+        # Driven on an explicit clock so the backoff-gated cadence is
+        # observable: each poll runs exactly when its window opens.
+        clock = 100.0
+        outcomes = [fol.poll_once(now=clock)]  # healthy: installs epoch 1
+        assert outcomes[0] == fol_mod.INSTALLED
+        # Partition: the leader keeps publishing while polls fail.
+        gaps = []
+        for _ in range(5):
+            state["x"] = state["x"] + 1.0
+            pub.refresh()
+            gaps.append(fol._next_poll - clock)
+            clock = fol._next_poll
+            outcomes.append(fol.poll_once(now=clock))
+        assert outcomes[1:] == [fol_mod.FETCH_ERROR] * 5
+        # The shared backoff policy stretched the poll cadence: each
+        # failed poll's window is at least as long as the last (jittered
+        # doubling toward the cap).
+        assert fol._backoff.failures == 5
+        assert fol._next_poll - clock > gaps[1]
+        # Partition heals: the follower catches up to the NEWEST epoch.
+        clock = fol._next_poll
+        outcomes.append(fol.poll_once(now=clock))
+        assert outcomes[-1] == fol_mod.INSTALLED
+        assert fol.installed_epoch == pub.status()["epoch"]
+        assert fol.fetch_errors == 5
+        return outcomes, list(faults.installed().log)
+    finally:
+        faults.uninstall()
+
+
+def test_replication_partition_backs_off_and_catches_up():
+    out1, log1 = _run_partition(seed=11)
+    out2, log2 = _run_partition(seed=11)
+    assert out1 == out2 and log1 == log2      # bit-for-bit reproducible
+
+
+# --------------------------------------------------------------------------
+# Scenario: kube-API outage -> actuation error -> next-cycle success
+# --------------------------------------------------------------------------
+
+
+def test_kube_api_outage_survives_and_heals():
+    from gie_tpu.autoscale.actuator import ReplicaActuator
+    from gie_tpu.autoscale.recommender import Recommendation
+
+    patched = []
+
+    class _Client:
+        def _json(self, method, path, body=None, content_type=None):
+            patched.append(path)
+            return {}
+
+    faults.install(FaultInjector(31, {
+        "kube.patch": FaultRule(p_error=1.0, max_fires=3),
+    }))
+    act = ReplicaActuator(_Client(), "default", target="pool")
+    rec = Recommendation(at=0.0, current=2, desired=4, reason="chaos")
+    # Outage: all three in-call attempts fail; the loop survives with
+    # an "error" outcome instead of raising into the control loop.
+    assert act.apply(rec) == "error"
+    assert patched == []
+    # Next control cycle: the outage ended (schedule exhausted).
+    assert act.apply(rec) == "patched"
+    assert len(patched) == 1
+
+
+# --------------------------------------------------------------------------
+# Scenario: slow + hung endpoints (per-endpoint latency injection)
+# --------------------------------------------------------------------------
+
+
+def test_slow_and_hung_endpoints_do_not_starve_healthy_peers():
+    ms = MetricsStore()
+    board = BreakerBoard()
+    faults.install(FaultInjector(17, {
+        "endpoint.slow": FaultRule(p_latency=1.0, latency_s=0.03,
+                                   keys=("10.3.0.1",)),
+        "endpoint.hang": FaultRule(p_hang=1.0, hang_s=0.25,
+                                   keys=("10.3.0.2",), max_fires=2),
+    }))
+    eng = ScrapeEngine(ms, interval_s=0.01, fetcher=lambda u: VLLM_TEXT,
+                       workers=2, breaker_board=board)
+    try:
+        eng.attach(0, "http://10.3.0.1:8000/metrics", VLLM)  # slow
+        eng.attach(1, "http://10.3.0.2:8000/metrics", VLLM)  # hangs
+        eng.attach(2, "http://10.3.0.3:8000/metrics", VLLM)  # healthy
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not all(
+                ms._has_data[s] for s in (0, 1, 2)):
+            time.sleep(0.01)
+        # Slow and hung endpoints still land rows (latency, not loss),
+        # and the healthy peer was never starved by them.
+        assert all(ms._has_data[s] for s in (0, 1, 2))
+        inj = faults.installed()
+        assert inj.fired.get("endpoint.slow", 0) > 0
+        assert inj.fired.get("endpoint.hang", 0) == 2
+        assert not board.has_open        # latency is not failure
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------------------------
+# Slow soak: mixed faults over the composed stack
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mixed_fault_soak_serves_continuously():
+    """~8s of mixed chaos — scrape failures, device dispatch errors,
+    per-endpoint latency — against continuous pick load from two
+    threads: zero failed picks, bounded degradation, full recovery."""
+    rs = ResilienceState(
+        board=BreakerBoard(BreakerConfig(open_after=3, open_s=0.2,
+                                         close_after=2)),
+        ladder=_fast_ladder(blackout_stale_s=1.0))
+    sched, ds, ms, picker = _cluster(6, rs)
+    faults.install(FaultInjector(4242, {
+        "scrape.fetch": FaultRule(p_error=0.3),
+        "endpoint.slow": FaultRule(p_latency=0.2, latency_s=0.005),
+        "device.dispatch": FaultRule(p_error=0.15),
+    }))
+    eng = ScrapeEngine(ms, interval_s=0.01, max_backoff_s=0.05,
+                       fetcher=lambda u: VLLM_TEXT, workers=2,
+                       breaker_board=rs.board)
+    rs.staleness_fn = eng.staleness_seconds
+    errors: list = []
+    served = [0, 0]
+    stop = threading.Event()
+
+    def load(i):
+        while not stop.is_set():
+            try:
+                res = picker.pick(PickRequest(headers={}, body=b"x"),
+                                  ds.endpoints())
+                assert ":" in res.endpoint
+                served[i] += 1
+            except Exception as e:  # noqa: BLE001 - the soak's subject
+                errors.append(e)
+            time.sleep(0.002)
+
+    try:
+        for e in ds.endpoints():
+            eng.attach(e.slot, f"http://{e.hostport}/metrics", VLLM)
+        threads = [threading.Thread(target=load, args=(i,))
+                   for i in range(2)]
+        [t.start() for t in threads]
+        time.sleep(8.0)
+        stop.set()
+        [t.join(timeout=10) for t in threads]
+        assert not errors, f"picks failed under chaos: {errors[:3]}"
+        assert sum(served) > 200, "load generator barely ran"
+        # The schedule genuinely exercised the stack.
+        inj = faults.installed()
+        assert inj.fired.get("device.dispatch", 0) > 5
+        assert inj.fired.get("scrape.fetch", 0) > 20
+        # Chaos off: the ladder must return to FULL and breakers close.
+        faults.uninstall()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and (
+                rs.ladder.rung() != Rung.FULL or rs.board.has_open):
+            picker.pick(PickRequest(headers={}, body=b"x"), ds.endpoints())
+            time.sleep(0.02)
+        assert rs.ladder.rung() == Rung.FULL
+        assert not rs.board.has_open
+    finally:
+        stop.set()
+        eng.close()
+        picker.close()

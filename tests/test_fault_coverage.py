@@ -1,0 +1,101 @@
+"""Fault-point coverage meta-test (ISSUE 7, docs/RESILIENCE.md).
+
+Every fault point registered in ``gie_tpu.resilience.faults.CATALOG``
+must be exercised by at least one test — a new injection site cannot
+land untested. "Exercised" means some test module other than this one
+names the point in a string literal (the injector refuses unknown
+names, so a literal in a test is a live FaultRule/spec reference, not
+prose). The reverse direction holds too: a point named by tests but
+missing from the catalog is a stale reference the injector would
+reject at runtime.
+
+Also pins the weave itself: every catalog point must appear in
+gie_tpu/ source (a catalog entry with no woven call site is dead
+configuration).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from gie_tpu.resilience.faults import CATALOG
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PKG = os.path.join(REPO, "gie_tpu")
+SELF = os.path.basename(__file__)
+
+
+def _string_literals(path: str) -> set:
+    """All string constants in a python file (AST-level, so comments and
+    docstring prose don't count as coverage... they do, actually — a
+    docstring IS a Constant node. Filter those out by keeping only
+    strings that exactly equal a catalog point, which prose sentences
+    never do)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read())
+    return {
+        node.value
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str)
+    }
+
+
+def _exact_point_literals(root: str, skip: set) -> dict:
+    """point -> sorted files naming it as an exact string literal."""
+    hits: dict = {p: [] for p in CATALOG}
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in sorted(files):
+            if not fn.endswith(".py") or fn in skip:
+                continue
+            path = os.path.join(dirpath, fn)
+            lits = _string_literals(path)
+            for point in CATALOG:
+                if point in lits:
+                    hits[point].append(os.path.relpath(path, REPO))
+    return hits
+
+
+def test_every_fault_point_is_exercised_by_a_test():
+    hits = _exact_point_literals(HERE, skip={SELF})
+    uncovered = sorted(p for p, files in hits.items() if not files)
+    assert not uncovered, (
+        f"fault points registered in CATALOG but exercised by no test: "
+        f"{uncovered} — every injection site needs at least one test "
+        f"driving a FaultRule through it (tests/test_resilience.py and "
+        f"tests/test_chaos.py hold the existing ones)")
+
+
+def test_every_fault_point_is_woven_into_source():
+    hits = _exact_point_literals(
+        PKG, skip={"faults.py"})  # the registry itself doesn't count
+    unwoven = sorted(p for p, files in hits.items() if not files)
+    assert not unwoven, (
+        f"fault points registered in CATALOG but woven into no gie_tpu/ "
+        f"call site: {unwoven} — delete the catalog entry or add the "
+        f"faults.check()/fire() weave")
+
+
+def test_no_stale_point_names_in_tests():
+    """Any 'x.y'-shaped literal passed to FaultRule dicts/specs in tests
+    must be a registered point. Heuristic: exact literals that LOOK like
+    fault points (lowercase dotted pairs over the catalog's vocabulary
+    of subsystem prefixes) but aren't registered."""
+    prefixes = {p.split(".")[0] for p in CATALOG}
+    stale = set()
+    for dirpath, _dirs, files in os.walk(HERE):
+        for fn in sorted(files):
+            if not fn.endswith(".py") or fn == SELF:
+                continue
+            for lit in _string_literals(os.path.join(dirpath, fn)):
+                parts = lit.split(".")
+                if (len(parts) == 2 and parts[0] in prefixes
+                        and parts[1].isidentifier()
+                        and lit not in CATALOG
+                        and not lit.endswith((".py", ".md"))):
+                    stale.add(lit)
+    # Known non-point dotted literals living in test files (module
+    # attributes etc.) are excluded by the isidentifier/prefix filter;
+    # anything left is a typo'd fault point waiting to silently no-op.
+    assert not stale, f"dotted literals that look like fault points: {stale}"

@@ -75,6 +75,12 @@ def run_fixture(stem: str, rule: str) -> list[Violation]:
             "urllib.request.urlopen inside async function via",
             "threading.Event.wait",
         ]),
+        ("gr001_bad", "GR001", [
+            "queue.Queue.get() inside a daemon loop",
+            "threading.Event.wait() inside a daemon loop",
+            "socket.socket.recv() inside a daemon loop",
+            "gr001_bad.Loop._lock.acquire() inside a daemon loop",
+        ]),
     ],
 )
 def test_rule_fires_on_golden_fixture(stem, rule, expected_substrings):
@@ -100,6 +106,7 @@ def test_gt001_counts_every_import_time_shape():
         ("gt001_ok", "GT001"),
         ("gt002_ok", "GT002"),
         ("ga001_ok", "GA001"),
+        ("gr001_ok", "GR001"),
     ],
 )
 def test_rule_silent_on_negative_fixture(stem, rule):
