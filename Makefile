@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native native-asan generate lint fuzz-smoke chaos-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
+.PHONY: all native native-asan generate lint fuzz-smoke chaos-ci chaos-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
 
 all: native generate
 
@@ -31,12 +31,18 @@ fuzz-smoke: native-asan
 	native/fuzz/bin/fuzz_promparse -max_total_time=$(FUZZ_SECS) native/fuzz/corpus/promparse
 	native/fuzz/bin/fuzz_chunker   -max_total_time=$(FUZZ_SECS) native/fuzz/corpus/chunker
 
+# Fast chaos gate (docs/RESILIENCE.md): the recorded scenario library
+# (serve-5xx storm, reset storm, rolling upgrade) plus the fast chaos
+# scenarios, deterministic seeds only — cheap enough to sit next to
+# `make lint` in the test gate. The slow soak stays in chaos-smoke.
+chaos-ci:
+	$(PY) -m pytest tests/test_scenarios.py tests/test_chaos.py -q -m 'not slow'
+
 # Seeded chaos pass (docs/RESILIENCE.md): the fast scenario suite that
 # also runs in tier-1, then the slow-marked mixed-fault soak — identical
 # seeds reproduce identical fault schedules, so a failure here is a real
 # resilience regression, never flake.
-chaos-smoke:
-	$(PY) -m pytest tests/test_chaos.py -q -m 'not slow'
+chaos-smoke: chaos-ci
 	$(PY) -m pytest tests/test_chaos.py -q -m slow
 
 # CRD manifests (reference `make generate`).
@@ -44,9 +50,12 @@ generate:
 	$(PY) -m gie_tpu.api.crdgen config/crd/bases
 
 # Full test tier: unit + conformance on the virtual 8-device CPU mesh.
-# Lint gates the suite: a hierarchy violation fails before pytest runs.
-test: lint
-	$(PY) -m pytest tests/ -q
+# Lint and the fast chaos gate run first: a hierarchy violation or a
+# deterministic-seed resilience regression fails before the full suite.
+# The chaos files are excluded from the main sweep — chaos-ci already
+# ran them (the slow soak lives in chaos-smoke, not here).
+test: lint chaos-ci
+	$(PY) -m pytest tests/ -q --ignore=tests/test_scenarios.py --ignore=tests/test_chaos.py
 
 test-unit: lint
 	$(PY) -m pytest tests/ -q --ignore=tests/test_conformance.py

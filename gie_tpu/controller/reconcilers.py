@@ -12,6 +12,16 @@ Faithful behavioral port of reference pkg/lwepp/controller/
     pool not synced       -> requeue 5 s
     not-found             -> pod_delete
     ready && labels match -> pod_update_or_add, else pod_delete
+
+One graceful-drain deviation from the reference (docs/RESILIENCE.md): a
+label-matching pod that stops being ready WHILE it still has serving
+endpoints — rolling-upgrade termination (deletionTimestamp) or a failed
+readiness probe mid-serve — is marked DRAINING instead of hard-deleted.
+Its endpoints leave new-pick candidacy immediately, in-flight waves and
+open streams complete against the live slot, and the slot reclaims at
+the bounded drain deadline or on the pod's actual deletion event,
+whichever arrives first. A pod that was never serving (or whose labels
+left the pool) still hard-deletes: there is nothing to drain.
 """
 
 from __future__ import annotations
@@ -84,6 +94,15 @@ class PodReconciler:
         )
         if is_pod_ready(pod) and labels_match:
             self.datastore.pod_update_or_add(pod)
+        elif labels_match:
+            # Still OUR pod, no longer ready: terminating (rolling
+            # upgrade sets deletionTimestamp long before the pod object
+            # disappears) or NotReady while serving. Drain instead of
+            # hard-evicting — mark_draining returns False when the pod
+            # has no serving endpoints, in which case there is nothing
+            # to drain and the plain delete applies.
+            if not self.datastore.pod_mark_draining(namespace, name):
+                self.datastore.pod_delete(namespace, name)
         else:
             self.datastore.pod_delete(namespace, name)
         return None

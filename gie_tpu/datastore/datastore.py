@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from typing import Callable, Iterable, Optional
 
 from gie_tpu.datastore.objects import Endpoint, EndpointPool, Pod
@@ -70,6 +71,7 @@ class Datastore:
         self,
         on_slot_reclaimed: Optional[SlotReclaimedFn] = None,
         max_slots: int = C.M_MAX,
+        drain_deadline_s: float = 30.0,
     ):
         self._lock = threading.RLock()
         self._pool: Optional[EndpointPool] = None
@@ -94,6 +96,16 @@ class Datastore:
         # pool-derived decisions (appProtocol transcoding) the same way.
         self._snapshot: Optional[list[Endpoint]] = None
         self.pool_generation = 0
+        # Graceful drain (docs/RESILIENCE.md): endpoints of terminating /
+        # NotReady-while-serving pods are marked DRAINING instead of
+        # hard-evicted — excluded from new-pick candidacy while in-flight
+        # waves and open streams complete, reclaimed at their bounded
+        # drain deadline (or on actual pod deletion, whichever first).
+        # Key -> drain_until (monotonic). The pick path's cached
+        # non-draining snapshot lives beside the full one.
+        self.drain_deadline_s = drain_deadline_s
+        self._draining: dict[str, float] = {}
+        self._snapshot_ready: Optional[list[Endpoint]] = None
 
     # ---- pool ------------------------------------------------------------
 
@@ -145,6 +157,7 @@ class Datastore:
             self._pool = None
             self.pool_generation += 1
             self._snapshot = None
+            self._snapshot_ready = None
             for key in list(self._endpoints):
                 self._remove_endpoint(key)
         self._drain_reclaims()
@@ -155,12 +168,19 @@ class Datastore:
         """Admit/refresh a ready, label-matching pod: ensure exactly one
         endpoint per active rank (reference PodUpdateOrAddIfNotExist,
         datastore.go:195-255)."""
+        # Pod churn is exactly when slots are needed: reap expired drains
+        # FIRST so a stuck terminating pod past its deadline frees its
+        # slot for the replacement being admitted — the wave-cadence reap
+        # never fires on an idle pool (the collector sleeps without
+        # traffic), and the bounded-deadline contract must hold there too.
+        self.reap_expired_drains()
         with self._lock:
             self._pod_update_or_add_locked(pod)
         self._drain_reclaims()
 
     def _pod_update_or_add_locked(self, pod: Pod) -> None:
         self._snapshot = None
+        self._snapshot_ready = None
         pool = self.pool_get()
         active = set(_active_ports(pod, pool.target_ports))
         for idx, port in enumerate(pool.target_ports):
@@ -194,6 +214,13 @@ class Datastore:
                     existing.address = pod.ip
                     existing.port = port
                     existing.labels = dict(pod.labels)
+                    # A pod re-admitted ready cancels its drain (a
+                    # rolled-back upgrade, a flapped readiness probe):
+                    # the endpoint rejoins new-pick candidacy.
+                    if existing.draining:
+                        existing.draining = False
+                        existing.drain_until = 0.0
+                        self._draining.pop(key, None)
                     self._by_hostport[existing.hostport] = existing
             else:
                 if existing is not None:
@@ -237,6 +264,74 @@ class Datastore:
             eps = list(self._endpoints.values())
         return [e for e in eps if predicate(e)]
 
+    def pick_candidates(self) -> list[Endpoint]:
+        """Endpoints eligible for NEW picks: the cached snapshot minus
+        DRAINING slots. Falls back to the full set when every endpoint
+        is draining — availability beats drain, the same floor rule the
+        breaker filter uses (a pool mid-upgrade must keep answering).
+        Same immutability contract as endpoints()."""
+        snap = self._snapshot_ready  # GIL-atomic read; None after mutation
+        if snap is not None:
+            return snap
+        with self._lock:
+            snap = self._snapshot_ready
+            if snap is None:
+                eps = list(self._endpoints.values())
+                ready = [e for e in eps if not e.draining]
+                snap = ready if ready else eps
+                self._snapshot_ready = snap
+        return snap
+
+    # ---- graceful drain --------------------------------------------------
+
+    def pod_mark_draining(
+        self, namespace: str, pod_name: str,
+        now: Optional[float] = None,
+    ) -> bool:
+        """Enter DRAINING for all of a pod's endpoints (rolling-upgrade
+        termination / NotReady-while-serving): new picks exclude them,
+        in-flight waves and open streams complete against the live slot,
+        and reap_expired_drains() reclaims at the bounded deadline if the
+        pod's actual deletion doesn't arrive first. Idempotent (the
+        deadline is set once, at first mark). Returns False when the pod
+        has no serving endpoints — nothing to drain, the caller should
+        plain-delete."""
+        now = time.monotonic() if now is None else now
+        marked = False
+        with self._lock:
+            prefix = f"{namespace}/{pod_name}-rank-"
+            for key, ep in self._endpoints.items():
+                if not key.startswith(prefix):
+                    continue
+                marked = True
+                if not ep.draining:
+                    ep.draining = True
+                    ep.drain_until = now + self.drain_deadline_s
+                    self._draining[key] = ep.drain_until
+                    self._snapshot_ready = None
+        return marked
+
+    def reap_expired_drains(self, now: Optional[float] = None) -> int:
+        """Reclaim endpoints whose bounded drain deadline passed without
+        the pod's deletion event arriving (a stuck terminating pod must
+        not hold its scheduler slot forever). Cheap no-op while nothing
+        drains — callers may invoke it at wave cadence."""
+        if not self._draining:  # GIL-atomic read on the common path
+            return 0
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            expired = [k for k, until in self._draining.items()
+                       if now >= until]
+            for key in expired:
+                if key in self._endpoints:
+                    self._remove_endpoint(key)
+        self._drain_reclaims()
+        return len(expired)
+
+    def draining_count(self) -> int:
+        with self._lock:
+            return len(self._draining)
+
     def endpoint_by_hostport(self, hostport: str) -> Optional[Endpoint]:
         with self._lock:
             return self._by_hostport.get(hostport)
@@ -272,6 +367,8 @@ class Datastore:
 
     def _remove_endpoint(self, key: str) -> None:
         self._snapshot = None
+        self._snapshot_ready = None
+        self._draining.pop(key, None)
         ep = self._endpoints.pop(key)
         if self._by_hostport.get(ep.hostport) is ep:
             del self._by_hostport[ep.hostport]

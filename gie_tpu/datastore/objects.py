@@ -52,6 +52,13 @@ class Endpoint:
     rank: int            # index into pool.target_ports
     slot: int            # dense scheduler slot in [0, M_MAX)
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    # Graceful drain (docs/RESILIENCE.md): a pod entering rolling-upgrade
+    # termination (deletionTimestamp) or going NotReady while serving is
+    # DRAINED, not hard-evicted — the slot leaves new-pick candidacy
+    # while in-flight waves and open streams complete, then reclaims at
+    # drain_until (monotonic) or on actual pod deletion, whichever first.
+    draining: bool = False
+    drain_until: float = 0.0
 
     @property
     def hostport(self) -> str:

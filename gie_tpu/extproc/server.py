@@ -34,6 +34,7 @@ from google.protobuf.message import DecodeError as _DecodeError
 
 from gie_tpu.extproc import codec, envoy, fieldscan, metadata, pb
 from gie_tpu.resilience import deadline as deadline_mod
+from gie_tpu.resilience import faults
 from gie_tpu.resilience.deadline import DeadlineExceeded
 from gie_tpu.runtime import metrics as own_metrics
 from gie_tpu.runtime import tracing
@@ -240,6 +241,20 @@ class RequestContext:
     resp_tokens: int = 0
     resp_first_at: float = 0.0
     resp_last_at: float = 0.0
+    # Data-plane outcome harvest (docs/RESILIENCE.md): when the pick
+    # landed (monotonic; serve latency = response-headers time minus
+    # this), the Envoy :status observed on the response (0 = none yet),
+    # and whether response headers arrived at all — a stream that ends
+    # after a pick but BEFORE response headers is an upstream reset, fed
+    # back through on_stream_aborted so the assumed-load charge is
+    # released and the breaker sees the reset.
+    picked_at: float = 0.0
+    resp_status: int = 0
+    resp_headers_seen: bool = False
+    # True when the stream ended ABNORMALLY (cancellation / transport /
+    # protocol error) — the reset signal; a clean half-close leaves it
+    # False and teardown only releases the charge.
+    aborted: bool = False
     # Split-"data:" guard across chunk boundaries; seeded with a virtual
     # newline so a frame at stream start (no preceding terminator) anchors.
     sse_carry: bytes = b"\n"
@@ -283,6 +298,10 @@ class RequestContext:
         self.resp_tail_truncated = False
         self.last_frame = None
         self.timing_is_generation = False
+        self.picked_at = 0.0
+        self.resp_status = 0
+        self.resp_headers_seen = False
+        self.aborted = False
 
 
 # Bounded RequestContext free-list (fast lane): one context per stream at
@@ -297,6 +316,16 @@ def _acquire_ctx() -> RequestContext:
         return RequestContext()
     ctx.reset()
     return ctx
+
+
+class StreamAborted(Exception):
+    """The Envoy processing stream ended ABNORMALLY — cancellation or a
+    transport error, raised by ``Stream.recv``. Distinct from a clean
+    half-close (``recv() -> None``): Envoy tears the ext-proc stream
+    down this way when the HTTP stream resets, while a clean close with
+    no response phase just means response processing is not configured
+    for this route — only the former is a serve outcome
+    (docs/RESILIENCE.md data-plane signals)."""
 
 
 class Stream(Protocol):
@@ -417,7 +446,7 @@ class StreamingServer:
     def __init__(self, datastore, picker: EndpointPicker, on_served=None,
                  bbr_chain=None, transcode_h2c: bool = True,
                  on_response_complete=None, fast_lane: bool = True,
-                 needed_headers=None):
+                 needed_headers=None, on_stream_aborted=None):
         self.datastore = datastore
         self.picker = picker
         # Admission fast lane (docs/EXTPROC.md): zero-parse field scan
@@ -446,6 +475,13 @@ class StreamingServer:
         # count + chunk timings (the TPOT training signal the
         # response-headers hop cannot observe).
         self.on_response_complete = on_response_complete
+        # Stream-abort hook (docs/RESILIENCE.md data-plane signals):
+        # called with the RequestContext when a stream that PICKED ends
+        # before response headers arrive — an upstream reset or client
+        # disconnect. The wired picker releases the assumed-load charge
+        # (on_served will never fire for this stream) and records a
+        # reset serve outcome against the primary endpoint's breaker.
+        self.on_stream_aborted = on_stream_aborted
         # Optional BBR plugin chain (proposal 1964): runs over the complete
         # request body before the pick; its headers join the header mutation
         # and its body mutation is forwarded chunked.
@@ -484,17 +520,45 @@ class StreamingServer:
             own_metrics.STREAMS.dec()
 
     def _process(self, stream: Stream) -> None:
-        if self.fast_lane:
-            ctx = _acquire_ctx()
-            try:
-                self._process_with(ctx, stream)
-            finally:
+        ctx = _acquire_ctx() if self.fast_lane else RequestContext()
+        try:
+            self._process_with(ctx, stream)
+        except StreamAborted:
+            ctx.aborted = True  # cancelled/reset: nothing left to send
+        except Exception:
+            ctx.aborted = True  # stream-fatal protocol/internal error
+            raise
+        finally:
+            # Teardown accounting (both lanes, every exit path): a stream
+            # that picked but never saw response headers released nothing
+            # and fed the breaker nothing; the hook releases the charge
+            # on every such exit and records a reset outcome only for
+            # ABNORMAL ends (ctx.aborted) — a clean half-close with no
+            # response phase just means response processing is not
+            # configured for this route, and counting those as resets
+            # would quarantine every healthy pod behind such a listener.
+            self._finish_stream(ctx)
+            if self.fast_lane:
                 # Hooks ran synchronously inside the loop; nothing holds
                 # the context once the stream ends (reset() hands out
                 # fresh containers for anything that does hold a dict).
                 _CTX_POOL.append(ctx)
-        else:
-            self._process_with(RequestContext(), stream)
+
+    def _finish_stream(self, ctx: RequestContext) -> None:
+        """Stream teardown: if a pick happened but the response headers
+        never arrived (Envoy reset the upstream stream, the client went
+        away, the stream died on a protocol error, or the route simply
+        has no response processing), the serve feedback loop would
+        otherwise silently never fire — the assumed-load charge leaks
+        until pod eviction. The hook releases it; ``ctx.aborted``
+        decides whether the breaker also sees a reset outcome."""
+        if (ctx.pick_result is None or ctx.resp_headers_seen
+                or self.on_stream_aborted is None):
+            return
+        try:
+            self.on_stream_aborted(ctx)
+        except Exception:
+            pass  # teardown accounting must never mask the stream error
 
     def _process_with(self, ctx: RequestContext, stream: Stream) -> None:
         body = bytearray()
@@ -726,9 +790,20 @@ class StreamingServer:
                 if ep.address in allow_all_ports or ep.hostport in allowed
             ]
             # Strict subsetting: empty candidate set stays empty
-            # (request.go:130-133) -> UNAVAILABLE at pick time.
+            # (request.go:130-133) -> UNAVAILABLE at pick time. Subset
+            # hints stay on the FULL list — a steering decision made
+            # upstream is honored verbatim even mid-drain, and the
+            # wave-level drain filter still prefers any non-draining
+            # members of the subset.
             return
-        ctx.candidates = all_eps
+        # Graceful drain (docs/RESILIENCE.md): default candidacy is the
+        # non-DRAINING snapshot — endpoints of terminating pods stop
+        # receiving NEW picks while their in-flight streams complete.
+        # Falls back to the full set when everything drains
+        # (availability beats drain). getattr: latency/protocol tests
+        # stub the datastore with plain endpoint lists.
+        pick_cands = getattr(self.datastore, "pick_candidates", None)
+        ctx.candidates = pick_cands() if pick_cands is not None else all_eps
 
     def _pick(self, ctx: RequestContext, body: Optional[bytes]) -> PickResult:
         """reference handlers/request.go:141-163."""
@@ -858,6 +933,7 @@ class StreamingServer:
                 }
         ctx.target_endpoint = result.destination_value
         ctx.selected_pod_ip = result.endpoint.rsplit(":", 1)[0]
+        ctx.picked_at = time.monotonic()
         ctx.pick_result = result
         return result
 
@@ -1065,8 +1141,55 @@ class StreamingServer:
             if isinstance(v, str):
                 served = v
         ctx.served_hostport = served
-        if served and self.on_served is not None:
-            self.on_served(served, ctx)
+        # Data-plane outcome harvest (docs/RESILIENCE.md): the :status
+        # pseudo-header is the serve verdict Envoy routes back through
+        # the EPP for exactly this purpose (PAPER.md ext-proc protocol).
+        # Response headers arrive once per stream — a plain loop, not
+        # the needed-keys machinery of the per-request hot path.
+        status = 0
+        for h in req.response_headers.headers.headers:
+            if h.key == ":status":
+                raw = h.raw_value
+                try:
+                    status = int(raw.decode() if raw else h.value)
+                except (TypeError, ValueError):
+                    status = 0
+                break
+        if faults.ENABLED:
+            # Chaos seams for the data-plane loop, keyed by the serving
+            # endpoint so `keys=` can storm one pod: endpoint.reset
+            # simulates an upstream reset BEFORE response headers (skip
+            # the harvest + on_served; the stream-teardown abort path
+            # then releases the charge and records the reset);
+            # endpoint.serve_5xx rewrites the observed verdict to 503.
+            hp = served or (
+                ctx.pick_result.endpoint if ctx.pick_result else "")
+            if faults.fire("endpoint.reset", key=hp).kind in (
+                    faults.ERROR, faults.CORRUPT):
+                ctx.served_hostport = ""  # a reset stream trains nothing
+                ctx.aborted = True        # teardown records the reset
+                return pb.ProcessingResponse(
+                    response_headers=pb.HeadersResponse(
+                        response=pb.CommonResponse()))
+            if faults.fire("endpoint.serve_5xx", key=hp).kind in (
+                    faults.ERROR, faults.CORRUPT):
+                status = 503
+        ctx.resp_status = status
+        ctx.resp_headers_seen = True
+        report = served
+        if not report and ctx.pick_result is not None:
+            # Envoy local reply (upstream connect refused/timed out, or a
+            # filter-generated 5xx): response headers arrive with NO
+            # served-endpoint metadata because no upstream ever served.
+            # Attribute the verdict to the attempted primary — the Envoy
+            # outlier-detection attribution rule — otherwise the exact
+            # connect-refused pods this loop exists to catch would stay
+            # invisible to the breaker and their assumed-load charges
+            # would leak (resp_headers_seen suppresses the abort path).
+            report = ctx.pick_result.endpoint
+            ctx.served_hostport = report
+        if report and self.on_served is not None:
+            self.on_served(report, ctx)
         set_headers = {metadata.WENT_INTO_RESP_HEADERS: "true"}
         if served:
             set_headers[metadata.CONFORMANCE_TEST_RESULT_HEADER] = served

@@ -15,7 +15,11 @@ import threading
 import grpc
 
 from gie_tpu.extproc import pb
-from gie_tpu.extproc.server import ExtProcError, StreamingServer
+from gie_tpu.extproc.server import (
+    ExtProcError,
+    StreamAborted,
+    StreamingServer,
+)
 
 SERVICE_NAME = "envoy.service.ext_proc.v3.ExternalProcessor"
 
@@ -30,9 +34,13 @@ def _process_handler(server: StreamingServer):
                 try:
                     return next(request_iterator)
                 except StopIteration:
-                    return None
+                    return None  # clean half-close: not a serve outcome
                 except grpc.RpcError:
-                    return None
+                    # Envoy tears the ext-proc stream down this way when
+                    # the HTTP stream resets/cancels — the data-plane
+                    # abort signal (docs/RESILIENCE.md), distinct from a
+                    # clean close on a route without response processing.
+                    raise StreamAborted()
 
             def send(self, resp: pb.ProcessingResponse) -> None:
                 out.put(resp)

@@ -19,7 +19,11 @@ Three pieces, one contract:
             bounded-staleness cached-snapshot pick -> weighted
             round-robin on last-known-good rows -> static subset,
             entered on dispatch errors / metrics blackout / sustained
-            pick-latency breach, exited hysteretically.
+            pick-latency breach / a pool-wide data-plane 5xx storm,
+            exited hysteretically.
+  scenarios recorded chaos scenarios: --fault specs grown into
+            replayable JSON files (seed + rules + drive), shipped under
+            resilience/scenarios/ and replayed by the chaos-ci suite.
 """
 
 from gie_tpu.resilience.breaker import (        # noqa: F401
@@ -52,3 +56,8 @@ from gie_tpu.resilience.policy import (         # noqa: F401
     BackoffPolicy,
     retry_call,
 )
+from gie_tpu.resilience.scenarios import (      # noqa: F401
+    Scenario,
+    list_scenarios,
+)
+from gie_tpu.resilience.scenarios import load as load_scenario  # noqa: F401

@@ -54,6 +54,12 @@ CATALOG = {
         "scheduler device cycle dispatch + materialize (sched/batching.py)",
     "endpoint.slow": "per-endpoint added latency (metricsio/engine.py)",
     "endpoint.hang": "per-endpoint hang (metricsio/engine.py)",
+    "endpoint.serve_5xx":
+        "data-plane serve outcome forced to 503 at the ext-proc "
+        "response-headers hop (extproc/server.py)",
+    "endpoint.reset":
+        "upstream stream reset before response headers — the abort-as-"
+        "reset path (extproc/server.py)",
 }
 
 OK = "ok"

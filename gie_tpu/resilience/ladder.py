@@ -32,7 +32,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from gie_tpu.resilience.breaker import BreakerBoard
+from gie_tpu.resilience.breaker import BreakerBoard, WindowedRate
 
 
 class Rung(enum.IntEnum):
@@ -56,6 +56,17 @@ class LadderConfig:
     # Blackout recovery hysteresis: staleness must fall back below
     # blackout_stale_s * this fraction before the RR floor lifts.
     blackout_recover_fraction: float = 0.5
+    # Data-plane serve-outcome floor: a POOL-WIDE 5xx/reset storm (error
+    # rate over the sliding window >= serve_error_rate with at least
+    # serve_min_samples) pins the ladder at ROUND_ROBIN even when
+    # scrapes look clean — whatever data the full path is scoring on is
+    # demonstrably not predicting serve outcomes, so spread uniformly
+    # and let the per-endpoint breakers carve out the truly sick pods.
+    # The floor lifts when the rate falls under serve_error_rate *
+    # blackout_recover_fraction, or when the window drains empty.
+    serve_window_s: float = 10.0
+    serve_error_rate: float = 0.5
+    serve_min_samples: int = 20
 
     def __post_init__(self):
         if (self.dispatch_error_streak < 1 or self.recover_streak < 1
@@ -63,6 +74,10 @@ class LadderConfig:
             raise ValueError("ladder streaks must be >= 1")
         if not (0.0 < self.blackout_recover_fraction <= 1.0):
             raise ValueError("blackout_recover_fraction must be in (0, 1]")
+        if not (0.0 < self.serve_error_rate <= 1.0):
+            raise ValueError("serve_error_rate must be in (0, 1]")
+        if self.serve_window_s <= 0 or self.serve_min_samples < 1:
+            raise ValueError("serve window parameters must be positive")
 
 
 class DegradationLadder:
@@ -83,6 +98,8 @@ class DegradationLadder:
         self._lock = threading.Lock()
         self._level = Rung.FULL          # error-driven component
         self._blackout_floor = Rung.FULL  # staleness-driven component
+        self._serve_floor = Rung.FULL    # data-plane serve-outcome component
+        self._serve_window = WindowedRate(self.cfg.serve_window_s)
         self._err_streak = 0
         self._ok_streak = 0
         self._slow_streak = 0
@@ -94,18 +111,29 @@ class DegradationLadder:
 
     def rung(self) -> Rung:
         with self._lock:
+            # Lazy serve-floor lift: with traffic gone the window drains
+            # empty and no note_serve_outcome will ever arrive to lift
+            # the floor — re-evaluate on read (wave cadence, one rate()
+            # over <= 8 buckets).
+            if self._serve_floor > Rung.FULL:
+                self._reeval_serve_floor_locked(self.clock())
             return self._effective()
 
     def _effective(self) -> Rung:
-        return Rung(max(self._level, self._blackout_floor))
+        return Rung(max(self._level, self._blackout_floor,
+                        self._serve_floor))
 
     def report(self) -> dict:
         with self._lock:
+            err, n = self._serve_window.rate(self.clock())
             return {
                 "rung": int(self._effective()),
                 "rung_name": self._effective().name,
                 "level": int(self._level),
                 "blackout_floor": int(self._blackout_floor),
+                "serve_floor": int(self._serve_floor),
+                "serve_error_rate": err,
+                "serve_samples": n,
                 "error_streak": self._err_streak,
                 "since_s": max(self.clock() - self._changed_at, 0.0),
             }
@@ -113,7 +141,8 @@ class DegradationLadder:
     # -- feeds -------------------------------------------------------------
 
     def _set(self, level: Optional[Rung] = None,
-             floor: Optional[Rung] = None) -> None:
+             floor: Optional[Rung] = None,
+             serve_floor: Optional[Rung] = None) -> None:
         """Caller holds the lock. Records transitions of the EFFECTIVE
         rung and fires on_change for them."""
         before = self._effective()
@@ -121,6 +150,8 @@ class DegradationLadder:
             self._level = level
         if floor is not None:
             self._blackout_floor = floor
+        if serve_floor is not None:
+            self._serve_floor = serve_floor
         after = self._effective()
         if after != before:
             self._changed_at = self.clock()
@@ -182,6 +213,30 @@ class DegradationLadder:
                   and stale_s < cfg.blackout_stale_s
                   * cfg.blackout_recover_fraction):
                 self._set(floor=Rung.FULL)
+
+    def note_serve_outcome(self, ok: bool) -> None:
+        """One data-plane serve outcome (any endpoint): maintains the
+        pool-wide sliding error rate and the serve floor it drives. A
+        5xx/reset storm descends the ladder to ROUND_ROBIN even while
+        every scrape looks healthy; recovery is hysteretic (rate must
+        fall under serve_error_rate * blackout_recover_fraction) so a
+        storm's trailing edge cannot flap the pool between regimes."""
+        with self._lock:
+            now = self.clock()
+            self._serve_window.note(ok, now)
+            self._reeval_serve_floor_locked(now)
+
+    def _reeval_serve_floor_locked(self, now: float) -> None:
+        cfg = self.cfg
+        err, n = self._serve_window.rate(now)
+        if n >= cfg.serve_min_samples and err >= cfg.serve_error_rate:
+            if self._serve_floor < Rung.ROUND_ROBIN:
+                self._set(serve_floor=Rung.ROUND_ROBIN)
+        elif (self._serve_floor > Rung.FULL
+              and (n == 0
+                   or err < cfg.serve_error_rate
+                   * cfg.blackout_recover_fraction)):
+            self._set(serve_floor=Rung.FULL)
 
     def should_probe(self) -> bool:
         """While degraded by LEVEL, let one wave through the full path

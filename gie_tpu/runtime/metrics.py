@@ -241,6 +241,43 @@ DEADLINE_SHED = prom.Counter(
     ["stage"],  # admission|queue
     registry=REGISTRY,
 )
+# Data-plane feedback loop (ISSUE 8, docs/RESILIENCE.md): serve outcomes
+# harvested at the ext-proc response hop (Envoy :status class, or
+# "reset" for streams that abort after a pick but before response
+# headers), the observed pick-to-first-byte serve latency, endpoints in
+# graceful drain, and the budget-aware scheduling adjustments.
+SERVE_OUTCOME = prom.Counter(
+    "gie_serve_outcome_total",
+    "Data-plane serve outcomes observed on the response path",
+    ["class"],  # 2xx|3xx|4xx|5xx|reset
+    registry=REGISTRY,
+)
+SERVE_LATENCY = prom.Histogram(
+    "gie_serve_latency_seconds",
+    "Observed pick-to-response-headers serve latency",
+    buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0),
+    registry=REGISTRY,
+)
+DRAINING_ENDPOINTS = prom.Gauge(
+    "gie_draining_endpoints",
+    "Endpoints in graceful DRAINING state (excluded from new picks, "
+    "in-flight streams completing)",
+    registry=REGISTRY,
+)
+HOLD_BUDGET_BYPASS = prom.Counter(
+    "gie_hold_budget_bypass_total",
+    "Saturation holds skipped because the request's remaining deadline "
+    "budget could not survive another hold retry (picked best-effort "
+    "now instead of held to die)",
+    registry=REGISTRY,
+)
+PD_BUDGET_SINGLEHOP = prom.Counter(
+    "gie_pd_budget_singlehop_total",
+    "Disaggregated picks collapsed to the decode worker only because "
+    "the request's remaining deadline budget could not afford the "
+    "cross-worker prefill hop",
+    registry=REGISTRY,
+)
 
 
 _POOL_SNAPSHOT = {"fn": lambda: {}, "registered": False,

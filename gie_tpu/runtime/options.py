@@ -125,6 +125,20 @@ class Options:
     # injection disabled (zero hot-path cost beyond one flag check).
     fault_specs: list = dataclasses.field(default_factory=list)
     fault_seed: int = 0
+    # Recorded chaos scenario (resilience/scenarios.py): a JSON file (or
+    # a shipped-library name like "mixed-soak") whose seed + rules arm
+    # the injector at startup — the replayable form of --fault/--fault-
+    # seed. Mutually exclusive with --fault (a scenario IS a recorded
+    # spec; mixing the two would break its bit-for-bit replay claim).
+    fault_scenario: str = ""
+    # Graceful drain (docs/RESILIENCE.md): how long a DRAINING endpoint
+    # (terminating / NotReady-while-serving pod) may finish its in-flight
+    # streams before its scheduler slot is reclaimed anyway.
+    drain_deadline_s: float = 30.0
+    # Budget-aware pd split (docs/RESILIENCE.md): disaggregated picks
+    # whose remaining deadline budget is under this floor collapse to
+    # the decode worker only (no cross-worker prefill hop). 0 disables.
+    pd_budget_floor_ms: float = 250.0
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -290,6 +304,22 @@ class Options:
         parser.add_argument("--fault-seed", type=int, default=d.fault_seed,
                             help="seed for the deterministic fault "
                                  "schedule")
+        parser.add_argument("--fault-scenario", default=d.fault_scenario,
+                            metavar="FILE|NAME",
+                            help="recorded chaos scenario JSON to arm at "
+                                 "startup (a path, or a shipped-library "
+                                 "name like 'mixed-soak'); mutually "
+                                 "exclusive with --fault")
+        parser.add_argument("--drain-deadline-s", type=float,
+                            default=d.drain_deadline_s,
+                            help="bounded graceful-drain window: how long "
+                                 "a terminating pod's endpoints may finish "
+                                 "in-flight streams before slot reclaim")
+        parser.add_argument("--pd-budget-floor-ms", type=float,
+                            default=d.pd_budget_floor_ms,
+                            help="disaggregated picks with less deadline "
+                                 "budget than this collapse to the decode "
+                                 "worker only (0 disables)")
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "Options":
@@ -339,6 +369,9 @@ class Options:
             resilience_static_subset=args.resilience_static_subset,
             fault_specs=list(args.fault_specs),
             fault_seed=args.fault_seed,
+            fault_scenario=args.fault_scenario,
+            drain_deadline_s=args.drain_deadline_s,
+            pd_budget_floor_ms=args.pd_budget_floor_ms,
         )
 
     def validate(self) -> None:
@@ -409,6 +442,22 @@ class Options:
                 _faults.parse_spec(self.fault_specs)
             except ValueError as e:
                 raise ValueError(f"--fault: {e}") from None
+        if self.fault_scenario:
+            if self.fault_specs:
+                # A scenario IS a recorded spec; merging ad-hoc rules in
+                # would break its bit-for-bit replay claim.
+                raise ValueError(
+                    "--fault-scenario and --fault are mutually exclusive")
+            from gie_tpu.resilience import scenarios as _scenarios
+
+            try:
+                _scenarios.load(self.fault_scenario)
+            except ValueError as e:
+                raise ValueError(f"--fault-scenario: {e}") from None
+        if self.drain_deadline_s <= 0:
+            raise ValueError("--drain-deadline-s must be > 0")
+        if self.pd_budget_floor_ms < 0:
+            raise ValueError("--pd-budget-floor-ms must be >= 0")
         for spec in self.objectives:
             name, sep, crit = spec.partition("=")
             if not sep or not name:

@@ -153,7 +153,9 @@ class ExtProcServerRunner:
             # unreachable and backing off, a wedged shard) that row ages
             # alone miss.
             self.resilience.staleness_fn = self.scraper.staleness_seconds
-        self.datastore = Datastore(on_slot_reclaimed=self._slot_reclaimed)
+        self.datastore = Datastore(
+            on_slot_reclaimed=self._slot_reclaimed,
+            drain_deadline_s=opts.drain_deadline_s)
         self._overflow_logged = 0
         self.picker = BatchingTPUPicker(
             self.scheduler,
@@ -164,6 +166,7 @@ class ExtProcServerRunner:
             trainer=self.trainer,
             queue_bound=opts.queue_bound,
             queue_max_age_s=opts.queue_max_age_s,
+            pd_budget_floor_s=opts.pd_budget_floor_ms / 1000.0,
             # Production path: first contact with a new wave-shape lattice
             # background-compiles its remaining N buckets, so a load spike
             # never stalls the dispatcher on first-use jit (ROADMAP item).
@@ -315,6 +318,7 @@ class ExtProcServerRunner:
             self.datastore, self.picker,
             on_served=self.picker.observe_served,
             on_response_complete=self.picker.observe_response_complete,
+            on_stream_aborted=self.picker.observe_stream_aborted,
             fast_lane=opts.extproc_fast_lane,
         )
         self.grpc_server: Optional[grpc.Server] = None
@@ -479,6 +483,16 @@ class ExtProcServerRunner:
             self.log.info("fault injection armed",
                           seed=self.opts.fault_seed,
                           specs=self.opts.fault_specs)
+        elif self.opts.fault_scenario:
+            # Recorded chaos scenario (resilience/scenarios.py): the
+            # file carries its own seed + rules — the replayable form of
+            # --fault/--fault-seed, bit-for-bit across runs.
+            from gie_tpu.resilience import scenarios
+
+            scn = scenarios.load(self.opts.fault_scenario)
+            scn.arm()
+            self.log.info("chaos scenario armed", name=scn.name,
+                          seed=scn.seed, path=scn.path)
         self.health_server, _ = start_dedicated_health_server(
             self.ready, self.opts.grpc_health_port,
             self.replication.healthy if self.replication is not None

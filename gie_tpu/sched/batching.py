@@ -42,6 +42,7 @@ from gie_tpu.resilience import deadline as deadline_mod
 from gie_tpu.resilience import faults
 from gie_tpu.resilience.ladder import ResilienceState, Rung
 from gie_tpu.sched import constants as C
+from gie_tpu.sched.filters import drain_filter
 from gie_tpu.sched.hashing import batch_chunk_hashes
 from gie_tpu.models.latency import host_features
 from gie_tpu.sched.profile import Scheduler, pd_costs_host, request_cost_host
@@ -231,6 +232,7 @@ class BatchingTPUPicker:
         hold_queue_limit: float = 128.0,
         hold_retry_s: float = 0.01,
         pick_timeout_s: float = 60.0,
+        pd_budget_floor_s: float = 0.0,
         queue_bound: int = 0,
         queue_max_age_s: float = 0.0,
         pipeline_depth=2,
@@ -260,6 +262,13 @@ class BatchingTPUPicker:
         self.hold_queue_limit = hold_queue_limit
         self.hold_retry_s = hold_retry_s
         self.pick_timeout_s = pick_timeout_s
+        # Budget-aware pd split (docs/RESILIENCE.md): a disaggregated
+        # pick whose remaining deadline budget is under this floor
+        # collapses to the decode worker only — the cross-worker prefill
+        # hop (KV transfer + an extra network leg) would eat the budget.
+        # 0 disables (seed behavior); the runner wires
+        # --pd-budget-floor-ms.
+        self.pd_budget_floor_s = pd_budget_floor_s
         # Flow-control queue BOUNDS (the reference flow-controller implies
         # bounded queues + overload policy, proposal 0683 README:64-66).
         # queue_bound > 0 caps pending depth: an arrival into a full queue
@@ -439,46 +448,41 @@ class BatchingTPUPicker:
 
     def observe_served(self, served_hostport: str, ctx) -> None:
         """Served-endpoint feedback -> assumed-load release
-        (004 README:84-101) + latency-predictor training signal."""
+        (004 README:84-101) + data-plane serve outcome (breaker/ladder,
+        docs/RESILIENCE.md) + latency-predictor training signal."""
         pick_result = getattr(ctx, "pick_result", None)
-        cost = getattr(pick_result, "assumed_cost", 1.0)
-        # Release the slot the cycle CHARGED (the primary pick), not the slot
-        # of whichever endpoint actually served: on data-plane failover the
-        # primary's charge would leak and the fallback would get a spurious
-        # release. Guard against slot reuse — if the primary was evicted, its
-        # eviction already cleared the slot's load, so skip the release.
-        charged = getattr(pick_result, "charged", None)
-        if charged:
-            # Disaggregated mode: release every charged worker whose slot
-            # still belongs to the charged hostport (slot-reuse guard).
-            slots, costs = [], []
-            for slot, slot_cost, hostport in charged:
-                ep = self.datastore.endpoint_by_hostport(hostport)
-                if ep is not None and ep.slot == slot:
-                    slots.append(slot)
-                    costs.append(slot_cost)
-            if slots:
-                self.scheduler.complete(
-                    np.asarray(slots, np.int32),
-                    np.asarray(costs, np.float32),
-                )
-        else:
-            release_slot = None
-            charged_slot = getattr(pick_result, "charged_slot", None)
-            primary = getattr(pick_result, "endpoint", None)
-            if charged_slot is not None and primary is not None:
-                ep = self.datastore.endpoint_by_hostport(primary)
-                if ep is not None and ep.slot == charged_slot:
-                    release_slot = charged_slot
-            else:  # legacy pick results without charge bookkeeping
-                ep = self.datastore.endpoint_by_hostport(served_hostport)
-                if ep is not None:
-                    release_slot = ep.slot
-            if release_slot is not None:
-                self.scheduler.complete(
-                    np.asarray([release_slot], np.int32),
-                    np.asarray([cost], np.float32),
-                )
+        self._release_charge(pick_result, served_hostport)
+        # Serve outcome: the Envoy :status harvested at the response-
+        # headers hop (0 = the transport never surfaced one — nothing to
+        # learn) and the pick-to-response-headers latency. Charged to
+        # the endpoint that actually SERVED, which is what the outcome
+        # describes (on data-plane failover the fallback's health is
+        # what was observed, not the primary's).
+        status = int(getattr(ctx, "resp_status", 0) or 0)
+        primary = getattr(pick_result, "endpoint", "")
+        if (primary and served_hostport
+                and served_hostport != primary):
+            # Envoy walked the fallback list: an earlier entry — the
+            # primary — refused the connection or reset before the
+            # fallback served. Without this, a connect-refusing pod
+            # whose requests always retry onto a fallback would never
+            # feed its own breaker (it scrapes healthy, and the served
+            # endpoint's 2xx is credited to the fallback) while adding
+            # a failed hop to every request it wins.
+            self._note_serve_outcome(primary, ok=False, cls="reset")
+        if status > 0:
+            picked_at = float(getattr(ctx, "picked_at", 0.0) or 0.0)
+            latency_s = (
+                max(time.monotonic() - picked_at, 0.0) if picked_at else 0.0)
+            self._note_serve_outcome(
+                served_hostport, ok=status < 500,
+                cls=f"{status // 100}xx", latency_s=latency_s)
+            if status >= 500:
+                # An errored serve trains nothing: an Envoy local-reply
+                # 503 (connect refused) arrives FAST, and a low-latency
+                # TTFT sample would teach the predictor that the sick
+                # endpoint is the most attractive one in the pool.
+                return
         feedback = getattr(pick_result, "feedback", None)
         if self.trainer is not None and feedback is not None:
             features, slot, picked_at, picked_hostport = feedback
@@ -495,6 +499,86 @@ class BatchingTPUPicker:
             self.trainer.observe(features, ttft_s=elapsed, tpot_s=None,
                                  slot=slot)
 
+    def _release_charge(self, pick_result, served_hostport: str = "") -> None:
+        """Release the assumed-load the cycle CHARGED (the primary pick,
+        or both pd workers), not the slot of whichever endpoint actually
+        served: on data-plane failover the primary's charge would leak
+        and the fallback would get a spurious release. Guard against
+        slot reuse — if the charged endpoint was evicted, its eviction
+        already cleared the slot's load, so skip the release."""
+        cost = getattr(pick_result, "assumed_cost", 1.0)
+        charged = getattr(pick_result, "charged", None)
+        if charged:
+            # Disaggregated mode: release every charged worker whose slot
+            # still belongs to the charged hostport (slot-reuse guard).
+            slots, costs = [], []
+            for slot, slot_cost, hostport in charged:
+                ep = self.datastore.endpoint_by_hostport(hostport)
+                if ep is not None and ep.slot == slot:
+                    slots.append(slot)
+                    costs.append(slot_cost)
+            if slots:
+                self.scheduler.complete(
+                    np.asarray(slots, np.int32),
+                    np.asarray(costs, np.float32),
+                )
+            return
+        release_slot = None
+        charged_slot = getattr(pick_result, "charged_slot", None)
+        primary = getattr(pick_result, "endpoint", None)
+        if charged_slot is not None and primary is not None:
+            ep = self.datastore.endpoint_by_hostport(primary)
+            if ep is not None and ep.slot == charged_slot:
+                release_slot = charged_slot
+        elif served_hostport:  # legacy pick results without bookkeeping
+            ep = self.datastore.endpoint_by_hostport(served_hostport)
+            if ep is not None:
+                release_slot = ep.slot
+        if release_slot is not None:
+            self.scheduler.complete(
+                np.asarray([release_slot], np.int32),
+                np.asarray([cost], np.float32),
+            )
+
+    def observe_stream_aborted(self, ctx) -> None:
+        """Stream-teardown feedback (extproc on_stream_aborted): the
+        Envoy stream ended after a pick but BEFORE response headers.
+        on_served will never fire for this stream, so the release it
+        would have performed happens here (the stream must not leak
+        assumed load until pod eviction) — every such exit. The
+        breaker/ladder additionally see a reset outcome against the
+        primary endpoint only when the end was ABNORMAL (ctx.aborted:
+        cancellation, transport/protocol error, or the injected reset) —
+        a clean half-close just means the route has no response
+        processing, and charging those as resets would quarantine every
+        healthy pod behind such a listener."""
+        pick_result = getattr(ctx, "pick_result", None)
+        if pick_result is None:
+            return
+        self._release_charge(pick_result)
+        primary = getattr(pick_result, "endpoint", "")
+        if primary and getattr(ctx, "aborted", True):
+            self._note_serve_outcome(primary, ok=False, cls="reset")
+
+    def _note_serve_outcome(self, hostport: str, ok: bool, cls: str,
+                            latency_s: float = 0.0) -> None:
+        """Fan one data-plane serve outcome into the resilience layer:
+        gie_serve_outcome_total, the serving endpoint's breaker (windowed
+        error-rate + streak), and the ladder's pool-wide serve floor."""
+        own_metrics.SERVE_OUTCOME.labels(cls).inc()
+        if latency_s > 0.0:
+            own_metrics.SERVE_LATENCY.observe(latency_s)
+        rs = self.resilience
+        if rs is None:
+            return
+        ep = self.datastore.endpoint_by_hostport(hostport)
+        if ep is not None and rs.board.record_serve_outcome(
+                ep.slot, ok, latency_s=latency_s):
+            # State transition: refresh the gauge here rather than
+            # paying open_count()'s lock per request.
+            own_metrics.BREAKER_OPEN.set(rs.board.open_count())
+        rs.ladder.note_serve_outcome(ok)
+
     def observe_response_complete(self, ctx) -> None:
         """Response-stream-complete feedback -> TPOT training signal
         (VERDICT r3 #7): the ext-proc response-body hop harvests the
@@ -504,6 +588,12 @@ class BatchingTPUPicker:
         latency. Trains the TPOT head only — the TTFT half was observed
         at the response-headers hop."""
         if self.trainer is None:
+            return
+        if (getattr(ctx, "aborted", False)
+                or int(getattr(ctx, "resp_status", 0) or 0) >= 500):
+            # A reset/errored stream trains nothing (same rule as the
+            # TTFT hop): its chunk timing describes the failure, not
+            # token generation.
             return
         pick_result = getattr(ctx, "pick_result", None)
         feedback = getattr(pick_result, "feedback", None)
@@ -723,6 +813,28 @@ class BatchingTPUPicker:
             batch = kept
         if not batch:
             return []
+        # Graceful-drain housekeeping at wave cadence (docs/RESILIENCE.md):
+        # reap endpoints whose bounded drain deadline passed without the
+        # pod's deletion event, export the gauge, and drop DRAINING
+        # endpoints from each item's candidate set — the cycle's subset
+        # mask, and therefore the primary pick AND the in-mask fallback
+        # ranks, never land on a terminating pod (drain_filter keeps the
+        # set when filtering would empty it: availability beats drain).
+        # While nothing drains this costs two attribute loads and one
+        # falsy check. getattr: latency tests stub the datastore.
+        draining_count = getattr(self.datastore, "draining_count", None)
+        if draining_count is not None:
+            self.datastore.reap_expired_drains()
+            n_draining = draining_count()
+            own_metrics.DRAINING_ENDPOINTS.set(n_draining)
+            if n_draining:
+                for it in batch:
+                    allowed = drain_filter(it.candidates)
+                    if allowed is not it.candidates:
+                        it.candidates = allowed
+                        it.cand_slots = np.fromiter(
+                            (getattr(ep, "slot", -1) for ep in allowed),
+                            np.int64, len(allowed))
         # Flow-control hold decision happens BEFORE any scheduling, so a
         # held request never touches device state (assumed load, prefix
         # inserts, tick) — it simply waits for capacity or its deadline.
@@ -742,7 +854,20 @@ class BatchingTPUPicker:
                     and now - it.enqueued_at < self.hold_max_s
                     and bool(np.all(queues[slots] >= self.hold_queue_limit))
                 ):
-                    held.append(it)
+                    d = it.req.deadline_at
+                    if d and d - now < 2.0 * self.hold_retry_s:
+                        # Budget-aware hold (docs/RESILIENCE.md): the
+                        # remaining deadline budget cannot survive even
+                        # one more retry-pacing window plus the pick
+                        # itself — holding would hold it to die at the
+                        # queue-shed check. Pick NOW, best-effort, onto
+                        # the saturated pool; long holds are reserved
+                        # for requests that still have budget (or carry
+                        # no deadline at all).
+                        own_metrics.HOLD_BUDGET_BYPASS.inc()
+                        runnable.append(it)
+                    else:
+                        held.append(it)
                 else:
                     runnable.append(it)
             batch = runnable
@@ -932,6 +1057,17 @@ class BatchingTPUPicker:
         prefill_np = (
             np.asarray(result.prefill) if result.prefill is not None else None
         )
+        # Ranked-fallback-tail hygiene flags, read once per wave: the
+        # subset mask constrained the PRIMARY at dispatch, but the ranked
+        # tail spans the whole pool — quarantined or DRAINING endpoints
+        # must not ride along as data-plane failover targets. Draining is
+        # read at COMPLETION time (endpoints are shared mutable objects),
+        # so a drain marked between dispatch and fan-out still excludes.
+        rs = self.resilience
+        board_open = rs is not None and rs.board.has_open
+        any_draining = any(
+            getattr(ep, "draining", False) for ep in wave.endpoints)
+        now_mono = time.monotonic()
         for i, item in enumerate(batch):
             own_metrics.PICK_LATENCY.observe(time.monotonic() - item.enqueued_at)
             if status[i] == C.Status.SHED:
@@ -946,16 +1082,17 @@ class BatchingTPUPicker:
                 picked_slots = [
                     int(s) for s in indices[i] if s >= 0 and s in by_slot
                 ]
-                rs = self.resilience
-                if rs is not None and rs.board.has_open and picked_slots:
-                    # The subset mask constrained the PRIMARY at dispatch,
-                    # but the ranked fallback tail spans the whole pool —
-                    # a quarantined endpoint must not ride along as a
-                    # data-plane failover target. Keep the raw list only
-                    # if filtering would empty it (availability beats
-                    # quarantine, same rule as the dispatch-side filter).
-                    healthy = [s for s in picked_slots
-                               if not rs.board.quarantined(s)]
+                if picked_slots and (board_open or any_draining):
+                    # Keep the raw list only if filtering would empty it
+                    # (availability beats quarantine AND drain, the same
+                    # rule as the dispatch-side filters) — exclusion
+                    # parity between wave candidates and this tail is
+                    # pinned by tests/test_dataplane.py.
+                    healthy = [
+                        s for s in picked_slots
+                        if not ((board_open and rs.board.quarantined(s))
+                                or (any_draining and getattr(
+                                    by_slot[s], "draining", False)))]
                     if healthy:
                         picked_slots = healthy
                 picked = [by_slot[s].hostport for s in picked_slots]
@@ -982,6 +1119,27 @@ class BatchingTPUPicker:
                         # release the full request cost from a slot the
                         # cycle only charged d_cost.
                         res.charged = [(res.charged_slot, d_cost, picked[0])]
+                        d = item.req.deadline_at
+                        if (p_ep is not None and self.pd_budget_floor_s > 0
+                                and d
+                                and d - now_mono < self.pd_budget_floor_s):
+                            # Budget-aware pd split (docs/RESILIENCE.md):
+                            # the remaining deadline budget cannot afford
+                            # the cross-worker prefill hop (KV transfer +
+                            # an extra network leg) — collapse to the
+                            # decode worker only, which prefills locally.
+                            # The cycle charged p_cost to the prefill
+                            # slot; release it now so the skipped hop
+                            # does not phantom-load a worker that will
+                            # never see the request. (The decode worker's
+                            # local prefill rides uncharged for this one
+                            # request — a bounded under-count, versus an
+                            # unbounded phantom charge.)
+                            self.scheduler.complete(
+                                np.asarray([p_slot], np.int32),
+                                np.asarray([p_cost], np.float32))
+                            own_metrics.PD_BUDGET_SINGLEHOP.inc()
+                            p_ep = None
                         if p_ep is not None:
                             res.extra_headers = {
                                 **res.extra_headers,
@@ -1046,6 +1204,14 @@ class BatchingTPUPicker:
         _degraded_lock."""
         endpoints = self.datastore.endpoints()
         by_slot = {ep.slot: ep for ep in endpoints}
+        # Degraded rungs honor graceful drain exactly like the full path:
+        # a terminating pod leaves new-pick candidacy even while the
+        # ladder is down (a rolling upgrade DURING a degradation must
+        # still be zero-error), with the same availability floor.
+        ready = {s: ep for s, ep in by_slot.items()
+                 if not getattr(ep, "draining", False)}
+        if ready:
+            by_slot = ready
         rs = self.resilience
         if rs is not None and rs.board.has_open and len(by_slot) > 1:
             allowed = {s for s in by_slot if not rs.board.quarantined(s)}

@@ -15,6 +15,28 @@ from gie_tpu.sched import constants as C
 from gie_tpu.sched.types import EndpointBatch, RequestBatch
 
 
+def drain_filter(candidates: list) -> list:
+    """Graceful-drain candidate prefilter (docs/RESILIENCE.md).
+
+    Host-side sibling of the mask filters below: DRAINING endpoints
+    (terminating pods completing their in-flight streams) are dropped
+    from a pick's candidate set BEFORE wave assembly, so the device
+    cycle never scores them — the [N, M] grid sees them only through
+    the subset mask, exactly like a breaker-quarantined slot. Kept
+    host-side rather than as an EndpointBatch column because drain is a
+    membership property, not a metric: it changes at pod-churn cadence
+    and must never cost the jitted cycle a recompile or an extra input.
+
+    Availability beats drain: when every candidate is draining the set
+    is returned unchanged — a pool mid-rolling-upgrade must keep
+    answering (same floor rule as the breaker filter).
+    """
+    kept = [ep for ep in candidates if not getattr(ep, "draining", False)]
+    if not kept or len(kept) == len(candidates):
+        return candidates  # identity-preserving: callers compare `is`
+    return kept
+
+
 def base_mask(reqs: RequestBatch, eps: EndpointBatch) -> jnp.ndarray:
     """Validity + subset-hint mask.
 

@@ -375,17 +375,21 @@ def test_slow_and_hung_endpoints_do_not_starve_healthy_peers():
 def test_mixed_fault_soak_serves_continuously():
     """~8s of mixed chaos — scrape failures, device dispatch errors,
     per-endpoint latency — against continuous pick load from two
-    threads: zero failed picks, bounded degradation, full recovery."""
+    threads: zero failed picks, bounded degradation, full recovery.
+
+    The schedule is REPLAYED from the shipped mixed-soak scenario file
+    (resilience/scenarios/mixed-soak.json) rather than re-declared here:
+    the same file reproduces the soak's conditions against a live stack
+    via ``--fault-scenario mixed-soak``."""
+    from gie_tpu.resilience import scenarios
+
+    scn = scenarios.load("mixed-soak")
     rs = ResilienceState(
         board=BreakerBoard(BreakerConfig(open_after=3, open_s=0.2,
                                          close_after=2)),
         ladder=_fast_ladder(blackout_stale_s=1.0))
-    sched, ds, ms, picker = _cluster(6, rs)
-    faults.install(FaultInjector(4242, {
-        "scrape.fetch": FaultRule(p_error=0.3),
-        "endpoint.slow": FaultRule(p_latency=0.2, latency_s=0.005),
-        "device.dispatch": FaultRule(p_error=0.15),
-    }))
+    sched, ds, ms, picker = _cluster(scn.drive["pods"], rs)
+    scn.arm()
     eng = ScrapeEngine(ms, interval_s=0.01, max_backoff_s=0.05,
                        fetcher=lambda u: VLLM_TEXT, workers=2,
                        breaker_board=rs.board)
@@ -411,7 +415,7 @@ def test_mixed_fault_soak_serves_continuously():
         threads = [threading.Thread(target=load, args=(i,))
                    for i in range(2)]
         [t.start() for t in threads]
-        time.sleep(8.0)
+        time.sleep(scn.drive["duration_s"])
         stop.set()
         [t.join(timeout=10) for t in threads]
         assert not errors, f"picks failed under chaos: {errors[:3]}"

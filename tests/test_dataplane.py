@@ -1,0 +1,826 @@
+"""Data-plane feedback loop (ISSUE 8, docs/RESILIENCE.md).
+
+Covers the response-outcome half of the resilience layer: the windowed
+breaker error-rate model (rate-open vs streak-open, serve-opened
+recovery semantics), the ladder's pool-wide serve floor, graceful
+endpoint drain (lifecycle, wave-candidate vs ranked-fallback-tail
+exclusion parity, degraded-rung parity, availability floor, bounded
+reap), abort-as-reset charge release, and the deadline-budget-aware
+hold / pd-split decisions.
+"""
+
+from __future__ import annotations
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from gie_tpu.api.types import ROLE_LABEL
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool, Pod
+from gie_tpu.extproc import StreamingServer, metadata as mdkeys, pb
+from gie_tpu.extproc.server import PickRequest
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.resilience.breaker import (
+    SERVE,
+    BreakerBoard,
+    BreakerConfig,
+    BreakerState,
+    CircuitBreaker,
+    WindowedRate,
+)
+from gie_tpu.resilience.ladder import (
+    DegradationLadder,
+    LadderConfig,
+    ResilienceState,
+    Rung,
+)
+from gie_tpu.runtime import metrics as own_metrics
+from gie_tpu.sched import ProfileConfig, Scheduler
+from gie_tpu.sched.batching import BatchingTPUPicker
+from gie_tpu.sched.filters import drain_filter
+
+from tests.test_extproc import FakeStream, headers_msg
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _counter(name: str, **labels) -> float:
+    v = own_metrics.REGISTRY.get_sample_value(name, labels or None)
+    return 0.0 if v is None else v
+
+
+# --------------------------------------------------------------------------
+# WindowedRate
+# --------------------------------------------------------------------------
+
+
+def test_windowed_rate_counts_and_prunes():
+    w = WindowedRate(8.0)
+    now = 100.0
+    for i in range(4):
+        w.note(ok=False, now=now + i * 0.1)
+    for i in range(4):
+        w.note(ok=True, now=now + 1 + i * 0.1)
+    err, n = w.rate(now + 2)
+    assert n == 8 and err == pytest.approx(0.5)
+    # Everything ages out of the window: the rate drains to empty.
+    err, n = w.rate(now + 30)
+    assert (err, n) == (0.0, 0)
+
+
+# --------------------------------------------------------------------------
+# Breaker: streak-open vs rate-open (consecutive-5xx OR rate-over-window)
+# --------------------------------------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(open_after=5, open_s=1.0, close_after=2,
+                serve_window_s=8.0, serve_rate_open=0.5,
+                serve_min_samples=6)
+    base.update(kw)
+    return BreakerConfig(**base)
+
+
+def test_streak_open_on_consecutive_serve_failures():
+    clk = Clock()
+    b = CircuitBreaker(_cfg(), clock=clk)
+    for _ in range(4):
+        b.record_serve(ok=False)
+        clk.t += 0.01
+    assert b.state == BreakerState.CLOSED
+    b.record_serve(ok=False)          # 5th consecutive: streak opens
+    assert b.state == BreakerState.OPEN
+    assert b.opened_by == SERVE
+
+
+def test_rate_open_while_scrapes_stay_clean():
+    """The blind spot ISSUE 8 closes: interleaved healthy scrapes keep
+    resetting the failure streak, so only the windowed error rate can
+    open — a pod that scrapes healthy but serves 5xx still quarantines."""
+    clk = Clock()
+    b = CircuitBreaker(_cfg(), clock=clk)
+    for i in range(10):
+        b.record(ok=True)             # scrape sweep lands between serves
+        b.record_serve(ok=(i % 2 == 1))  # 50% serve failure rate
+        clk.t += 0.1
+        if b.state == BreakerState.OPEN:
+            break
+    assert b.state == BreakerState.OPEN
+    assert b.opened_by == SERVE
+    assert b.fail_streak < b.cfg.open_after  # the streak NEVER got there
+
+
+def test_serve_successes_do_not_mask_scrape_failures():
+    """Per-plane streak isolation: a metrics-dead pod serving 2xx at
+    normal QPS must still open via the scrape streak — serve successes
+    arriving between sweeps clear only the SERVE streak (PR 7's
+    control-plane quarantine keeps working under traffic)."""
+    clk = Clock()
+    b = CircuitBreaker(_cfg(open_after=5), clock=clk)
+    for _ in range(5):
+        for _ in range(10):             # healthy serves between sweeps
+            b.record_serve(ok=True)
+        b.record(ok=False)              # the scrape sweep fails
+        clk.t += 0.1
+    assert b.state == BreakerState.OPEN
+    assert b.opened_by == "scrape"
+
+
+def test_one_scrape_hiccup_does_not_steal_a_serve_streak_open():
+    """A serve-failure streak at open_after-1 plus one transient scrape
+    failure must not open the breaker as scrape-owned (the scrape
+    engine's next clean fetches would close it while the pod still
+    5xx-es): each plane opens on ITS OWN streak."""
+    clk = Clock()
+    b = CircuitBreaker(_cfg(open_after=5, serve_min_samples=50), clock=clk)
+    for _ in range(4):
+        b.record_serve(ok=False)        # serve streak at 4
+        clk.t += 0.01
+    b.record(ok=False)                  # scrape hiccup: scrape streak 1
+    assert b.state == BreakerState.CLOSED
+    b.record_serve(ok=False)            # serve streak reaches 5
+    assert b.state == BreakerState.OPEN
+    assert b.opened_by == SERVE
+
+
+def test_rate_needs_min_samples():
+    clk = Clock()
+    b = CircuitBreaker(_cfg(serve_min_samples=50, serve_rate_open=0.4),
+                       clock=clk)
+    for i in range(20):
+        b.record(ok=True)
+        b.record_serve(ok=(i % 2 == 1))  # 50% errors, streak stays at 1
+        clk.t += 0.01
+    # Error rate over the open threshold but under the sample floor
+    # (and no plane's streak ever accumulates): stays closed.
+    assert b.state == BreakerState.CLOSED
+
+
+# --------------------------------------------------------------------------
+# Serve-opened recovery: scrapes cannot close it, live traffic probes it
+# --------------------------------------------------------------------------
+
+
+def test_scrape_success_cannot_close_a_serve_opened_breaker():
+    clk = Clock()
+    board = BreakerBoard(_cfg(), clock=clk)
+    for _ in range(5):
+        board.record_serve_outcome(3, ok=False)
+        clk.t += 0.01
+    assert board.state(3) == BreakerState.OPEN
+    # A storm of healthy scrapes across the dwell: still quarantined
+    # until the dwell elapses (scrape successes are ignored for it).
+    for _ in range(10):
+        board.record(3, ok=True)
+    assert board.state(3) == BreakerState.OPEN
+    assert board.quarantined(3)
+
+
+def test_serve_opened_breaker_recovers_through_live_traffic():
+    clk = Clock()
+    board = BreakerBoard(_cfg(open_s=1.0, close_after=2), clock=clk)
+    for _ in range(5):
+        board.record_serve_outcome(3, ok=False)
+        clk.t += 0.01
+    assert board.quarantined(3)
+    # Dwell elapses: the quarantined() read doubles as the probe gate —
+    # the endpoint re-admits HALF_OPEN and live traffic is the probe.
+    clk.t += 2.0
+    assert not board.quarantined(3)
+    assert board.state(3) == BreakerState.HALF_OPEN
+    # close_after serve successes close it hysteretically.
+    board.record_serve_outcome(3, ok=True)
+    assert board.state(3) == BreakerState.HALF_OPEN
+    board.record_serve_outcome(3, ok=True)
+    assert board.state(3) == BreakerState.CLOSED
+    assert not board.has_open
+
+
+def test_serve_probe_failure_requarantines_for_another_dwell():
+    clk = Clock()
+    board = BreakerBoard(_cfg(open_s=1.0), clock=clk)
+    for _ in range(5):
+        board.record_serve_outcome(3, ok=False)
+        clk.t += 0.01
+    clk.t += 2.0
+    assert not board.quarantined(3)     # probe window opens
+    board.record_serve_outcome(3, ok=False)  # probe 5xx: re-open
+    assert board.state(3) == BreakerState.OPEN
+    assert board.quarantined(3)         # dwell restarts
+
+
+def test_close_resets_serve_window():
+    """The pre-quarantine window errors must not instantly re-open a
+    breaker that just healed."""
+    clk = Clock()
+    b = CircuitBreaker(_cfg(open_s=1.0, close_after=1), clock=clk)
+    for _ in range(5):
+        b.record_serve(ok=False)
+        clk.t += 0.01
+    clk.t += 2.0
+    assert b.allow()                    # HALF_OPEN
+    b.record_serve(ok=True)             # closes (close_after=1)
+    assert b.state == BreakerState.CLOSED
+    _err, n = b.serve_window.rate(clk.t)
+    assert n <= 1                       # only the closing success remains
+
+
+def test_scrape_failure_during_probe_keeps_serve_classification():
+    """A transient scrape hiccup while a serve-opened breaker is
+    HALF_OPEN must not reclassify it as scrape-opened — that would hand
+    recovery to scrape successes, closing it while the pod still 5xxs."""
+    clk = Clock()
+    b = CircuitBreaker(_cfg(open_s=1.0), clock=clk)
+    for _ in range(5):
+        b.record_serve(ok=False)
+        clk.t += 0.01
+    clk.t += 2.0
+    assert b.allow()                    # HALF_OPEN probe window
+    b.record(ok=False)                  # scrape-plane probe failure
+    assert b.state == BreakerState.OPEN
+    assert b.opened_by == SERVE         # classification survives
+    # Healthy scrapes across another dwell still cannot close it.
+    clk.t += 2.0
+    for _ in range(5):
+        b.record(ok=True)
+    assert b.state != BreakerState.CLOSED
+
+
+def test_scrape_opened_breaker_quarantine_stays_read_only():
+    clk = Clock()
+    board = BreakerBoard(_cfg(open_s=1.0), clock=clk)
+    for _ in range(5):
+        board.record(7, ok=False)       # control-plane opens it
+    assert board.state(7) == BreakerState.OPEN
+    clk.t += 5.0
+    # quarantined() never advances a SCRAPE-opened breaker to HALF_OPEN:
+    # the scrape engine owns that probe budget.
+    assert board.quarantined(7)
+    assert board.state(7) == BreakerState.OPEN
+
+
+def test_serve_success_cannot_close_a_scrape_opened_breaker():
+    """The other direction of the plane asymmetry: a pod whose /metrics
+    endpoint died serves 2xx fine — in-flight serve successes must not
+    flip the scrape-opened breaker OPEN -> HALF_OPEN -> CLOSED with zero
+    dwell (the pod would flap in and out of rotation at sweep-vs-request
+    cadence, scored on rows that went dark)."""
+    clk = Clock()
+    board = BreakerBoard(_cfg(open_s=1.0, close_after=2), clock=clk)
+    for _ in range(5):
+        board.record(7, ok=False)       # scrapes open it
+    assert board.state(7) == BreakerState.OPEN
+    for _ in range(5):
+        board.record_serve_outcome(7, ok=True)  # in-flight 2xx completes
+    assert board.state(7) == BreakerState.OPEN
+    # The scrape engine still owns recovery: its probe closes it.
+    clk.t += 2.0
+    board.record(7, ok=True)            # half-open probe (engine-owned)
+    board.record(7, ok=True)
+    assert board.state(7) == BreakerState.CLOSED
+
+
+# --------------------------------------------------------------------------
+# Ladder: pool-wide serve floor
+# --------------------------------------------------------------------------
+
+
+def _serve_ladder(clk, **kw):
+    cfg = dict(dispatch_error_streak=3, blackout_stale_s=60.0,
+               latency_breach_s=60.0, latency_breach_streak=50,
+               recover_streak=2, min_dwell_s=0.0, probe_interval_s=0.01,
+               serve_window_s=8.0, serve_error_rate=0.5,
+               serve_min_samples=10, blackout_recover_fraction=0.5)
+    cfg.update(kw)
+    return DegradationLadder(LadderConfig(**cfg), clock=clk)
+
+
+def test_serve_storm_floors_ladder_and_recovery_is_hysteretic():
+    clk = Clock()
+    lad = _serve_ladder(clk)
+    for _ in range(10):
+        lad.note_serve_outcome(ok=False)
+        clk.t += 0.05
+    assert lad.rung() == Rung.ROUND_ROBIN
+    assert lad.report()["serve_floor"] == int(Rung.ROUND_ROBIN)
+    # Rate falls, but not under rate * recover_fraction: floor holds.
+    for _ in range(12):
+        lad.note_serve_outcome(ok=True)
+        clk.t += 0.05
+    assert lad.rung() == Rung.ROUND_ROBIN  # 10/22 = 0.45 >= 0.25
+    # Under the recovery fraction: the floor lifts.
+    for _ in range(20):
+        lad.note_serve_outcome(ok=True)
+        clk.t += 0.05
+    assert lad.rung() == Rung.FULL
+
+
+def test_serve_floor_lifts_lazily_when_traffic_stops():
+    """With traffic gone no note_serve_outcome will ever arrive to lift
+    the floor — the rung() read must re-evaluate against the drained
+    window."""
+    clk = Clock()
+    lad = _serve_ladder(clk)
+    for _ in range(10):
+        lad.note_serve_outcome(ok=False)
+    assert lad.rung() == Rung.ROUND_ROBIN
+    clk.t += 30.0                       # window drains empty, no feed
+    assert lad.rung() == Rung.FULL
+
+
+# --------------------------------------------------------------------------
+# Graceful drain: datastore lifecycle
+# --------------------------------------------------------------------------
+
+POOL = EndpointPool(selector={"app": "x"}, target_ports=[8000],
+                    namespace="default")
+
+
+def _pod(i, name=None, **kw):
+    return Pod(name=name or f"p{i}", labels={"app": "x"},
+               ip=f"10.9.3.{i + 1}", **kw)
+
+
+def _drain_ds(n=3, **kw):
+    reclaimed = []
+    ds = Datastore(on_slot_reclaimed=reclaimed.append, **kw)
+    ds.pool_set(POOL)
+    for i in range(n):
+        ds.pod_update_or_add(_pod(i))
+    return ds, reclaimed
+
+
+def test_drain_lifecycle_mark_candidacy_readmit_and_delete():
+    ds, reclaimed = _drain_ds(3)
+    assert ds.pod_mark_draining("default", "p0")
+    assert ds.draining_count() == 1
+    hp = {e.hostport for e in ds.pick_candidates()}
+    assert "10.9.3.1:8000" not in hp and len(hp) == 2
+    # The full set still carries the draining endpoint (in-flight use).
+    assert len(ds.endpoints()) == 3
+    assert not reclaimed                # nothing reclaimed yet
+    # Re-admitted ready (rolled-back upgrade): drain cancels.
+    ds.pod_update_or_add(_pod(0))
+    assert ds.draining_count() == 0
+    assert len(ds.pick_candidates()) == 3
+    # Drain again, then the actual deletion event: immediate reclaim.
+    ds.pod_mark_draining("default", "p0")
+    ds.pod_delete("default", "p0")
+    assert reclaimed and ds.draining_count() == 0
+    assert len(ds.endpoints()) == 2
+
+
+def test_drain_mark_without_endpoints_returns_false():
+    ds, _ = _drain_ds(1)
+    assert not ds.pod_mark_draining("default", "never-seen")
+
+
+def test_reap_expired_drains_is_bounded():
+    ds, reclaimed = _drain_ds(2, drain_deadline_s=5.0)
+    t0 = 1000.0
+    ds.pod_mark_draining("default", "p0", now=t0)
+    assert ds.reap_expired_drains(now=t0 + 4.9) == 0
+    assert not reclaimed
+    assert ds.reap_expired_drains(now=t0 + 5.0) == 1
+    assert reclaimed and ds.draining_count() == 0
+    assert len(ds.endpoints()) == 1
+
+
+def test_pick_candidates_availability_floor():
+    ds, _ = _drain_ds(2)
+    ds.pod_mark_draining("default", "p0")
+    ds.pod_mark_draining("default", "p1")
+    # Everything draining: availability beats drain, full set returns.
+    assert len(ds.pick_candidates()) == 2
+
+
+def test_drain_filter_helper():
+    a = SimpleNamespace(draining=False)
+    b = SimpleNamespace(draining=True)
+    assert drain_filter([a, b]) == [a]
+    full = [b, b]
+    assert drain_filter(full) is full   # would empty: unchanged
+    clean = [a, a]
+    assert drain_filter(clean) is clean  # identity-preserving
+
+
+# --------------------------------------------------------------------------
+# Drain exclusion parity: wave candidates AND the ranked fallback tail
+# --------------------------------------------------------------------------
+
+
+def _cluster(n_pods, rs=None, **picker_kw):
+    sched = Scheduler(ProfileConfig(load_decay=1.0))
+    ms = MetricsStore()
+    ds = Datastore(on_slot_reclaimed=lambda s: (sched.evict_endpoint(s),
+                                                ms.remove(s)))
+    ds.pool_set(POOL)
+    for i in range(n_pods):
+        ds.pod_update_or_add(_pod(i))
+    picker = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.005,
+                               resilience=rs, **picker_kw)
+    return sched, ds, ms, picker
+
+
+def test_draining_endpoint_leaves_primary_and_fallback_tail():
+    """Exclusion parity: once marked, the drained endpoint appears
+    neither as the pick nor anywhere in the ranked fallback tail — the
+    wave subset mask and the completion-side tail filter agree."""
+    sched, ds, ms, picker = _cluster(4)
+    try:
+        picker.pick(PickRequest(headers={}, body=b"x"), ds.pick_candidates())
+        drained = "10.9.3.1:8000"
+        assert ds.pod_mark_draining("default", "p0")
+        for _ in range(12):
+            res = picker.pick(PickRequest(headers={}, body=b"x"),
+                              ds.pick_candidates())
+            assert res.endpoint != drained
+            assert drained not in res.fallbacks
+        assert _counter("gie_draining_endpoints") == 1.0
+    finally:
+        picker.close()
+
+
+def test_fallback_tail_filters_even_when_candidates_predate_drain():
+    """A caller holding a stale candidate list (snapshotted before the
+    drain mark) is still protected: the wave-level filter prunes its
+    candidates and the completer prunes the tail."""
+    sched, ds, ms, picker = _cluster(4)
+    try:
+        stale = ds.endpoints()          # includes the soon-drained pod
+        picker.pick(PickRequest(headers={}, body=b"x"), stale)
+        drained = "10.9.3.1:8000"
+        ds.pod_mark_draining("default", "p0")
+        for _ in range(12):
+            res = picker.pick(PickRequest(headers={}, body=b"x"), stale)
+            assert res.endpoint != drained
+            assert drained not in res.fallbacks
+    finally:
+        picker.close()
+
+
+def test_all_draining_still_serves():
+    sched, ds, ms, picker = _cluster(2)
+    try:
+        picker.pick(PickRequest(headers={}, body=b"x"), ds.pick_candidates())
+        ds.pod_mark_draining("default", "p0")
+        ds.pod_mark_draining("default", "p1")
+        res = picker.pick(PickRequest(headers={}, body=b"x"),
+                          ds.pick_candidates())
+        assert ":" in res.endpoint      # availability beats drain
+    finally:
+        picker.close()
+
+
+def test_degraded_rung_honors_drain():
+    """Parity holds on the host-side degraded rungs too."""
+    rs = ResilienceState()
+    sched, ds, ms, picker = _cluster(3, rs=rs)
+    try:
+        ds.pod_mark_draining("default", "p0")
+        drained = "10.9.3.1:8000"
+        from gie_tpu.sched.batching import _Pending
+
+        for rung in (Rung.CACHED, Rung.ROUND_ROBIN, Rung.STATIC):
+            batch = [_Pending(PickRequest(headers={}, body=b"x"),
+                              ds.endpoints(), band=1) for _ in range(6)]
+            picker._degraded_pick(batch, rung)
+            for it in batch:
+                assert it.result is not None
+                assert it.result.endpoint != drained
+                assert drained not in it.result.fallbacks
+    finally:
+        picker.close()
+
+
+# --------------------------------------------------------------------------
+# Abort-as-reset: assumed load releases, the breaker sees the reset
+# --------------------------------------------------------------------------
+
+
+def _resp_headers_msg(served=None, status=b"200"):
+    hm = pb.HeaderMap()
+    hm.headers.append(pb.HeaderValue(key=":status", raw_value=status))
+    req = pb.ProcessingRequest(
+        response_headers=pb.HttpHeaders(headers=hm))
+    if served:
+        from google.protobuf import struct_pb2
+
+        st = struct_pb2.Struct()
+        st.fields[mdkeys.DESTINATION_ENDPOINT_SERVED_KEY].string_value = served
+        req.metadata_context.filter_metadata[
+            mdkeys.DESTINATION_ENDPOINT_NAMESPACE].CopyFrom(st)
+    return req
+
+
+def _server(ds, picker, **kw):
+    return StreamingServer(
+        ds, picker,
+        on_served=picker.observe_served,
+        on_response_complete=picker.observe_response_complete,
+        on_stream_aborted=picker.observe_stream_aborted,
+        **kw)
+
+
+class AbortingStream(FakeStream):
+    """Raises StreamAborted once its messages run out — the gRPC
+    adapter's shape for an Envoy cancellation/reset (service.py), as
+    opposed to FakeStream's clean half-close (recv -> None)."""
+
+    def recv(self):
+        msg = super().recv()
+        if msg is None:
+            from gie_tpu.extproc.server import StreamAborted
+
+            raise StreamAborted()
+        return msg
+
+
+def test_stream_abort_after_pick_releases_charge_and_records_reset():
+    rs = ResilienceState()
+    sched, ds, ms, picker = _cluster(3, rs=rs)
+    srv = _server(ds, picker)
+    try:
+        resets0 = _counter("gie_serve_outcome_total", **{"class": "reset"})
+        # The stream is CANCELLED right after the pick: response headers
+        # never arrive (Envoy upstream reset / client disconnect). Before
+        # ISSUE 8 this leaked the assumed-load charge until pod eviction
+        # and the breaker never learned of the reset.
+        srv.process(AbortingStream([headers_msg()]))
+        load = sched.snapshot_assumed_load()
+        assert float(np.abs(load).sum()) == pytest.approx(0.0, abs=1e-5)
+        assert _counter("gie_serve_outcome_total",
+                        **{"class": "reset"}) == resets0 + 1
+        # One reset is a signal, not a quarantine.
+        assert not rs.board.has_open
+    finally:
+        picker.close()
+
+
+def test_clean_half_close_releases_charge_without_outcome():
+    """A route with no response processing half-closes cleanly after the
+    request phase. The charge must release (no leak) but NO reset may be
+    recorded — otherwise every healthy pod behind such a listener would
+    quarantine (the breaker would see 100% 'resets')."""
+    rs = ResilienceState()
+    sched, ds, ms, picker = _cluster(3, rs=rs)
+    srv = _server(ds, picker)
+    try:
+        resets0 = _counter("gie_serve_outcome_total", **{"class": "reset"})
+        for _ in range(8):
+            srv.process(FakeStream([headers_msg()]))
+        load = sched.snapshot_assumed_load()
+        assert float(np.abs(load).sum()) == pytest.approx(0.0, abs=1e-5)
+        assert _counter("gie_serve_outcome_total",
+                        **{"class": "reset"}) == resets0
+        assert not rs.board.has_open
+    finally:
+        picker.close()
+
+
+def test_served_stream_does_not_double_release():
+    rs = ResilienceState()
+    sched, ds, ms, picker = _cluster(3, rs=rs)
+    srv = _server(ds, picker)
+    try:
+        ok0 = _counter("gie_serve_outcome_total", **{"class": "2xx"})
+        resets0 = _counter("gie_serve_outcome_total", **{"class": "reset"})
+
+        class EchoStream(FakeStream):
+            """Feeds response headers echoing the picked PRIMARY (the
+            destination header is the ordered fallback list; Envoy
+            serves from its head and echoes the one that served)."""
+
+            def recv(self):
+                if not self.messages and len(self.sent) == 1:
+                    mut = self.sent[0].request_headers.response.header_mutation
+                    dest = next(
+                        o.header.raw_value.decode()
+                        for o in mut.set_headers
+                        if o.header.key == mdkeys.DESTINATION_ENDPOINT_KEY)
+                    self.messages.append(
+                        _resp_headers_msg(served=dest.split(",")[0]))
+                return super().recv()
+
+        srv.process(EchoStream([headers_msg()]))
+        load = sched.snapshot_assumed_load()
+        # Released exactly once (a second, abort-path release would have
+        # driven the slot negative).
+        assert float(np.abs(load).sum()) == pytest.approx(0.0, abs=1e-5)
+        assert _counter("gie_serve_outcome_total",
+                        **{"class": "2xx"}) == ok0 + 1
+        assert _counter("gie_serve_outcome_total",
+                        **{"class": "reset"}) == resets0
+    finally:
+        picker.close()
+
+
+def test_local_reply_5xx_attributes_to_primary_and_releases_charge():
+    """Envoy local reply (upstream connect refused): response headers
+    arrive with :status 503 and NO served-endpoint metadata. The verdict
+    attributes to the attempted primary and the charge releases — the
+    connect-refused pod must not stay invisible to the breaker."""
+    board = BreakerBoard(BreakerConfig(open_after=3, open_s=30.0))
+    rs = ResilienceState(board=board)
+    sched, ds, ms, picker = _cluster(1, rs=rs)
+    srv = _server(ds, picker)
+    try:
+        fives0 = _counter("gie_serve_outcome_total", **{"class": "5xx"})
+        only = ds.endpoints()[0]
+
+        class LocalReplyStream(FakeStream):
+            def recv(self):
+                if not self.messages and len(self.sent) == 1:
+                    self.messages.append(
+                        _resp_headers_msg(served=None, status=b"503"))
+                return super().recv()
+
+        for _ in range(3):
+            srv.process(LocalReplyStream([headers_msg()]))
+        assert _counter("gie_serve_outcome_total",
+                        **{"class": "5xx"}) == fives0 + 3
+        assert board.state(only.slot) == BreakerState.OPEN
+        load = sched.snapshot_assumed_load()
+        assert float(np.abs(load).sum()) == pytest.approx(0.0, abs=1e-5)
+    finally:
+        picker.close()
+
+
+def test_expired_drain_reaps_on_pod_churn_without_traffic():
+    """The wave-cadence reap never fires on an idle pool (the collector
+    sleeps without traffic) — the replacement pod's admission event must
+    reap the stuck terminating pod past its deadline instead."""
+    reclaimed = []
+    ds = Datastore(on_slot_reclaimed=reclaimed.append, drain_deadline_s=0.0)
+    ds.pool_set(POOL)
+    ds.pod_update_or_add(_pod(0))
+    ds.pod_mark_draining("default", "p0", now=time.monotonic() - 1.0)
+    # No picks, no waves: the replacement's ADD event does the reap.
+    ds.pod_update_or_add(_pod(1))
+    assert reclaimed
+    assert ds.draining_count() == 0
+    assert {e.hostport for e in ds.endpoints()} == {"10.9.3.2:8000"}
+
+
+def test_failover_feeds_reset_to_the_bypassed_primary():
+    """When Envoy serves from a fallback, the primary it walked past
+    refused/reset — that failure must feed the PRIMARY's breaker (a
+    connect-refusing pod that always fails over would otherwise never
+    quarantine), while the fallback's 2xx is credited to the fallback."""
+    board = BreakerBoard(BreakerConfig(open_after=3, open_s=30.0))
+    rs = ResilienceState(board=board)
+    sched, ds, ms, picker = _cluster(2, rs=rs)
+    try:
+        a, b = ds.endpoints()
+        resets0 = _counter("gie_serve_outcome_total", **{"class": "reset"})
+        for _ in range(3):
+            res = SimpleNamespace(endpoint=a.hostport, charged=None,
+                                  charged_slot=-1, assumed_cost=0.0,
+                                  feedback=None)
+            ctx = SimpleNamespace(pick_result=res, resp_status=200,
+                                  picked_at=time.monotonic(), aborted=False)
+            picker.observe_served(b.hostport, ctx)   # fallback served
+        assert _counter("gie_serve_outcome_total",
+                        **{"class": "reset"}) == resets0 + 3
+        assert board.state(a.slot) == BreakerState.OPEN   # primary
+        assert board.state(b.slot) == BreakerState.CLOSED  # fallback
+    finally:
+        picker.close()
+
+
+def test_serve_5xx_outcomes_open_breaker_via_picker_feedback():
+    """A 5xx storm surfaced at the response-headers hop opens the
+    serving endpoint's breaker and floors the ladder, with no scrape
+    failure anywhere in sight."""
+    board = BreakerBoard(BreakerConfig(
+        open_after=50, open_s=0.5, close_after=2,
+        serve_window_s=4.0, serve_rate_open=0.5, serve_min_samples=6))
+    rs = ResilienceState(board=board, ladder=DegradationLadder(LadderConfig(
+        serve_window_s=4.0, serve_error_rate=0.9, serve_min_samples=500)))
+    sched, ds, ms, picker = _cluster(3, rs=rs)
+    try:
+        sick = ds.endpoints()[0]
+        open0 = board.open_count()
+        for i in range(8):
+            board.record(sick.slot, ok=True)   # scrapes stay pristine
+            ctx = SimpleNamespace(pick_result=None, resp_status=503,
+                                  picked_at=time.monotonic())
+            res = SimpleNamespace(endpoint=sick.hostport, charged=None,
+                                  charged_slot=-1, assumed_cost=0.0,
+                                  feedback=None)
+            ctx.pick_result = res
+            picker.observe_served(sick.hostport, ctx)
+        assert board.open_count() == open0 + 1
+        assert board.state(sick.slot) == BreakerState.OPEN
+        assert _counter("gie_breaker_open_endpoints") >= 1.0
+    finally:
+        picker.close()
+
+
+# --------------------------------------------------------------------------
+# Budget-aware holds and pd split
+# --------------------------------------------------------------------------
+
+
+def test_near_deadline_request_bypasses_saturation_hold():
+    sched, ds, ms, picker = _cluster(
+        2, hold_max_s=1.5, hold_queue_limit=0.0, hold_retry_s=0.05)
+    try:
+        # Warm the jit outside the timed window: CRITICAL bypasses holds.
+        picker.pick(
+            PickRequest(headers={mdkeys.OBJECTIVE_KEY: ["critical"]},
+                        body=b"x"),
+            ds.pick_candidates())
+        bypass0 = _counter("gie_hold_budget_bypass_total")
+        t0 = time.monotonic()
+        res = picker.pick(
+            PickRequest(headers={}, body=b"x",
+                        deadline_at=time.monotonic() + 0.08),
+            ds.pick_candidates())
+        elapsed = time.monotonic() - t0
+        assert ":" in res.endpoint          # picked best-effort, NOW
+        assert elapsed < 1.0                # not held toward hold_max_s
+        assert _counter("gie_hold_budget_bypass_total") == bypass0 + 1
+    finally:
+        picker.close()
+
+
+def test_budgetless_request_still_holds():
+    """Requests without a deadline keep the PR 7 hold behavior: they
+    wait out the hold window on a saturated pool."""
+    sched, ds, ms, picker = _cluster(
+        2, hold_max_s=0.4, hold_queue_limit=0.0, hold_retry_s=0.02)
+    try:
+        picker.pick(
+            PickRequest(headers={mdkeys.OBJECTIVE_KEY: ["critical"]},
+                        body=b"x"),
+            ds.pick_candidates())
+        t0 = time.monotonic()
+        res = picker.pick(PickRequest(headers={}, body=b"x"),
+                          ds.pick_candidates())
+        assert ":" in res.endpoint
+        assert time.monotonic() - t0 >= 0.4  # held the full window
+    finally:
+        picker.close()
+
+
+def _pd_cluster(**picker_kw):
+    sched = Scheduler(ProfileConfig(pd_disaggregation=True, load_decay=1.0))
+    ms = MetricsStore()
+    ds = Datastore(on_slot_reclaimed=lambda s: (sched.evict_endpoint(s),
+                                                ms.remove(s)))
+    ds.pool_set(POOL)
+    for i, role in enumerate(("prefill", "decode")):
+        ds.pod_update_or_add(Pod(
+            name=f"p{i}", labels={"app": "x", ROLE_LABEL: role},
+            ip=f"10.9.4.{i + 1}"))
+    picker = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.005, **picker_kw)
+    return sched, ds, ms, picker
+
+
+def test_pd_split_collapses_to_single_hop_under_budget_floor():
+    sched, ds, ms, picker = _pd_cluster(pd_budget_floor_s=0.5)
+    try:
+        # Warm (no deadline): full pd split with a prefill hop header.
+        res = picker.pick(PickRequest(headers={}, body=b"x" * 64),
+                          ds.pick_candidates())
+        assert mdkeys.PREFILL_ENDPOINT_KEY in res.extra_headers
+        ctx = SimpleNamespace(pick_result=res, resp_status=0, picked_at=0.0)
+        picker.observe_served(res.endpoint, ctx)
+        single0 = _counter("gie_pd_budget_singlehop_total")
+        # Budget above the floor: the cross-worker hop stays.
+        res = picker.pick(
+            PickRequest(headers={}, body=b"x" * 64,
+                        deadline_at=time.monotonic() + 10.0),
+            ds.pick_candidates())
+        assert mdkeys.PREFILL_ENDPOINT_KEY in res.extra_headers
+        ctx = SimpleNamespace(pick_result=res, resp_status=0, picked_at=0.0)
+        picker.observe_served(res.endpoint, ctx)
+        # Budget under the floor: decode-only, prefill charge released.
+        res = picker.pick(
+            PickRequest(headers={}, body=b"x" * 64,
+                        deadline_at=time.monotonic() + 0.3),
+            ds.pick_candidates())
+        assert mdkeys.PREFILL_ENDPOINT_KEY not in res.extra_headers
+        assert _counter("gie_pd_budget_singlehop_total") == single0 + 1
+        assert len(res.charged) == 1    # decode worker only
+        decode_slot = ds.endpoint_by_hostport(res.endpoint).slot
+        load = sched.snapshot_assumed_load()
+        prefill_slot = 1 - decode_slot
+        assert float(load[prefill_slot]) == pytest.approx(0.0, abs=1e-5)
+        assert float(load[decode_slot]) > 0.0
+        ctx = SimpleNamespace(pick_result=res, resp_status=0, picked_at=0.0)
+        picker.observe_served(res.endpoint, ctx)
+        load = sched.snapshot_assumed_load()
+        assert float(np.abs(load).sum()) == pytest.approx(0.0, abs=1e-5)
+    finally:
+        picker.close()

@@ -258,10 +258,16 @@ def test_reconcile_flow_end_to_end():
     assert ds.pool_has_synced()
     cluster.apply_pod(make_pod())
     assert len(ds.endpoints()) == 2
-    # Pod goes unready -> evicted (pod_reconciler.go:90-102).
+    # Pod goes unready WHILE serving -> graceful drain, not eviction
+    # (docs/RESILIENCE.md; deviation from pod_reconciler.go:90-102):
+    # the endpoints stay live for in-flight streams, marked DRAINING.
     cluster.apply_pod(make_pod(ready=False))
-    assert ds.endpoints() == []
+    assert [e.draining for e in ds.endpoints()] == [True, True]
+    # Readiness flap back -> the drain cancels, full candidacy returns.
     cluster.apply_pod(make_pod())
+    assert [e.draining for e in ds.endpoints()] == [False, False]
+    assert len(ds.pick_candidates()) == 2
+    # The actual deletion event reclaims immediately.
     cluster.delete_pod("default", "p1")
     assert ds.endpoints() == []
 

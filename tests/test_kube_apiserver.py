@@ -96,12 +96,19 @@ def test_pod_lifecycle_through_real_watch_loop(stack):
     srv.apply("pods", pod_manifest("pod-b", "10.0.0.2"))
     assert _wait(lambda: len(ds.endpoints()) == 2), "live ADDED missed"
 
-    # Readiness flip -> endpoint withdrawn.
+    # Readiness flip -> graceful drain (docs/RESILIENCE.md): the
+    # endpoint leaves NEW-pick candidacy but stays live for in-flight
+    # streams until its deletion event or the bounded drain deadline.
     srv.apply("pods", pod_manifest("pod-a", "10.0.0.1", ready=False))
-    assert _wait(lambda: {e.hostport for e in ds.endpoints()}
-                 == {"10.0.0.2:8000"}), "unready pod not withdrawn"
+    assert _wait(lambda: {e.hostport for e in ds.pick_candidates()}
+                 == {"10.0.0.2:8000"}), "unready pod not draining"
+    assert {e.hostport for e in ds.endpoints()} == {
+        "10.0.0.1:8000", "10.0.0.2:8000"}
 
-    # DELETED -> gone.
+    # DELETED -> gone (the draining pod's deletion reclaims immediately).
+    srv.delete("pods", NS, "pod-a")
+    assert _wait(lambda: {e.hostport for e in ds.endpoints()}
+                 == {"10.0.0.2:8000"}), "draining pod DELETE missed"
     srv.delete("pods", NS, "pod-b")
     assert _wait(lambda: len(ds.endpoints()) == 0), "DELETE missed"
 

@@ -174,4 +174,7 @@ def test_runner_wires_kube_elector_and_gates_readiness(apiserver):
         finally:
             b.stop()
     finally:
-        runner.elector.stop()
+        # Full stop, not just the elector: the runner's ScrapeEngine
+        # shards otherwise outlive the test and keep rewriting global
+        # gauges (gie_breaker_open_endpoints) for the rest of the run.
+        runner.stop()

@@ -157,18 +157,28 @@ def test_restored_confidence_applies_at_startup(tmp_path):
                    predictor_checkpoint_dir=ckpt,
                    scheduler_config=str(cfg_yaml))
     runner = ExtProcServerRunner(opts, FakeCluster())
-    # Freshly-restarted runner: restored confidence gates the column NOW.
-    # (The runner's trainer has its own confidence_min_samples, so compare
-    # against ITS view of the restored state, not t1's.)
-    live = float(runner.scheduler.weights.latency)
-    assert live == pytest.approx(2.0 * runner.trainer.confidence(), rel=1e-5)
-    assert live > 0.0
+    try:
+        # Freshly-restarted runner: restored confidence gates the column
+        # NOW. (The runner's trainer has its own confidence_min_samples,
+        # so compare against ITS view of the restored state, not t1's.)
+        live = float(runner.scheduler.weights.latency)
+        assert live == pytest.approx(2.0 * runner.trainer.confidence(),
+                                     rel=1e-5)
+        assert live > 0.0
 
-    # Without a checkpoint the column starts at zero (untrained predictor).
-    opts2 = Options(pool_name="p", enable_predictor=True,
-                    scheduler_config=str(cfg_yaml))
-    runner2 = ExtProcServerRunner(opts2, FakeCluster())
-    assert float(runner2.scheduler.weights.latency) == 0.0
+        # Without a checkpoint the column starts at zero (untrained).
+        opts2 = Options(pool_name="p", enable_predictor=True,
+                        scheduler_config=str(cfg_yaml))
+        runner2 = ExtProcServerRunner(opts2, FakeCluster())
+        try:
+            assert float(runner2.scheduler.weights.latency) == 0.0
+        finally:
+            runner2.stop()
+    finally:
+        # Unstopped runners leak their ScrapeEngine shard threads, which
+        # keep rewriting global gauges (gie_breaker_open_endpoints) for
+        # the rest of the pytest process.
+        runner.stop()
 
 
 def test_predictor_without_ceiling_skips_cycle_column():
@@ -181,9 +191,12 @@ def test_predictor_without_ceiling_skips_cycle_column():
 
     opts = Options(pool_name="p", enable_predictor=True)
     runner = ExtProcServerRunner(opts, FakeCluster())
-    assert runner.trainer is not None          # admission path available
-    assert runner.scheduler.predictor_fn is None   # no cycle cost
-    assert runner.scheduler.base_latency_weight == 0.0
+    try:
+        assert runner.trainer is not None      # admission path available
+        assert runner.scheduler.predictor_fn is None   # no cycle cost
+        assert runner.scheduler.base_latency_weight == 0.0
+    finally:
+        runner.stop()
 
 
 def test_pool_aggregate_gauges_for_hpa():
@@ -201,28 +214,35 @@ def test_pool_aggregate_gauges_for_hpa():
 
     opts = Options(pool_name="p")
     runner = ExtProcServerRunner(opts, FakeCluster())
-    runner.datastore.pool_set(EndpointPool({"app": "x"}, [8000], "default"))
-    runner.datastore.pod_update_or_add(
-        Pod(name="p0", labels={"app": "x"}, ip="10.1.0.1"))
-    runner.datastore.pod_update_or_add(
-        Pod(name="p1", labels={"app": "x"}, ip="10.1.0.2"))
-    slots = [ep.slot for ep in runner.datastore.endpoints()]
-    for s in slots:
-        runner.metrics_store.update(
-            s, {C.Metric.QUEUE_DEPTH: 7.0, C.Metric.KV_CACHE_UTIL: 0.5})
+    try:
+        runner.datastore.pool_set(
+            EndpointPool({"app": "x"}, [8000], "default"))
+        runner.datastore.pod_update_or_add(
+            Pod(name="p0", labels={"app": "x"}, ip="10.1.0.1"))
+        runner.datastore.pod_update_or_add(
+            Pod(name="p1", labels={"app": "x"}, ip="10.1.0.2"))
+        slots = [ep.slot for ep in runner.datastore.endpoints()]
+        for s in slots:
+            runner.metrics_store.update(
+                s, {C.Metric.QUEUE_DEPTH: 7.0, C.Metric.KV_CACHE_UTIL: 0.5})
 
-    snap = runner._pool_snapshot()
-    assert snap["ready_endpoints"] == 2.0
-    assert snap["queue_depth_total"] == pytest.approx(14.0)
-    assert snap["kv_cache_util_mean"] == pytest.approx(0.5)
-    assert snap["saturated_fraction"] == 0.0
+        snap = runner._pool_snapshot()
+        assert snap["ready_endpoints"] == 2.0
+        assert snap["queue_depth_total"] == pytest.approx(14.0)
+        assert snap["kv_cache_util_mean"] == pytest.approx(0.5)
+        assert snap["saturated_fraction"] == 0.0
 
-    text = generate_latest(own_metrics.REGISTRY).decode()
-    assert "gie_pool_endpoints 2.0" in text
-    assert "gie_pool_queue_depth_total 14.0" in text
+        text = generate_latest(own_metrics.REGISTRY).decode()
+        assert "gie_pool_endpoints 2.0" in text
+        assert "gie_pool_queue_depth_total 14.0" in text
 
-    # A second runner re-registers without duplicating collectors, and the
-    # gauges follow the LATEST runner's snapshot.
-    runner2 = ExtProcServerRunner(Options(pool_name="p2"), FakeCluster())
-    text = generate_latest(own_metrics.REGISTRY).decode()
-    assert "gie_pool_endpoints 0.0" in text
+        # A second runner re-registers without duplicating collectors,
+        # and the gauges follow the LATEST runner's snapshot.
+        runner2 = ExtProcServerRunner(Options(pool_name="p2"), FakeCluster())
+        try:
+            text = generate_latest(own_metrics.REGISTRY).decode()
+            assert "gie_pool_endpoints 0.0" in text
+        finally:
+            runner2.stop()
+    finally:
+        runner.stop()

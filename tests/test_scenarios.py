@@ -1,0 +1,352 @@
+"""Recorded chaos scenario files + the ISSUE 8 acceptance scenarios
+(docs/RESILIENCE.md "scenario files"; ROADMAP item 8).
+
+The loader tier pins the JSON schema (seed + rules + drive, ``rules``
+beating ``faults`` spec strings, unknown points rejected) and the
+file -> schedule determinism claim. The replay tier drives the shipped
+scenarios through the real stack:
+
+  serve-5xx-storm   one endpoint 503s on the data plane while its
+                    scrapes stay pristine — the windowed breaker opens
+                    it within one error window and picks route around.
+  reset-storm       upstream resets before response headers — the
+                    abort-as-reset path releases every assumed-load
+                    charge and quarantines the pod.
+  rolling-upgrade   sequential drain/replace of every endpoint under
+                    continuous traffic: zero client-visible 5xx, zero
+                    picks to a draining endpoint after its mark, zero
+                    orphaned assumed-load slots.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool, Pod
+from gie_tpu.extproc import metadata as mdkeys
+from gie_tpu.extproc.server import PickRequest
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.resilience import faults, scenarios
+from gie_tpu.resilience.breaker import (
+    BreakerBoard, BreakerConfig, BreakerState)
+from gie_tpu.resilience.ladder import (
+    DegradationLadder, LadderConfig, ResilienceState)
+from gie_tpu.sched import ProfileConfig, Scheduler
+from gie_tpu.sched.batching import BatchingTPUPicker
+
+from tests.test_extproc import FakeStream, headers_msg
+from tests.test_dataplane import _counter, _resp_headers_msg, _server
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# --------------------------------------------------------------------------
+# Loader
+# --------------------------------------------------------------------------
+
+
+def test_shipped_library_loads():
+    names = scenarios.list_scenarios()
+    assert {"rolling-upgrade", "serve-5xx-storm", "reset-storm",
+            "mixed-soak"} <= set(names)
+    for name in names:
+        scn = scenarios.load(name)
+        assert scn.name == name and scn.description and scn.drive
+    assert scenarios.load("rolling-upgrade").rules == {}
+    assert "endpoint.serve_5xx" in scenarios.load("serve-5xx-storm").rules
+    assert "endpoint.reset" in scenarios.load("reset-storm").rules
+
+
+def test_rules_win_over_spec_strings(tmp_path):
+    p = tmp_path / "s.json"
+    p.write_text(json.dumps({
+        "name": "s", "description": "d", "seed": 7,
+        "faults": ["scrape.fetch=error:0.1"],
+        "rules": {"scrape.fetch": {"p_error": 1.0, "keys": ["10.0.0.1"],
+                                   "after": 2, "max_fires": 5}},
+    }))
+    scn = scenarios.load(str(p))
+    rule = scn.rules["scrape.fetch"]
+    assert rule.p_error == 1.0 and rule.keys == ("10.0.0.1",)
+    assert rule.after == 2 and rule.max_fires == 5
+
+
+def test_loader_rejects_bad_files(tmp_path):
+    missing = tmp_path / "missing-seed.json"
+    missing.write_text(json.dumps({"name": "x", "description": "d"}))
+    with pytest.raises(ValueError, match="seed"):
+        scenarios.load(str(missing))
+    unknown = tmp_path / "unknown-point.json"
+    unknown.write_text(json.dumps({
+        "name": "x", "description": "d", "seed": 1,
+        "rules": {"nope.nothing": {"p_error": 1.0}}}))
+    with pytest.raises(ValueError, match="unknown fault point"):
+        scenarios.load(str(unknown))
+    badfield = tmp_path / "bad-field.json"
+    badfield.write_text(json.dumps({
+        "name": "x", "description": "d", "seed": 1,
+        "rules": {"scrape.fetch": {"probability": 1.0}}}))
+    with pytest.raises(ValueError, match="unknown fields"):
+        scenarios.load(str(badfield))
+    with pytest.raises(ValueError, match="no such scenario"):
+        scenarios.load("does-not-exist")
+
+
+def test_scenario_schedule_is_deterministic():
+    """Same file -> same injector -> bit-identical verdict stream and
+    fault log: the replay claim scenario files exist to make."""
+    scn = scenarios.load("serve-5xx-storm")
+    i1, i2 = scn.injector(), scn.injector()
+    keys = ["10.9.1.1:8000", "10.9.1.2:8000", "10.9.1.1:8000"]
+    seq1 = [i1.verdict("endpoint.serve_5xx", key=k).kind
+            for k in keys * 20]
+    seq2 = [i2.verdict("endpoint.serve_5xx", key=k).kind
+            for k in keys * 20]
+    assert seq1 == seq2
+    assert i1.log == i2.log and i1.log  # and it genuinely fired
+
+
+# --------------------------------------------------------------------------
+# Replay harness
+# --------------------------------------------------------------------------
+
+POOL = EndpointPool(selector={"app": "x"}, target_ports=[8000],
+                    namespace="default")
+
+
+class EchoStream(FakeStream):
+    """One request/response exchange: request headers, then response
+    headers echoing the picked PRIMARY as the served endpoint with a
+    200 — the destination header is a comma-separated fallback list and
+    Envoy serves from its head; the chaos seams rewrite the verdict
+    from there."""
+
+    def recv(self):
+        if not self.messages and len(self.sent) == 1:
+            mut = self.sent[0].request_headers.response.header_mutation
+            dest = next(
+                o.header.raw_value.decode() for o in mut.set_headers
+                if o.header.key == mdkeys.DESTINATION_ENDPOINT_KEY)
+            self.messages.append(
+                _resp_headers_msg(served=dest.split(",")[0]))
+        return super().recv()
+
+
+def _stack(n_pods, rs, ip_base="10.9.1", drain_deadline_s=30.0):
+    sched = Scheduler(ProfileConfig(load_decay=1.0))
+    ms = MetricsStore()
+    ds = Datastore(on_slot_reclaimed=lambda s: (sched.evict_endpoint(s),
+                                                ms.remove(s)),
+                   drain_deadline_s=drain_deadline_s)
+    ds.pool_set(POOL)
+    for i in range(n_pods):
+        ds.pod_update_or_add(Pod(name=f"p{i}", labels={"app": "x"},
+                                 ip=f"{ip_base}.{i + 1}"))
+    picker = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.002,
+                               resilience=rs)
+    return sched, ds, ms, picker
+
+
+def _favor(ms, ds, hostport, depth=8.0):
+    """Scrape rows making ``hostport`` the pool's MOST attractive pick
+    (empty queue, everyone else ``depth`` deep) — the fast-failing-pod
+    pathology: a pod that 503s/resets instantly drains its queue, so
+    control-plane load signals actively steer MORE traffic at it. Only
+    the data-plane outcome loop can break that attraction."""
+    from gie_tpu.sched import constants as C
+    for ep in ds.endpoints():
+        q = 0.0 if ep.hostport == hostport else depth
+        ms.update(ep.slot, {int(C.Metric.QUEUE_DEPTH): q})
+
+
+# --------------------------------------------------------------------------
+# serve-5xx-storm: data-plane 5xx opens the breaker, scrapes stay clean
+# --------------------------------------------------------------------------
+
+
+def test_serve_5xx_storm_opens_breaker_with_scrapes_clean():
+    scn = scenarios.load("serve-5xx-storm")
+    sick_hp = scn.drive["sick"]
+    board = BreakerBoard(BreakerConfig(
+        open_after=50,                 # streak CANNOT open it (scrapes
+        open_s=30.0,                   # keep resetting it below) — only
+        serve_window_s=10.0,           # the windowed rate model can
+        serve_rate_open=0.5, serve_min_samples=6))
+    rs = ResilienceState(board=board, ladder=DegradationLadder(LadderConfig(
+        serve_min_samples=10_000)))    # ladder floor: not this test
+    sched, ds, ms, picker = _stack(scn.drive["pods"], rs)
+    _favor(ms, ds, sick_hp)
+    srv = _server(ds, picker)
+    inj = scn.arm()
+    try:
+        sick_slot = ds.endpoint_by_hostport(sick_hp).slot
+        fives0 = _counter("gie_serve_outcome_total", **{"class": "5xx"})
+        served_after_open = []
+        for _ in range(scn.drive["requests"]):
+            # A pristine scrape sweep lands between every request: the
+            # control plane swears this pod is healthy throughout.
+            for ep in ds.endpoints():
+                board.record(ep.slot, ok=True)
+            stream = EchoStream([headers_msg()])
+            srv.process(stream)
+            if board.state(sick_slot) == BreakerState.OPEN:
+                served_after_open.append(stream)
+                if len(served_after_open) >= 10:
+                    break
+        assert board.state(sick_slot) == BreakerState.OPEN, (
+            "serve-5xx storm never opened the sick endpoint's breaker")
+        assert inj.fired.get("endpoint.serve_5xx", 0) >= 6
+        # The acceptance metrics reflect it.
+        assert _counter("gie_serve_outcome_total", **{"class": "5xx"}) \
+            >= fives0 + 6
+        assert _counter("gie_breaker_open_endpoints") >= 1.0
+        # With the breaker open, picks route AROUND the sick endpoint.
+        for _ in range(8):
+            stream = EchoStream([headers_msg()])
+            srv.process(stream)
+            mut = stream.sent[0].request_headers.response.header_mutation
+            dest = next(o.header.raw_value.decode() for o in mut.set_headers
+                        if o.header.key == mdkeys.DESTINATION_ENDPOINT_KEY)
+            assert dest != sick_hp
+    finally:
+        picker.close()
+
+
+# --------------------------------------------------------------------------
+# reset-storm: aborts release charges and quarantine the resetting pod
+# --------------------------------------------------------------------------
+
+
+def test_reset_storm_releases_every_charge_and_quarantines():
+    scn = scenarios.load("reset-storm")
+    sick_hp = scn.drive["sick"]
+    board = BreakerBoard(BreakerConfig(open_after=5, open_s=30.0))
+    rs = ResilienceState(board=board, ladder=DegradationLadder(LadderConfig(
+        serve_min_samples=10_000)))
+    sched, ds, ms, picker = _stack(scn.drive["pods"], rs)
+    _favor(ms, ds, sick_hp)
+    srv = _server(ds, picker)
+    inj = scn.arm()
+    try:
+        sick_slot = ds.endpoint_by_hostport(sick_hp).slot
+        resets0 = _counter("gie_serve_outcome_total", **{"class": "reset"})
+        for _ in range(scn.drive["requests"]):
+            srv.process(EchoStream([headers_msg()]))
+            if board.state(sick_slot) == BreakerState.OPEN:
+                break
+        assert board.state(sick_slot) == BreakerState.OPEN, (
+            "reset storm never quarantined the resetting endpoint")
+        fired = inj.fired.get("endpoint.reset", 0)
+        assert fired >= 5
+        assert _counter("gie_serve_outcome_total", **{"class": "reset"}) \
+            == resets0 + fired
+        # Zero orphaned assumed-load slots: every aborted stream's
+        # charge was released at teardown.
+        load = sched.snapshot_assumed_load()
+        assert float(np.abs(load).sum()) == pytest.approx(0.0, abs=1e-4)
+    finally:
+        picker.close()
+
+
+# --------------------------------------------------------------------------
+# rolling-upgrade: the ISSUE 8 acceptance scenario
+# --------------------------------------------------------------------------
+
+
+def test_rolling_upgrade_zero_client_visible_5xx():
+    """Sequential drain/replace of EVERY endpoint under continuous
+    traffic: no pick ever fails (zero client-visible 5xx/429), no pick
+    enqueued after a pod's drain mark lands on it, and at the end no
+    assumed-load slot is orphaned and nothing is still draining."""
+    scn = scenarios.load("rolling-upgrade")
+    d = scn.drive
+    assert scn.rules == {}             # pure-drive scenario: churn IS the
+    rs = ResilienceState()             # chaos, nothing is injected
+    sched, ds, ms, picker = _stack(
+        d["pods"], rs, ip_base="10.9.5",
+        drain_deadline_s=d["drain_deadline_s"])
+    errors: list = []
+    log: list = []                     # (enqueue_t, hostport)
+    stop = threading.Event()
+
+    def traffic():
+        while not stop.is_set():
+            t = time.monotonic()
+            try:
+                res = picker.pick(PickRequest(headers={}, body=b"x"),
+                                  ds.pick_candidates())
+                ctx = SimpleNamespace(pick_result=res, resp_status=200,
+                                      picked_at=t)
+                picker.observe_served(res.endpoint, ctx)
+                log.append((t, res.endpoint))
+            except Exception as e:  # noqa: BLE001 — the scenario subject
+                errors.append(e)
+            time.sleep(d["pick_interval_s"])
+
+    try:
+        # Warm BOTH wave lattices (size-1 and size-2..8 buckets) outside
+        # the scenario window so no mid-upgrade pick stalls on jit: one
+        # solo pick compiles the size-1 bucket, then a concurrent burst
+        # compiles the batched bucket.
+        picker.pick(PickRequest(headers={}, body=b"x"), ds.pick_candidates())
+        warm = [threading.Thread(target=lambda: picker.pick(
+            PickRequest(headers={}, body=b"x"), ds.pick_candidates()))
+            for _ in range(4)]
+        [t.start() for t in warm]
+        [t.join() for t in warm]
+        threads = [threading.Thread(target=traffic)
+                   for _ in range(d["traffic_threads"])]
+        [t.start() for t in threads]
+        # The churn only starts once the traffic loop is demonstrably
+        # hot — the zero-5xx claim is vacuous over an idle pool.
+        warm_until = time.monotonic() + 30.0
+        while len(log) < 30 and time.monotonic() < warm_until:
+            time.sleep(0.01)
+        assert len(log) >= 30, "traffic loop never got hot"
+        marks: list = []               # (hostport, mark_t)
+        for i in range(d["pods"]):
+            hp = f"10.9.5.{i + 1}:8000"
+            mark_t = time.monotonic()
+            assert ds.pod_mark_draining("default", f"p{i}")
+            time.sleep(d["drain_settle_s"])   # in-flight completes
+            ds.pod_delete("default", f"p{i}")  # the deletion event lands
+            ds.pod_update_or_add(Pod(          # the replacement joins
+                name=f"p{i}-new", labels={"app": "x"},
+                ip=f"10.9.6.{i + 1}"))
+            marks.append((hp, mark_t))
+        time.sleep(0.2)
+        stop.set()
+        [t.join(timeout=20) for t in threads]
+        assert not errors, f"client-visible failures: {errors[:3]}"
+        assert len(log) > 50, "traffic generator barely ran"
+        # Zero picks to a drained endpoint after its mark (a small
+        # epsilon absorbs enqueue-vs-mark clock ordering: a pick that
+        # READ its candidates before the mark may carry t ~ mark_t).
+        for hp, mark_t in marks:
+            late = [t for t, ep in log if ep == hp and t > mark_t + 0.05]
+            assert not late, (
+                f"{len(late)} picks landed on {hp} after its drain mark")
+        # Every original endpoint was replaced; traffic reached the new
+        # pods; nothing is left draining; no assumed-load slot leaked.
+        assert {ep for _, ep in log} & {
+            f"10.9.6.{i + 1}:8000" for i in range(d["pods"])}
+        assert ds.draining_count() == 0
+        assert {e.hostport for e in ds.endpoints()} == {
+            f"10.9.6.{i + 1}:8000" for i in range(d["pods"])}
+        load = sched.snapshot_assumed_load()
+        assert float(np.abs(load).sum()) == pytest.approx(0.0, abs=1e-3)
+    finally:
+        stop.set()
+        picker.close()
