@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native native-asan generate lint fuzz-smoke chaos-ci chaos-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
+.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
 
 all: native generate
 
@@ -21,6 +21,12 @@ native-asan:
 # entries — the baseline can only shrink.
 lint:
 	$(PY) -m gie_tpu.lint gie_tpu
+
+# Metrics-catalog lint (gie_tpu/obs/metricscheck.py, docs/OBSERVABILITY.md):
+# every metric gie_-prefixed with help text, bounded label width, and no
+# per-endpoint/per-request identity labels (cardinality bombs).
+obs-check:
+	$(PY) -m gie_tpu.obs.metricscheck
 
 # Bounded ASan/UBSan fuzz pass over the three native libraries, seeded
 # from the parity-test corpora (FUZZ_SECS per library, default 30).
@@ -50,14 +56,15 @@ generate:
 	$(PY) -m gie_tpu.api.crdgen config/crd/bases
 
 # Full test tier: unit + conformance on the virtual 8-device CPU mesh.
-# Lint and the fast chaos gate run first: a hierarchy violation or a
-# deterministic-seed resilience regression fails before the full suite.
-# The chaos files are excluded from the main sweep — chaos-ci already
-# ran them (the slow soak lives in chaos-smoke, not here).
-test: lint chaos-ci
+# Lint, the metrics-catalog check, and the fast chaos gate run first: a
+# hierarchy violation, a malformed metric, or a deterministic-seed
+# resilience regression fails before the full suite. The chaos files
+# are excluded from the main sweep — chaos-ci already ran them (the
+# slow soak lives in chaos-smoke, not here).
+test: lint obs-check chaos-ci
 	$(PY) -m pytest tests/ -q --ignore=tests/test_scenarios.py --ignore=tests/test_chaos.py
 
-test-unit: lint
+test-unit: lint obs-check
 	$(PY) -m pytest tests/ -q --ignore=tests/test_conformance.py
 
 # Conformance suite with report emission (reference `go test ./conformance`).

@@ -332,6 +332,35 @@ class Datastore:
         with self._lock:
             return len(self._draining)
 
+    def debug_report(self) -> dict:
+        """Datastore zpage (/debugz/datastore, gie_tpu/obs): the pool
+        sync state, snapshot generation, slot pressure, and the live
+        endpoint table with drain deadlines — the exact inputs the pick
+        path's cached snapshots were built from. Lock held only for the
+        dict build; no callbacks, no I/O."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "pool_synced": self._pool is not None,
+                "pool_generation": self.pool_generation,
+                "endpoints": [
+                    {
+                        "name": ep.name,
+                        "hostport": ep.hostport,
+                        "slot": ep.slot,
+                        "draining": bool(ep.draining),
+                        "drain_remaining_s": (
+                            round(max(ep.drain_until - now, 0.0), 2)
+                            if ep.draining else None),
+                    }
+                    for ep in self._endpoints.values()
+                ],
+                "draining": len(self._draining),
+                "free_slots": len(self._free_slots),
+                "overflow": self._overflow,
+                "drain_deadline_s": self.drain_deadline_s,
+            }
+
     def endpoint_by_hostport(self, hostport: str) -> Optional[Endpoint]:
         with self._lock:
             return self._by_hostport.get(hostport)

@@ -32,7 +32,9 @@ import grpc
 
 from google.protobuf.message import DecodeError as _DecodeError
 
+from gie_tpu import obs
 from gie_tpu.extproc import codec, envoy, fieldscan, metadata, pb
+from gie_tpu.obs import trace as obs_trace
 from gie_tpu.resilience import deadline as deadline_mod
 from gie_tpu.resilience import faults
 from gie_tpu.resilience.deadline import DeadlineExceeded
@@ -59,6 +61,10 @@ NEEDED_REQUEST_HEADERS = frozenset({
     # bound and Envoy's route timeout.
     deadline_mod.GATEWAY_DEADLINE_HEADER,
     deadline_mod.ENVOY_TIMEOUT_HEADER,
+    # Trace-context propagation (gie_tpu/obs, docs/OBSERVABILITY.md):
+    # the W3C trace ID and Envoy's request ID.
+    obs_trace.TRACEPARENT_HEADER,
+    obs_trace.REQUEST_ID_HEADER,
 })
 
 
@@ -91,6 +97,10 @@ class PickRequest:
     # Monotonic request deadline (0.0 = none; resilience/deadline.py):
     # the batching collector sheds queued picks past this with 503.
     deadline_at: float = 0.0
+    # Trace context (obs.trace.TraceCtx or None): rides the pick through
+    # the flow queue and wave so the scheduler stages can stamp events
+    # and the flight-recorder record carries the trace ID.
+    trace: object = None
 
 
 @dataclasses.dataclass(slots=True)
@@ -115,6 +125,10 @@ class PickResult:
     charged: Optional[list] = None
     # Optional (feature_row, picked_at) recorded for online latency training.
     feedback: Optional[tuple] = None
+    # Flight-recorder decision record this pick published (gie_tpu/obs):
+    # the serve-outcome path mutates its outcome fields in place so the
+    # record closes with what the data plane actually did.
+    record: Optional[dict] = None
 
     @property
     def destination_value(self) -> str:
@@ -270,6 +284,12 @@ class RequestContext:
     # (transcoded Generate frames, or >=2 SSE data frames) — a buffered
     # JSON body split across network flushes must never train TPOT.
     timing_is_generation: bool = False
+    # Trace context for this stream (obs.trace.TraceCtx, None while
+    # tracing is off) and the outcome class its closure reports when an
+    # exit path decided it explicitly (shed / deadline / unavailable /
+    # error); "" lets teardown derive it from the stream state.
+    trace: object = None
+    trace_outcome: str = ""
 
     def reset(self) -> None:
         """Return to the pristine state with FRESH containers (never
@@ -302,6 +322,8 @@ class RequestContext:
         self.resp_status = 0
         self.resp_headers_seen = False
         self.aborted = False
+        self.trace = None
+        self.trace_outcome = ""
 
 
 # Bounded RequestContext free-list (fast lane): one context per stream at
@@ -425,6 +447,19 @@ _ADMISSION_LANES = {
 }
 
 
+def _observe_admission(ctx: "RequestContext", t0: float) -> None:
+    """Admission histogram observe, with an OpenMetrics exemplar linking
+    the bucket to this request's trace when it was head-sampled (the
+    dashboards' histogram -> trace join, docs/OBSERVABILITY.md). The
+    untraced path is the bare observe the fast lane always paid."""
+    dt = time.perf_counter() - t0
+    tr = ctx.trace
+    if tr is not None and tr.sampled:
+        _ADMISSION_LANES[ctx.lane].observe(dt, {"trace_id": tr.trace_id})
+    else:
+        _ADMISSION_LANES[ctx.lane].observe(dt)
+
+
 def _shed_response(e: Exception) -> pb.ProcessingResponse:
     """ImmediateResponse for a request the EPP will not schedule: 429 for
     load shedding (ShedError, 004 README:80), 503 for an exhausted
@@ -525,8 +560,17 @@ class StreamingServer:
             self._process_with(ctx, stream)
         except StreamAborted:
             ctx.aborted = True  # cancelled/reset: nothing left to send
+        except ExtProcError as e:
+            ctx.aborted = True  # stream-fatal protocol error
+            if not ctx.trace_outcome:
+                ctx.trace_outcome = (
+                    "unavailable" if e.code == grpc.StatusCode.UNAVAILABLE
+                    else "error")
+            raise
         except Exception:
-            ctx.aborted = True  # stream-fatal protocol/internal error
+            ctx.aborted = True  # stream-fatal internal error
+            if not ctx.trace_outcome:
+                ctx.trace_outcome = "error"
             raise
         finally:
             # Teardown accounting (both lanes, every exit path): a stream
@@ -538,6 +582,11 @@ class StreamingServer:
             # configured for this route, and counting those as resets
             # would quarantine every healthy pod behind such a listener.
             self._finish_stream(ctx)
+            # Trace closure rides the same every-exit-path finally: ok,
+            # shed, deadline 503, unavailable, abort, internal error —
+            # every stream that began a trace closes it exactly once.
+            if ctx.trace is not None:
+                self._finish_trace(ctx)
             if self.fast_lane:
                 # Hooks ran synchronously inside the loop; nothing holds
                 # the context once the stream ends (reset() hands out
@@ -559,6 +608,30 @@ class StreamingServer:
             self.on_stream_aborted(ctx)
         except Exception:
             pass  # teardown accounting must never mask the stream error
+
+    def _finish_trace(self, ctx: RequestContext) -> None:
+        """Close this stream's trace (docs/OBSERVABILITY.md lifecycle).
+        Outcome precedence: an exit path's explicit verdict (shed /
+        deadline / unavailable / error), else the stream state (abort,
+        serve 5xx), else ok. The pick's flight-recorder record — if one
+        was published — is summarized into the exported trace."""
+        tracer = obs.TRACER
+        if tracer is None:
+            return  # tracer uninstalled mid-stream (tests): drop quietly
+        outcome = ctx.trace_outcome
+        if not outcome:
+            if ctx.aborted:
+                outcome = "aborted"
+            elif ctx.resp_status >= 500:
+                outcome = "serve_5xx"
+            else:
+                outcome = "ok"
+        pr = ctx.pick_result
+        try:
+            tracer.finish(ctx.trace, outcome,
+                          record=pr.record if pr is not None else None)
+        except Exception:
+            pass  # trace export must never mask the stream outcome
 
     def _process_with(self, ctx: RequestContext, stream: Stream) -> None:
         body = bytearray()
@@ -584,11 +657,13 @@ class StreamingServer:
                     try:
                         self._pick(ctx, None)
                     except (ShedError, DeadlineExceeded) as e:
+                        ctx.trace_outcome = (
+                            "deadline" if isinstance(e, DeadlineExceeded)
+                            else "shed")
                         stream.send(_shed_response(e))
                         return
                     stream.send(self._headers_response(ctx))
-                    _ADMISSION_LANES[ctx.lane].observe(
-                        time.perf_counter() - admission_t0)
+                    _observe_admission(ctx, admission_t0)
                 else:
                     headers_deferred = True
             elif which == "request_body":
@@ -605,6 +680,9 @@ class StreamingServer:
                     try:
                         result = self._pick(ctx, bytes(body))
                     except (ShedError, DeadlineExceeded) as e:
+                        ctx.trace_outcome = (
+                            "deadline" if isinstance(e, DeadlineExceeded)
+                            else "shed")
                         stream.send(_shed_response(e))
                         return
                     if headers_deferred:
@@ -625,8 +703,7 @@ class StreamingServer:
                                 )
                             )
                         )
-                    _ADMISSION_LANES[ctx.lane].observe(
-                        time.perf_counter() - admission_t0)
+                    _observe_admission(ctx, admission_t0)
                 else:
                     # Intermediate chunks need no reply in buffered-partial
                     # mode; continue receiving.
@@ -718,6 +795,14 @@ class StreamingServer:
                 ctx.headers.setdefault(h.key, []).append(
                     envoy.get_header_value(h)
                 )
+
+        # Trace begin (gie_tpu/obs): with tracing off (sample rate 0 or
+        # obs uninstalled) this is one module-attribute load and a None
+        # check — the bench-extproc guard pins the unsampled fast lane.
+        if obs.ENABLED:
+            tracer = obs.TRACER
+            if tracer is not None:
+                ctx.trace = tracer.begin(ctx.headers)
 
         # Deadline propagation (resilience/deadline.py): resolve the
         # monotonic budget once, at header time. The no-deadline common
@@ -896,6 +981,7 @@ class StreamingServer:
                 model=model,
                 decode_tokens=_decode_tokens(ctx.headers, parsed, scan),
                 deadline_at=ctx.deadline_at,
+                trace=ctx.trace,
             ),
             ctx.candidates,
         )
@@ -1176,6 +1262,8 @@ class StreamingServer:
                 status = 503
         ctx.resp_status = status
         ctx.resp_headers_seen = True
+        if ctx.trace is not None:
+            ctx.trace.event("response_headers")
         report = served
         if not report and ctx.pick_result is not None:
             # Envoy local reply (upstream connect refused/timed out, or a

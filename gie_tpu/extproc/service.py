@@ -20,6 +20,7 @@ from gie_tpu.extproc.server import (
     StreamAborted,
     StreamingServer,
 )
+from gie_tpu.runtime import metrics as own_metrics
 
 SERVICE_NAME = "envoy.service.ext_proc.v3.ExternalProcessor"
 
@@ -57,6 +58,12 @@ def _process_handler(server: StreamingServer):
                     ExtProcError(grpc.StatusCode.INTERNAL, f"internal error: {e}")
                 )
             finally:
+                if failure:
+                    # Stream-fatal failures by gRPC code (gie-obs): the
+                    # aborts Envoy converts per FailureMode were
+                    # previously visible only in Envoy's own stats.
+                    own_metrics.STREAM_ERRORS.labels(
+                        code=failure[0].code.name.lower()).inc()
                 out.put(done)
 
         t = threading.Thread(target=run, daemon=True)

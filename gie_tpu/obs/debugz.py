@@ -1,0 +1,159 @@
+"""/debugz introspection plane on the metrics HTTP surface.
+
+One stdlib ThreadingHTTPServer replaces ``prometheus_client``'s
+``start_http_server`` so the EPP's single operator port serves BOTH:
+
+  /metrics            Prometheus exposition. Content-negotiated: an
+                      ``Accept: application/openmetrics-text`` scrape
+                      gets the OpenMetrics form, which is what carries
+                      the trace-ID EXEMPLARS attached to
+                      gie_extproc_admission_seconds /
+                      gie_pick_latency_seconds buckets — the bucket ->
+                      trace join (docs/OBSERVABILITY.md).
+  /debugz             JSON catalog of the registered zpages.
+  /debugz/<page>      one zpage, JSON. The runner registers: traces /
+                      trace / picks / pick / breakers / ladder / drain /
+                      queue / datastore / scheduler / buildinfo.
+
+Providers are callables ``(query: dict[str, str]) -> object`` so the
+plane stays dependency-inverted: obs knows nothing about the runner's
+subsystems, the runner hands in closures. Handlers run on the HTTP
+server's worker threads; every provider reads snapshots/reports that
+take at most a leaf lock briefly — never the pick lock, and all JSON
+serialization happens here, outside every gie_tpu lock.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Mapping
+from urllib.parse import parse_qsl, urlparse
+
+import prometheus_client as prom
+from prometheus_client.openmetrics import exposition as openmetrics
+
+Provider = Callable[[dict], object]
+
+
+def _jsonable(obj):
+    """json.dumps default: numpy scalars -> python, everything else str."""
+    item = getattr(obj, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    return str(obj)
+
+
+class DebugzServer:
+    """The combined /metrics + /debugz listener."""
+
+    def __init__(self, port: int, registry, providers: Mapping[str, Provider],
+                 bind: str = "0.0.0.0"):
+        self.registry = registry
+        self.providers = dict(providers)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    outer._handle(self)
+                except BrokenPipeError:
+                    pass  # scraper went away mid-write
+                except Exception as e:  # debug plane must never crash
+                    try:
+                        self.send_error(500, f"{type(e).__name__}: {e}")
+                    except Exception:
+                        pass
+
+            def log_message(self, *args):
+                pass  # operator plane: no per-scrape stderr chatter
+
+        try:
+            self._httpd = ThreadingHTTPServer((bind, port), Handler)
+        except OSError as e:
+            raise OSError(f"failed to bind metrics/debugz port {port}: {e}")
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="gie-debugz", daemon=True)
+        self._thread.start()
+
+    # -- request handling --------------------------------------------------
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        path = parsed.path.rstrip("/") or "/"
+        if path == "/debugz":
+            self._send_json(req, {
+                "pages": sorted(f"/debugz/{name}" for name in self.providers),
+                "metrics": "/metrics (Accept: application/openmetrics-text "
+                           "for exemplars)",
+            })
+            return
+        if path.startswith("/debugz/"):
+            name = path[len("/debugz/"):]
+            provider = self.providers.get(name)
+            if provider is None:
+                req.send_error(404, f"no such zpage: {name}")
+                return
+            query = dict(parse_qsl(parsed.query))
+            self._send_json(req, provider(query))
+            return
+        # Everything else is the exposition — prometheus_client's
+        # start_http_server serves metrics on ANY path, and existing
+        # scrape configs may point at non-/metrics paths.
+        self._serve_metrics(req, parse_qsl(parsed.query))
+
+    def _serve_metrics(self, req: BaseHTTPRequestHandler,
+                       query_pairs: list) -> None:
+        """Exposition with prometheus_client-handler parity: ``name[]``
+        metric filtering, gzip under Accept-Encoding (Prometheus sends
+        it by default — the ~50-metric exemplar-bearing exposition
+        should not ship uncompressed every 15 s), and OpenMetrics under
+        content negotiation (the exemplar transport)."""
+        names = [v for k, v in query_pairs if k == "name[]"]
+        registry = self.registry
+        if names:
+            registry = registry.restricted_registry(names)
+        accept = req.headers.get("Accept", "")
+        if "application/openmetrics-text" in accept:
+            body = openmetrics.generate_latest(registry)
+            ctype = openmetrics.CONTENT_TYPE_LATEST
+        else:
+            body = prom.generate_latest(registry)
+            ctype = prom.CONTENT_TYPE_LATEST
+        req.send_response(200)
+        req.send_header("Content-Type", ctype)
+        if "gzip" in req.headers.get("Accept-Encoding", ""):
+            body = gzip.compress(body, 5)
+            req.send_header("Content-Encoding", "gzip")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _send_json(self, req: BaseHTTPRequestHandler, obj) -> None:
+        body = json.dumps(obj, indent=1, default=_jsonable).encode()
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    # ----------------------------------------------------------------------
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def start_debugz_server(
+    port: int, registry, providers: Mapping[str, Provider] | None = None,
+    bind: str = "0.0.0.0",
+) -> DebugzServer:
+    """Start the combined listener (the runner's metrics-port server)."""
+    return DebugzServer(port, registry, providers or {}, bind=bind)

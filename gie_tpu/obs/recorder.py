@@ -1,0 +1,93 @@
+"""Pick flight recorder: a fixed-size lock-free ring of per-request
+scheduling decision records.
+
+Every answered pick — full TPU cycle or degraded rung — appends one
+record: the candidate subset the request arrived with, who was excluded
+and why (breaker quarantine, graceful drain), the ranked choice with
+its blended score vector, a host-side scorer breakdown for the chosen
+endpoint, the ladder rung, the remaining deadline budget, and (filled
+in later by the serve-outcome path) what the data plane actually did
+with the decision. This is the record "Simple is Better" (PAPERS.md)
+assumes exists: rich enough to replay and score scheduling policies
+offline, and the raw material for ROADMAP items 3/8/9 (learned-policy
+training traces, p99 outlier ejection, real-hardware calibration).
+
+Concurrency: writers are the batching completer, the dispatcher's
+degraded path, and the ext-proc response threads (outcome updates) —
+all append/mutate without a lock. The ring is a preallocated slot list;
+each writer takes a ticket from an ``itertools.count`` (its C-level
+``next`` is atomic under the GIL) and stores a FULLY-BUILT dict with
+one list-item assignment. Readers reconstruct order from the ``seq``
+embedded in each record, so a torn read can only miss the newest
+in-flight slot, never see a half-written record. Outcome updates mutate
+fields of an already-published dict (GIL-atomic item assignment).
+
+Records are written at wave-completion cadence on the completer thread
+— NEVER under the scheduler's pick lock, and with no device pulls of
+their own (the scorer breakdown reads the wave's already-materialized
+host-side arrays; gie-lint's GL002 blocking set covers the JSON export
+so it can never creep under a declared lock).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Optional
+
+
+class FlightRecorder:
+    """Fixed-size lock-free decision-record ring."""
+
+    def __init__(self, size: int = 512):
+        if size < 1:
+            raise ValueError("flight recorder size must be >= 1")
+        self.size = size
+        self._slots: list = [None] * size
+        self._tickets = itertools.count()
+
+    def append(self, record: dict) -> dict:
+        """Publish one fully-built record (stamps ``seq``); returns it so
+        callers can keep the reference for later outcome updates."""
+        i = next(self._tickets)          # atomic ticket
+        record["seq"] = i
+        self._slots[i % self.size] = record  # atomic publish
+        return record
+
+    def __len__(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def snapshot(self, n: int = 0) -> list[dict]:
+        """Shallow copies of the live records, oldest first (newest-first
+        when trimmed to the last ``n``). Copying detaches the zpage/JSON
+        view from in-flight outcome mutations; record field values are
+        scalars/small lists, so a shallow copy is a consistent-enough
+        read without any writer coordination."""
+        live = [dict(s) for s in list(self._slots) if s is not None]
+        live.sort(key=lambda r: r.get("seq", 0))
+        if n > 0:
+            live = live[-n:][::-1]
+        return live
+
+    def find(self, trace_id: str = "", seq: Optional[int] = None
+             ) -> Optional[dict]:
+        """Newest record matching a trace ID (or exact seq) — the
+        /debugz/pick join."""
+        best = None
+        for s in list(self._slots):
+            if s is None:
+                continue
+            if seq is not None:
+                if s.get("seq") == seq:
+                    return dict(s)
+                continue
+            if trace_id and s.get("trace_id") == trace_id:
+                if best is None or s.get("seq", 0) > best.get("seq", 0):
+                    best = s
+        return dict(best) if best is not None else None
+
+    def export_json(self, n: int = 0) -> str:
+        """Serialize the ring for artifacts/zpages. Listed in gie-lint's
+        GL002 blocking set: serialization is I/O-scale work and must
+        never run under a declared lock (the pick lock above all)."""
+        return json.dumps(self.snapshot(n), default=str)

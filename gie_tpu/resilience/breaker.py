@@ -346,6 +346,30 @@ class BreakerBoard:
             return sum(1 for b in self._breakers.values()
                        if b.state == BreakerState.OPEN)
 
+    def report(self) -> dict:
+        """Full per-endpoint breaker dump for the /debugz/breakers zpage
+        (gie_tpu/obs): state, owning plane, both planes' streaks, the
+        serve window's live error rate, and dwell age — everything
+        states() summarizes away. Leaf-lock only; no I/O under it."""
+        with self._lock:
+            now = self.clock()
+            out = {}
+            for key, b in self._breakers.items():
+                err, n = b.serve_window.rate(now)
+                out[str(key)] = {
+                    "state": b.state,
+                    "opened_by": b.opened_by,
+                    "fail_streaks": dict(b.fail_streaks),
+                    "ok_streak": b.ok_streak,
+                    "open_age_s": (
+                        round(now - b.opened_at, 3)
+                        if b.state != BreakerState.CLOSED else 0.0),
+                    "serve_error_rate": round(err, 4),
+                    "serve_samples": n,
+                    "transitions": b.transitions,
+                }
+            return {"has_open": self.has_open, "breakers": out}
+
     def drop(self, key: int) -> None:
         """Endpoint evicted: its breaker history must not outlive it (a
         reused slot starts CLOSED)."""

@@ -48,6 +48,13 @@ def set_verbosity(v: int) -> None:
     _level.set(v)
 
 
+def trace_enabled() -> bool:
+    """Public accessor: is TRACE verbosity live right now? Hot paths
+    (runtime/tracing.py span exits) gate record construction on this
+    instead of reaching into the private ``_level`` holder."""
+    return _level.get() >= TRACE
+
+
 class Logger:
     """JSON-lines logger with key-value context (zap sugar analogue)."""
 

@@ -278,6 +278,43 @@ PD_BUDGET_SINGLEHOP = prom.Counter(
     "cross-worker prefill hop",
     registry=REGISTRY,
 )
+# gie-obs (gie_tpu/obs, docs/OBSERVABILITY.md): build identity + the
+# tracing/flight-recorder plane's own counters. BUILD_INFO is the
+# standard constant-1 info gauge — joinable onto any other series to
+# slice dashboards by version/feature-flag mix during rollouts.
+BUILD_INFO = prom.Gauge(
+    "gie_build_info",
+    "Constant 1 with build/runtime identity labels: package version and "
+    "the lane/resilience/obs feature-flag mix this replica runs",
+    ["version", "fast_lane", "resilience", "obs"],
+    registry=REGISTRY,
+)
+STREAM_ERRORS = prom.Counter(
+    "gie_extproc_stream_errors_total",
+    "Stream-fatal ext-proc failures surfaced to Envoy, by gRPC status "
+    "code (label values are the bounded grpc.StatusCode enum)",
+    ["code"],
+    registry=REGISTRY,
+)
+TRACES_EXPORTED = prom.Counter(
+    "gie_obs_traces_exported_total",
+    "Request traces exported to the /debugz feeds, by why they were "
+    "kept (head sample, error-class outcome, latency tail outlier)",
+    ["reason"],  # sampled|error|slow
+    registry=REGISTRY,
+)
+
+
+def set_build_info(fast_lane: bool, resilience: bool, obs: bool) -> None:
+    """Stamp the constant-1 build-identity series (runner startup)."""
+    from gie_tpu.version import __version__
+
+    BUILD_INFO.labels(
+        version=__version__,
+        fast_lane=str(bool(fast_lane)).lower(),
+        resilience=str(bool(resilience)).lower(),
+        obs=str(bool(obs)).lower(),
+    ).set(1)
 
 
 _POOL_SNAPSHOT = {"fn": lambda: {}, "registered": False,
@@ -340,5 +377,12 @@ def register_pool_aggregates(snapshot) -> None:
                 _pool_snapshot_cached().get(field, 0.0)))
 
 
-def start_metrics_server(port: int) -> None:
-    prom.start_http_server(port, registry=REGISTRY)
+def start_metrics_server(port: int, providers=None):
+    """Start the operator HTTP listener: /metrics (Prometheus text, or
+    OpenMetrics-with-exemplars under content negotiation) plus the
+    /debugz introspection plane (gie_tpu/obs/debugz.py) for whatever
+    zpage providers the caller registers. Returns the server (close()
+    to stop); replaces prometheus_client's bare start_http_server."""
+    from gie_tpu.obs.debugz import start_debugz_server
+
+    return start_debugz_server(port, REGISTRY, providers)

@@ -139,6 +139,29 @@ class Options:
     # whose remaining deadline budget is under this floor collapse to
     # the decode worker only (no cross-worker prefill hop). 0 disables.
     pd_budget_floor_ms: float = 250.0
+    # gie-obs (gie_tpu/obs, docs/OBSERVABILITY.md): the pick flight
+    # recorder + /debugz introspection plane. On by default — records
+    # are written at wave-completion cadence, off the admission hot
+    # path; --no-obs removes even that.
+    obs: bool = True
+    # Head-sampling rate for end-to-end request traces, deterministic
+    # per trace ID. 0 (default) installs no tracer at all — the
+    # admission path pays one module-attribute load and a falsy branch
+    # (bench_extproc's regression guard pins it). At any rate > 0,
+    # errors/sheds/deadline breaches/latency tail outliers export
+    # regardless of the head decision.
+    obs_sample_rate: float = 0.0
+    # Deterministic sampling seed: same seed + same trace ID = same
+    # keep/drop on every replica.
+    obs_sample_seed: int = 0
+    # Flight-recorder ring capacity (records, fixed at startup).
+    obs_ring: int = 512
+    # Latency tail-outlier threshold: a request slower than this exports
+    # its trace even when head sampling dropped it.
+    obs_slow_ms: float = 250.0
+    # Where --fault-scenario runs (and failed chaos tests) dump the
+    # flight-recorder JSON artifact.
+    obs_dump_dir: str = "/tmp/gie-obs"
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -320,6 +343,33 @@ class Options:
                             help="disaggregated picks with less deadline "
                                  "budget than this collapse to the decode "
                                  "worker only (0 disables)")
+        parser.add_argument("--obs", dest="obs", action="store_true",
+                            default=d.obs,
+                            help="pick flight recorder + /debugz "
+                                 "introspection plane "
+                                 "(docs/OBSERVABILITY.md)")
+        parser.add_argument("--no-obs", dest="obs", action="store_false",
+                            help="disable the observability layer "
+                                 "entirely (no recorder, no tracer, "
+                                 "bare /metrics only)")
+        parser.add_argument("--obs-sample-rate", type=float,
+                            default=d.obs_sample_rate,
+                            help="head-sampling rate for request traces "
+                                 "in [0, 1]; 0 installs no tracer (errors "
+                                 "always export at any rate > 0)")
+        parser.add_argument("--obs-sample-seed", type=int,
+                            default=d.obs_sample_seed,
+                            help="deterministic sampling seed (same seed "
+                                 "+ trace ID = same keep/drop everywhere)")
+        parser.add_argument("--obs-ring", type=int, default=d.obs_ring,
+                            help="flight-recorder ring capacity (records)")
+        parser.add_argument("--obs-slow-ms", type=float,
+                            default=d.obs_slow_ms,
+                            help="latency tail-outlier threshold: slower "
+                                 "traces export even when unsampled")
+        parser.add_argument("--obs-dump-dir", default=d.obs_dump_dir,
+                            help="directory for chaos-scenario flight-"
+                                 "recorder JSON artifacts")
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "Options":
@@ -372,6 +422,12 @@ class Options:
             fault_scenario=args.fault_scenario,
             drain_deadline_s=args.drain_deadline_s,
             pd_budget_floor_ms=args.pd_budget_floor_ms,
+            obs=args.obs,
+            obs_sample_rate=args.obs_sample_rate,
+            obs_sample_seed=args.obs_sample_seed,
+            obs_ring=args.obs_ring,
+            obs_slow_ms=args.obs_slow_ms,
+            obs_dump_dir=args.obs_dump_dir,
         )
 
     def validate(self) -> None:
@@ -456,6 +512,12 @@ class Options:
                 raise ValueError(f"--fault-scenario: {e}") from None
         if self.drain_deadline_s <= 0:
             raise ValueError("--drain-deadline-s must be > 0")
+        if not (0.0 <= self.obs_sample_rate <= 1.0):
+            raise ValueError("--obs-sample-rate must be in [0, 1]")
+        if self.obs_ring < 1:
+            raise ValueError("--obs-ring must be >= 1")
+        if self.obs_slow_ms <= 0:
+            raise ValueError("--obs-slow-ms must be > 0")
         if self.pd_budget_floor_ms < 0:
             raise ValueError("--pd-budget-floor-ms must be >= 0")
         for spec in self.objectives:

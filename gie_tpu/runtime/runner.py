@@ -123,6 +123,24 @@ class ExtProcServerRunner:
                 self.scheduler.gate_latency_column(self.trainer.confidence())
         self.metrics_store = MetricsStore()
         self.mapping = BY_NAME[opts.model_server_type]
+        # gie-obs (gie_tpu/obs, docs/OBSERVABILITY.md): the pick flight
+        # recorder (always, when obs is on — written at wave cadence)
+        # and the request tracer (only at a sampling rate > 0; rate 0
+        # leaves the admission path at one module-attr load + branch).
+        self._obs_installed = False
+        if opts.obs:
+            from gie_tpu import obs
+            from gie_tpu.obs.recorder import FlightRecorder
+            from gie_tpu.obs.trace import Tracer
+
+            tracer = None
+            if opts.obs_sample_rate > 0:
+                tracer = Tracer(
+                    opts.obs_sample_rate, seed=opts.obs_sample_seed,
+                    slow_s=opts.obs_slow_ms / 1000.0)
+            obs.install(tracer=tracer,
+                        recorder=FlightRecorder(opts.obs_ring))
+            self._obs_installed = True
         # Unified resilience layer (gie_tpu/resilience, docs/RESILIENCE.md):
         # one breaker board (scrape outcomes write, pick path reads), one
         # degradation ladder (batching collector drives), the scrape
@@ -323,9 +341,11 @@ class ExtProcServerRunner:
         )
         self.grpc_server: Optional[grpc.Server] = None
         self.health_server: Optional[grpc.Server] = None
+        self.debugz_server = None
         self.kv_events = None
         self.kv_events_server = None
         self._cert_reloader = None
+        self._scenario_name: Optional[str] = None
         self._stopped = threading.Event()
 
     def ready(self) -> bool:
@@ -365,6 +385,93 @@ class ExtProcServerRunner:
             "assumed_load_total": float(load[in_bucket].sum()),
             "saturated_fraction": agg["saturated_fraction"],
         }
+
+    def _debugz_providers(self) -> dict:
+        """The /debugz zpage catalog (gie_tpu/obs/debugz.py): closures
+        over the live subsystems. Every provider reads a snapshot/report
+        surface that takes at most a leaf lock briefly — never the pick
+        lock — and all JSON serialization happens in the HTTP layer."""
+        from gie_tpu import obs
+        from gie_tpu.version import __version__
+
+        def traces(q: dict):
+            t = obs.TRACER
+            if t is None:
+                return {"disabled":
+                        "tracing off (--obs-sample-rate 0 or --no-obs)"}
+            return {"tracer": t.report(),
+                    "traces": t.traces(q.get("kind", "recent"),
+                                       n=int(q.get("n", "50")))}
+
+        def trace(q: dict):
+            t = obs.TRACER
+            if t is None:
+                return {"disabled": "tracing off"}
+            found = t.get(q.get("id", ""))
+            return found if found is not None else {
+                "error": "no such trace (feed wrapped, or it was never "
+                         "exported — unsampled and uneventful)"}
+
+        def picks(q: dict):
+            r = obs.RECORDER
+            if r is None:
+                return {"disabled": "--no-obs"}
+            return r.snapshot(n=int(q.get("n", "100")))
+
+        def pick(q: dict):
+            # The per-request pick EXPLANATION: the flight-recorder
+            # decision record joined with its exported trace (when one
+            # exists) — "why did request X land on pod Y".
+            r = obs.RECORDER
+            if r is None:
+                return {"disabled": "--no-obs"}
+            seq = q.get("seq")
+            rec = r.find(trace_id=q.get("trace", ""),
+                         seq=int(seq) if seq is not None else None)
+            if rec is None:
+                return {"error": "no record for that trace/seq (ring "
+                                 "wrapped, or the pick predates obs)"}
+            out = {"record": rec}
+            t = obs.TRACER
+            if t is not None and rec.get("trace_id"):
+                tr = t.get(rec["trace_id"])
+                if tr is not None:
+                    out["trace"] = tr
+            return out
+
+        def drain(q: dict):
+            report = self.datastore.debug_report()
+            return {
+                "draining": report["draining"],
+                "drain_deadline_s": report["drain_deadline_s"],
+                "endpoints": [e for e in report["endpoints"]
+                              if e["draining"]],
+            }
+
+        providers = {
+            "traces": traces,
+            "trace": trace,
+            "picks": picks,
+            "pick": pick,
+            "queue": lambda q: self.picker.queue_report(),
+            "datastore": lambda q: self.datastore.debug_report(),
+            "scheduler": lambda q: self.scheduler.debug_report(),
+            "drain": drain,
+            "buildinfo": lambda q: {
+                "version": __version__,
+                "fast_lane": self.opts.extproc_fast_lane,
+                "resilience": self.opts.resilience,
+                "obs": self._obs_installed,
+                "obs_sample_rate": self.opts.obs_sample_rate,
+                "fault_scenario": self.opts.fault_scenario or None,
+            },
+        }
+        if self.resilience is not None:
+            providers["breakers"] = (
+                lambda q: self.resilience.board.report())
+            providers["ladder"] = (
+                lambda q: self.resilience.report())
+        return providers
 
     def _autoscale_ttft_probe(self):
         """-> (predicted_ttft_s, ttft_slo_s) for the autoscale capacity
@@ -491,6 +598,7 @@ class ExtProcServerRunner:
 
             scn = scenarios.load(self.opts.fault_scenario)
             scn.arm()
+            self._scenario_name = scn.name
             self.log.info("chaos scenario armed", name=scn.name,
                           seed=scn.seed, path=scn.path)
         self.health_server, _ = start_dedicated_health_server(
@@ -500,8 +608,14 @@ class ExtProcServerRunner:
             self.resilience.healthy if self.resilience is not None
             else None,
         )
+        own_metrics.set_build_info(
+            fast_lane=self.opts.extproc_fast_lane,
+            resilience=self.opts.resilience,
+            obs=self._obs_installed)
         try:
-            own_metrics.start_metrics_server(self.opts.metrics_port)
+            self.debugz_server = own_metrics.start_metrics_server(
+                self.opts.metrics_port,
+                providers=self._debugz_providers())
         except OSError as e:
             self.log.error("metrics server failed to start", err=e)
 
@@ -627,6 +741,25 @@ class ExtProcServerRunner:
             self.kv_events_server.close()
         self.picker.close()
         self.scraper.close()
+        if self.debugz_server is not None:
+            try:
+                self.debugz_server.close()
+            except Exception:
+                pass  # listener teardown must not block shutdown
+        if self._obs_installed:
+            from gie_tpu import obs
+
+            if self.opts.fault_scenario:
+                # Chaos-scenario artifact (docs/OBSERVABILITY.md): the
+                # ring buffer IS the explanation of what the scenario
+                # did to the pick path — dump it so a failed run reads
+                # back its own decisions.
+                path = obs.dump_artifact(
+                    self.opts.obs_dump_dir,
+                    name=self._scenario_name or "scenario")
+                if path:
+                    self.log.info("flight recorder dumped", path=path)
+            obs.uninstall()
         if self.opts.fault_specs:
             from gie_tpu.resilience import faults
 

@@ -61,7 +61,7 @@ class _Span:
     def __exit__(self, exc_type, exc, tb):
         elapsed = time.monotonic() - self.started
         _child(self.name).observe(elapsed)
-        if own_logging._level.get() >= TRACE:
+        if own_logging.trace_enabled():
             _log.v(TRACE).info(
                 "span", name=self.name, seconds=round(elapsed, 6),
                 **self.attrs

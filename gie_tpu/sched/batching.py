@@ -29,6 +29,7 @@ from typing import Optional
 import grpc
 import numpy as np
 
+from gie_tpu import obs
 from gie_tpu.runtime import metrics as own_metrics
 
 from gie_tpu.extproc.server import (
@@ -107,7 +108,8 @@ def _fair_order(items: list["_Pending"]) -> list["_Pending"]:
 
 class _Pending:
     __slots__ = ("req", "candidates", "event", "result", "error",
-                 "enqueued_at", "abandoned", "band", "cand_slots")
+                 "enqueued_at", "abandoned", "band", "cand_slots",
+                 "excl_breaker", "excl_drain")
 
     def __init__(self, req: PickRequest, candidates: list, band: Optional[int] = None):
         self.req = req
@@ -131,6 +133,11 @@ class _Pending:
         self.cand_slots = np.fromiter(
             (getattr(ep, "slot", -1) for ep in candidates),
             np.int64, len(candidates))
+        # Slots the wave-level filters excluded for THIS item (flight-
+        # recorder provenance, gie_tpu/obs): breaker quarantine and
+        # graceful drain. Empty tuples until a filter actually fires.
+        self.excl_breaker: tuple = ()
+        self.excl_drain: tuple = ()
 
 
 def assemble_wave(
@@ -394,6 +401,9 @@ class BatchingTPUPicker:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"malformed objective header: {type(e).__name__}: {e}")
         item = _Pending(req, candidates, band=band)
+        tr = req.trace
+        if tr is not None:
+            tr.event("queued")
         with self._cond:
             if self._closed:
                 raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "picker shut down")
@@ -460,6 +470,24 @@ class BatchingTPUPicker:
         # what was observed, not the primary's).
         status = int(getattr(ctx, "resp_status", 0) or 0)
         primary = getattr(pick_result, "endpoint", "")
+        rec = getattr(pick_result, "record", None)
+        if rec is not None:
+            # Close the flight-recorder record with what the data plane
+            # actually did: who served, which fallback rank Envoy walked
+            # to, the observed verdict and serve latency. Field writes
+            # on a published dict are GIL-atomic; zpage reads snapshot.
+            rec["served"] = served_hostport
+            ranked = [primary] + list(
+                getattr(pick_result, "fallbacks", None) or [])
+            rec["fallback_rank"] = (
+                ranked.index(served_hostport)
+                if served_hostport in ranked else -1)
+            if status > 0:
+                rec["outcome"] = f"{status // 100}xx"
+                picked_at = float(getattr(ctx, "picked_at", 0.0) or 0.0)
+                if picked_at:
+                    rec["serve_latency_ms"] = round(max(
+                        time.monotonic() - picked_at, 0.0) * 1e3, 1)
         if (primary and served_hostport
                 and served_hostport != primary):
             # Envoy walked the fallback list: an earlier entry — the
@@ -557,7 +585,11 @@ class BatchingTPUPicker:
             return
         self._release_charge(pick_result)
         primary = getattr(pick_result, "endpoint", "")
-        if primary and getattr(ctx, "aborted", True):
+        aborted = getattr(ctx, "aborted", True)
+        rec = getattr(pick_result, "record", None)
+        if rec is not None:
+            rec["outcome"] = "reset" if aborted else "closed"
+        if primary and aborted:
             self._note_serve_outcome(primary, ok=False, cls="reset")
 
     def _note_serve_outcome(self, hostport: str, ok: bool, cls: str,
@@ -619,6 +651,29 @@ class BatchingTPUPicker:
             return  # single-chunk response: no inter-token interval exists
         tpot = (t1 - t0) / (tokens - 1)
         self.trainer.observe(features, ttft_s=None, tpot_s=tpot, slot=slot)
+
+    def queue_report(self) -> dict:
+        """Flow-queue zpage (/debugz/queue, gie_tpu/obs): live depth,
+        per-band composition, and the oldest waiter's age. The lock is
+        held only for the list copy; aggregation runs outside it."""
+        now = time.monotonic()
+        with self._cond:
+            items = list(self._pending)
+        bands: dict[str, int] = {}
+        oldest = 0.0
+        for it in items:
+            name = _BAND_NAMES.get(it.band, str(it.band))
+            bands[name] = bands.get(name, 0) + 1
+            oldest = max(oldest, now - it.enqueued_at)
+        return {
+            "depth": len(items),
+            "bands": bands,
+            "oldest_wait_ms": round(oldest * 1e3, 1),
+            "queue_bound": self.queue_bound,
+            "queue_max_age_s": self.queue_max_age_s,
+            "pipeline_depth_limit": self._depth_limit,
+            "waves_in_flight": self._inflight,
+        }
 
     def close(self) -> None:
         with self._cond:
@@ -831,6 +886,12 @@ class BatchingTPUPicker:
                 for it in batch:
                     allowed = drain_filter(it.candidates)
                     if allowed is not it.candidates:
+                        # Flight-recorder provenance: which slots drain
+                        # excluded for this request (gie_tpu/obs).
+                        it.excl_drain = tuple(
+                            int(getattr(ep, "slot", -1))
+                            for ep in it.candidates
+                            if getattr(ep, "draining", False))
                         it.candidates = allowed
                         it.cand_slots = np.fromiter(
                             (getattr(ep, "slot", -1) for ep in allowed),
@@ -867,6 +928,15 @@ class BatchingTPUPicker:
                         own_metrics.HOLD_BUDGET_BYPASS.inc()
                         runnable.append(it)
                     else:
+                        tr_h = it.req.trace
+                        if tr_h is not None and (
+                                not tr_h.events
+                                or tr_h.events[-1][0] != "held"):
+                            # One event per hold SPELL, not per retry
+                            # cycle (10 ms cadence): a request held for
+                            # seconds must not grow its event list by
+                            # hundreds of duplicate rows.
+                            tr_h.event("held")
                         held.append(it)
                 else:
                     runnable.append(it)
@@ -891,10 +961,15 @@ class BatchingTPUPicker:
                 # empty it (availability beats quarantine; the breaker's
                 # own half-open probes need traffic to heal).
                 for it in batch:
-                    allowed = [ep for ep in it.candidates
-                               if not rs.board.quarantined(
-                                   getattr(ep, "slot", -1))]
-                    if allowed and len(allowed) < len(it.candidates):
+                    allowed, dropped = [], []
+                    for ep in it.candidates:
+                        if rs.board.quarantined(getattr(ep, "slot", -1)):
+                            dropped.append(ep)
+                        else:
+                            allowed.append(ep)
+                    if allowed and dropped:
+                        it.excl_breaker = tuple(
+                            int(getattr(ep, "slot", -1)) for ep in dropped)
                         it.candidates = allowed
                         it.cand_slots = np.fromiter(
                             (getattr(ep, "slot", -1) for ep in allowed),
@@ -1068,16 +1143,71 @@ class BatchingTPUPicker:
         any_draining = any(
             getattr(ep, "draining", False) for ep in wave.endpoints)
         now_mono = time.monotonic()
+        # Flight recorder (gie_tpu/obs, docs/OBSERVABILITY.md): one
+        # decision record per request, built HERE on the completer from
+        # the wave results that are already host-side — result.scores
+        # materialized with the pick, the wave's metrics tensor, the
+        # optional post-cycle load snapshot. No device pull happens under
+        # any lock (GL002), and nothing is built while obs is off.
+        recorder = obs.RECORDER
+        rec_scores = rec_metrics = None
+        rec_draining: list = []
+        if recorder is not None:
+            rec_scores = np.asarray(result.scores)
+            rec_metrics = (metrics_np if load_snapshot is not None
+                           else np.asarray(wave.eps_metrics))
+            rec_draining = sorted(
+                int(s) for s, ep in by_slot.items()
+                if getattr(ep, "draining", False))
+
+        def _rec_base(item: _Pending) -> dict:
+            req = item.req
+            tr = req.trace
+            return {
+                "ts": time.time(),
+                "trace_id": tr.trace_id if tr is not None else "",
+                "model": req.model,
+                "band": _BAND_NAMES.get(item.band, str(item.band)),
+                "rung": "full",
+                "candidates": [int(s) for s in item.cand_slots],
+                "excluded_breaker": list(item.excl_breaker),
+                "excluded_drain": list(item.excl_drain),
+                "draining": rec_draining,
+                "deadline_remaining_ms": (
+                    round((req.deadline_at - now_mono) * 1e3, 1)
+                    if req.deadline_at else None),
+            }
+
         for i, item in enumerate(batch):
-            own_metrics.PICK_LATENCY.observe(time.monotonic() - item.enqueued_at)
+            lat = time.monotonic() - item.enqueued_at
+            tr = item.req.trace
+            if tr is not None:
+                tr.event("picked")
+                if tr.sampled:
+                    # OpenMetrics exemplar: the pick-latency bucket ->
+                    # trace join (docs/OBSERVABILITY.md).
+                    own_metrics.PICK_LATENCY.observe(
+                        lat, {"trace_id": tr.trace_id})
+                else:
+                    own_metrics.PICK_LATENCY.observe(lat)
+            else:
+                own_metrics.PICK_LATENCY.observe(lat)
             if status[i] == C.Status.SHED:
                 own_metrics.PICKS.labels(outcome="shed").inc()
                 item.error = ShedError()
+                if recorder is not None:
+                    rec = _rec_base(item)
+                    rec["outcome"] = "shed"
+                    recorder.append(rec)
             elif status[i] != C.Status.OK:
                 own_metrics.PICKS.labels(outcome="unavailable").inc()
                 item.error = ExtProcError(
                     grpc.StatusCode.UNAVAILABLE, "no endpoints available"
                 )
+                if recorder is not None:
+                    rec = _rec_base(item)
+                    rec["outcome"] = "unavailable"
+                    recorder.append(rec)
             else:
                 picked_slots = [
                     int(s) for s in indices[i] if s >= 0 and s in by_slot
@@ -1101,6 +1231,10 @@ class BatchingTPUPicker:
                     item.error = ExtProcError(
                         grpc.StatusCode.UNAVAILABLE, "no endpoints available"
                     )
+                    if recorder is not None:
+                        rec = _rec_base(item)
+                        rec["outcome"] = "unavailable"
+                        recorder.append(rec)
                 else:
                     res = PickResult(endpoint=picked[0], fallbacks=picked[1:])
                     res.assumed_cost = request_cost_host(
@@ -1164,6 +1298,45 @@ class BatchingTPUPicker:
                             time.monotonic(),
                             picked[0],  # primary hostport the features describe
                         )
+                    if recorder is not None:
+                        rec = _rec_base(item)
+                        rec["outcome"] = "picked"
+                        rec["chosen"] = picked[0]
+                        rec["chosen_slot"] = picked_slots[0]
+                        rec["fallbacks"] = picked[1:]
+                        # Ranked blend scores straight from the cycle's
+                        # materialized result — the chosen endpoint's
+                        # entry may not be rank 0 when the tail filter
+                        # dropped a quarantined/draining primary.
+                        rec["ranked"] = [
+                            {"slot": int(s), "score": round(float(v), 5)}
+                            for s, v in zip(indices[i], rec_scores[i])
+                            if s >= 0]
+                        # Host-side scorer breakdown for the CHOSEN slot,
+                        # mirroring scorers.py's normalization formulas
+                        # over the wave's own metrics rows (no new D2H).
+                        cfg = self.scheduler.cfg
+                        row = rec_metrics[picked_slots[0]]
+                        q = float(row[C.Metric.QUEUE_DEPTH])
+                        kvu = float(row[C.Metric.KV_CACHE_UTIL])
+                        breakdown = {
+                            "queue": round(
+                                min(max(1.0 - q / cfg.queue_norm, 0.0),
+                                    1.0), 5),
+                            "kv_cache": round(
+                                min(max(1.0 - kvu, 0.0), 1.0), 5),
+                        }
+                        if load_snapshot is not None:
+                            al = float(load_snapshot[picked_slots[0]])
+                            breakdown["assumed_load"] = round(
+                                min(max(1.0 - al / cfg.load_norm, 0.0),
+                                    1.0), 5)
+                        rec["scorers"] = breakdown
+                        rec["queue_depth"] = q
+                        rec["kv_util"] = kvu
+                        if prefill_np is not None:
+                            rec["prefill_slot"] = int(prefill_np[i])
+                        res.record = recorder.append(rec)
                     item.result = res
         # Admission runs BEFORE waiters wake: a shed decision must replace
         # the result, never race the caller reading it. The "ok" outcome is
@@ -1210,12 +1383,15 @@ class BatchingTPUPicker:
         # still be zero-error), with the same availability floor.
         ready = {s: ep for s, ep in by_slot.items()
                  if not getattr(ep, "draining", False)}
+        drain_set = {s for s in by_slot if s not in ready} if ready else set()
         if ready:
             by_slot = ready
         rs = self.resilience
+        breaker_set: set = set()
         if rs is not None and rs.board.has_open and len(by_slot) > 1:
             allowed = {s for s in by_slot if not rs.board.quarantined(s)}
             if allowed:  # quarantine never empties the pool
+                breaker_set = set(by_slot) - allowed
                 by_slot = {s: ep for s, ep in by_slot.items()
                            if s in allowed}
         live = sorted(by_slot)
@@ -1286,11 +1462,59 @@ class BatchingTPUPicker:
                 )
                 res.assumed_cost = 0.0
                 res.charged_slot = -1  # nothing charged: skip the release
+                recorder = obs.RECORDER
+                if recorder is not None:
+                    # Degraded picks record too (same schema as the full
+                    # path): rung + exclusions explain exactly why this
+                    # request skipped the device cycle, raw row signals
+                    # stand in for the scorer breakdown the rung used.
+                    tr = item.req.trace
+                    j = col_of[picked[0]]
+                    d = item.req.deadline_at
+                    res.record = recorder.append({
+                        "ts": time.time(),
+                        "trace_id": tr.trace_id if tr is not None else "",
+                        "model": item.req.model,
+                        "band": _BAND_NAMES.get(item.band, str(item.band)),
+                        "rung": label,
+                        "candidates": [int(s) for s in item.cand_slots],
+                        "excluded_breaker": sorted(
+                            int(s) for s in item.cand_slots
+                            if s in breaker_set),
+                        "excluded_drain": sorted(
+                            int(s) for s in item.cand_slots
+                            if s in drain_set),
+                        "draining": sorted(int(s) for s in drain_set),
+                        "deadline_remaining_ms": (
+                            round((d - time.monotonic()) * 1e3, 1)
+                            if d else None),
+                        "outcome": "picked",
+                        "chosen": res.endpoint,
+                        "chosen_slot": int(picked[0]),
+                        "fallbacks": list(res.fallbacks),
+                        "scorers": {"degraded_" + label: round(
+                            float(queue[j] + 8.0 * kv[j]), 5)},
+                        "queue_depth": float(queue[j]),
+                        "kv_util": float(kv[j]),
+                    })
                 item.result = res
                 own_metrics.DEGRADED_PICKS.labels(rung=label).inc()
                 own_metrics.PICKS.labels(outcome="ok").inc()
-                own_metrics.PICK_LATENCY.observe(
-                    time.monotonic() - item.enqueued_at)
+                # Same trace lifecycle as the full path: the "picked"
+                # stage and the bucket->trace exemplar must not vanish
+                # exactly while the pool is degraded — that is when the
+                # traces are read.
+                lat = time.monotonic() - item.enqueued_at
+                tr = item.req.trace
+                if tr is not None:
+                    tr.event("picked")
+                    if tr.sampled:
+                        own_metrics.PICK_LATENCY.observe(
+                            lat, {"trace_id": tr.trace_id})
+                    else:
+                        own_metrics.PICK_LATENCY.observe(lat)
+                else:
+                    own_metrics.PICK_LATENCY.observe(lat)
                 item.event.set()
 
     def _slo_admission(self, batch: list[_Pending]) -> None:
@@ -1335,6 +1559,10 @@ class BatchingTPUPicker:
                 res = item.result
                 item.result = None
                 item.error = ShedError()
+                if res.record is not None:
+                    # The decision record outlives the reversal: the
+                    # request was picked, then SLO-shed post-pick.
+                    res.record["outcome"] = "shed_slo"
                 # The cycle charged the pick; the request will not run.
                 if res.charged:
                     self.scheduler.complete(

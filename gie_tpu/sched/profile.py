@@ -883,6 +883,29 @@ class Scheduler:
                 return  # beyond the live bucket: nothing was ever recorded
             self.state = self._clear_prefix(self.state, jnp.int32(slot))
 
+    def debug_report(self) -> dict:
+        """Scheduler zpage (/debugz/scheduler, gie_tpu/obs): the live
+        blend weights, picker/profile identity, and compile-cache state.
+        Lock-free on purpose — every read is a GIL-atomic reference
+        (weights/state are immutable pytrees swapped whole) and the tiny
+        weight scalars sync outside any lock, so this can never stall a
+        pick."""
+        weights = self.weights
+        state = self.state
+        return {
+            "picker": self.cfg.picker,
+            "pd_disaggregation": self.cfg.pd_disaggregation,
+            "m_bucket": int(state.assumed_load.shape[0]),
+            "tick": int(np.asarray(state.tick)),
+            "weights": {
+                f: round(float(getattr(weights, f)), 5)
+                for f in weights.__dataclass_fields__
+            },
+            "latency_weight_ceiling": self.base_latency_weight,
+            "warm_buckets": sorted(self._warm_buckets),
+            "warm_inline_compiles": self.warm_inline_compiles,
+        }
+
     def snapshot_assumed_load(self) -> np.ndarray:
         """Host copy of the assumed-load vector. Same discipline as
         export_state: the lock covers only a donation-safe DEVICE copy

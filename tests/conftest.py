@@ -56,6 +56,30 @@ def pytest_configure(config):
     )
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Failed-test flight-recorder capture (gie-obs, ISSUE 9): when a
+    test fails while a FlightRecorder is installed — the chaos-ci
+    scenario suite installs one — dump the ring to /tmp/gie-obs so the
+    failed scenario explains itself (which endpoints were candidates,
+    who was excluded and why, what the data plane did). Best-effort:
+    artifact capture must never mask or alter the test outcome."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed:
+        try:
+            from gie_tpu import obs
+
+            if obs.RECORDER is not None:
+                path = obs.dump_artifact("/tmp/gie-obs", name=item.name)
+                if path:
+                    item.add_report_section(
+                        "call", "flight-recorder",
+                        f"decision records dumped to {path}")
+        except Exception:
+            pass
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

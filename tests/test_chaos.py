@@ -46,6 +46,19 @@ def _no_leaked_injector():
     faults.uninstall()
 
 
+@pytest.fixture(autouse=True)
+def _flight_recorder():
+    """Chaos runs carry a flight recorder (gie-obs): on failure the
+    conftest hook dumps the decision records to /tmp/gie-obs so the
+    failed scenario explains itself."""
+    from gie_tpu import obs
+    from gie_tpu.obs.recorder import FlightRecorder
+
+    obs.install(recorder=FlightRecorder(2048))
+    yield
+    obs.uninstall()
+
+
 def _fast_ladder(**kw):
     cfg = dict(dispatch_error_streak=2, blackout_stale_s=0.35,
                latency_breach_s=5.0, latency_breach_streak=50,

@@ -1,0 +1,563 @@
+"""gie-obs (ISSUE 9, docs/OBSERVABILITY.md): trace propagation +
+sampling determinism, the flight recorder's lock-free ring, trace
+closure on every exit path, the /debugz plane, exemplar exposition, and
+the metrics-catalog lint."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gie_tpu import obs
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool, Pod
+from gie_tpu.extproc import metadata as mdkeys
+from gie_tpu.extproc.server import (
+    ExtProcError,
+    RoundRobinPicker,
+    ShedError,
+    StreamingServer,
+)
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.obs.debugz import DebugzServer
+from gie_tpu.obs.recorder import FlightRecorder
+from gie_tpu.obs.trace import Sampler, Tracer, trace_id_from_headers
+from gie_tpu.resilience.deadline import DeadlineExceeded
+from gie_tpu.runtime import metrics as own_metrics
+from gie_tpu.sched import ProfileConfig, Scheduler
+from gie_tpu.sched.batching import BatchingTPUPicker
+
+from tests.test_dataplane import _resp_headers_msg, _server
+from tests.test_extproc import FakeStream, headers_msg, make_ds
+
+TRACEPARENT = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+TID = "ab" * 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.uninstall()
+    yield
+    obs.uninstall()
+
+
+# --------------------------------------------------------------------------
+# Sampler determinism
+# --------------------------------------------------------------------------
+
+
+def test_sampler_bit_identical_per_trace_id():
+    """Same (seed, rate) -> the SAME keep/drop verdict for every trace
+    ID, across instances — the fleet-wide consistency claim."""
+    ids = [f"{i:032x}" for i in range(2000)]
+    a = Sampler(0.25, seed=7)
+    b = Sampler(0.25, seed=7)
+    va = [a.keep(t) for t in ids]
+    vb = [b.keep(t) for t in ids]
+    assert va == vb
+    # Replaying one ID never changes its verdict (stateless).
+    assert all(a.keep(ids[3]) == va[3] for _ in range(10))
+    # A different seed samples a different subset.
+    assert [Sampler(0.25, seed=8).keep(t) for t in ids] != va
+    # Rate edges and the achieved fraction.
+    assert not any(Sampler(0.0, seed=7).keep(t) for t in ids)
+    assert all(Sampler(1.0, seed=7).keep(t) for t in ids)
+    frac = sum(va) / len(va)
+    assert 0.15 < frac < 0.35
+
+
+def test_trace_id_extraction_precedence():
+    tid, rid = trace_id_from_headers({
+        "traceparent": [TRACEPARENT],
+        "x-request-id": ["9f1d4c3a-77aa-43f2-a1b0-2f8e6f1d9c55"],
+    })
+    assert tid == TID
+    assert rid == "9f1d4c3a-77aa-43f2-a1b0-2f8e6f1d9c55"
+    # x-request-id fallback: UUID hex with dashes stripped.
+    tid, _ = trace_id_from_headers(
+        {"x-request-id": ["9f1d4c3a-77aa-43f2-a1b0-2f8e6f1d9c55"]})
+    assert tid == "9f1d4c3a77aa43f2a1b02f8e6f1d9c55"
+    # Non-hex request IDs hash to a stable 32-hex ID.
+    t1, _ = trace_id_from_headers({"x-request-id": ["req-XYZ"]})
+    t2, _ = trace_id_from_headers({"x-request-id": ["req-XYZ"]})
+    assert t1 == t2 and len(t1) == 32
+    # Malformed traceparent falls through to x-request-id.
+    tid, _ = trace_id_from_headers({
+        "traceparent": ["garbage"], "x-request-id": ["abcd" * 8]})
+    assert tid == "abcd" * 8
+    # Nothing usable -> empty (the tracer generates).
+    assert trace_id_from_headers({}) == ("", "")
+    tracer = Tracer(1.0)
+    ctx = tracer.begin({})
+    assert len(ctx.trace_id) == 32 and ctx.trace_id != "0" * 32
+
+
+# --------------------------------------------------------------------------
+# Flight-recorder ring
+# --------------------------------------------------------------------------
+
+
+def test_ring_wraparound_under_concurrent_writers():
+    """8 writers x 300 records into a 64-slot ring: never more than 64
+    live records, every survivor intact and from the newest window, no
+    torn/half-written entries."""
+    rec = FlightRecorder(size=64)
+    n_threads, per = 8, 300
+    total = n_threads * per
+
+    def writer(k: int):
+        for i in range(per):
+            rec.append({"writer": k, "i": i, "payload": "x" * 32})
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(n_threads)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    snap = rec.snapshot()
+    assert len(snap) == 64
+    seqs = [r["seq"] for r in snap]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 64
+    assert max(seqs) == total - 1
+    # Only the newest window survives wraparound.
+    assert min(seqs) >= total - 64 - n_threads
+    for r in snap:
+        assert r["payload"] == "x" * 32 and 0 <= r["writer"] < n_threads
+    # Export is valid JSON of the same records.
+    assert len(json.loads(rec.export_json())) == 64
+    # find() by seq.
+    assert rec.find(seq=max(seqs))["seq"] == max(seqs)
+
+
+def test_ring_trims_newest_first():
+    rec = FlightRecorder(size=8)
+    for i in range(20):
+        rec.append({"i": i})
+    top = rec.snapshot(n=3)
+    assert [r["seq"] for r in top] == [19, 18, 17]
+
+
+# --------------------------------------------------------------------------
+# Trace closure on every exit path
+# --------------------------------------------------------------------------
+
+
+class _RaisingPicker:
+    def __init__(self, exc):
+        self.exc = exc
+
+    def pick(self, req, candidates):
+        raise self.exc
+
+
+class _AbortStream(FakeStream):
+    def recv(self):
+        from gie_tpu.extproc.server import StreamAborted
+
+        if self.messages:
+            return super().recv()
+        raise StreamAborted()
+
+
+def _outcomes(tracer: Tracer) -> dict:
+    return {t["trace_id"]: t["outcome"] for t in tracer.traces("recent", 99)}
+
+
+def test_trace_closes_on_every_exit_path():
+    tracer = Tracer(1.0, slow_s=10.0)
+    obs.install(tracer=tracer)
+    ds = make_ds()
+    hdrs = {"traceparent": TRACEPARENT, "content-type": "application/json"}
+
+    # ok: pick + response headers.
+    srv = StreamingServer(ds, RoundRobinPicker())
+    srv.process(FakeStream([headers_msg(hdrs),
+                            _resp_headers_msg(served="10.0.0.1:8000")]))
+    # shed -> 429.
+    StreamingServer(ds, _RaisingPicker(ShedError())).process(
+        FakeStream([headers_msg(hdrs)]))
+    # deadline -> 503.
+    StreamingServer(ds, _RaisingPicker(DeadlineExceeded("queue"))).process(
+        FakeStream([headers_msg(hdrs)]))
+    # unavailable -> stream-fatal UNAVAILABLE.
+    import grpc
+
+    with pytest.raises(ExtProcError):
+        StreamingServer(ds, _RaisingPicker(ExtProcError(
+            grpc.StatusCode.UNAVAILABLE, "no endpoints"))).process(
+            FakeStream([headers_msg(hdrs)]))
+    # abort after pick, before response headers.
+    srv2 = StreamingServer(ds, RoundRobinPicker())
+    srv2.process(_AbortStream([headers_msg(hdrs)]))
+
+    outs = [t["outcome"] for t in tracer.traces("recent", 99)]
+    for expected in ("ok", "shed", "deadline", "unavailable", "aborted"):
+        assert expected in outs, f"{expected} missing from {outs}"
+    assert tracer.exported_total == 5
+    # Error-class traces also land in the errors feed; ok does not.
+    err_outs = {t["outcome"] for t in tracer.traces("errors", 99)}
+    assert err_outs == {"shed", "deadline", "unavailable", "aborted"}
+    # Every trace carries the propagated W3C trace ID and staged events.
+    for t in tracer.traces("recent", 99):
+        assert t["trace_id"] == TID
+        assert t["events"][0]["stage"] == "admission"
+
+
+def test_errors_export_even_when_unsampled():
+    """The always-sample classes: with head sampling effectively off for
+    this trace ID, an ok request exports nothing but a shed exports."""
+    tracer = Tracer(1e-9, seed=0, slow_s=10.0)  # keeps ~nothing
+    assert not tracer.sampler.keep(TID)
+    obs.install(tracer=tracer)
+    ds = make_ds()
+    hdrs = {"traceparent": TRACEPARENT}
+    StreamingServer(ds, RoundRobinPicker()).process(
+        FakeStream([headers_msg(hdrs),
+                    _resp_headers_msg(served="10.0.0.1:8000")]))
+    assert tracer.exported_total == 0
+    StreamingServer(ds, _RaisingPicker(ShedError())).process(
+        FakeStream([headers_msg(hdrs)]))
+    assert tracer.exported_total == 1
+    assert tracer.traces("errors", 9)[0]["outcome"] == "shed"
+
+
+def test_slow_trace_exports_as_tail_outlier():
+    tracer = Tracer(1e-9, slow_s=0.0)  # everything is an outlier
+    obs.install(tracer=tracer)
+    StreamingServer(make_ds(), RoundRobinPicker()).process(
+        FakeStream([headers_msg({"traceparent": TRACEPARENT})]))
+    assert [t["outcome"] for t in tracer.traces("slow", 9)] == ["ok"]
+
+
+def test_get_finds_slow_trace_after_recent_eviction():
+    """A tail-outlier trace stays findable by ID even after newer
+    exports evict it from the recent feed (it lives on in _slow)."""
+    from gie_tpu.obs.trace import TraceCtx
+
+    tracer = Tracer(1.0, slow_s=1.0, keep=2)
+    now = time.monotonic()
+    slow_ctx = TraceCtx("aa" * 16, "", True, now - 5.0)  # 5 s latency
+    tracer.finish(slow_ctx, "ok")
+    for i in range(2):  # evict it from _recent (maxlen 2)
+        tracer.finish(TraceCtx(f"{i:032x}", "", True, now), "ok")
+    assert all(t["trace_id"] != "aa" * 16
+               for t in tracer.traces("recent", 9))
+    found = tracer.get("aa" * 16)
+    assert found is not None and found["latency_ms"] >= 5000
+
+
+# --------------------------------------------------------------------------
+# End-to-end: records through the real batching picker
+# --------------------------------------------------------------------------
+
+POOL = EndpointPool(selector={"app": "x"}, target_ports=[8000],
+                    namespace="default")
+
+
+def _stack(n_pods=4):
+    sched = Scheduler(ProfileConfig(load_decay=1.0))
+    ms = MetricsStore()
+    ds = Datastore(on_slot_reclaimed=lambda s: (sched.evict_endpoint(s),
+                                                ms.remove(s)))
+    ds.pool_set(POOL)
+    for i in range(n_pods):
+        ds.pod_update_or_add(Pod(name=f"p{i}", labels={"app": "x"},
+                                 ip=f"10.7.0.{i + 1}"))
+    picker = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.002)
+    return sched, ds, ms, picker
+
+
+class _EchoStream(FakeStream):
+    """Request headers, then response headers echoing the picked primary
+    as served with a 200 (tests/test_scenarios.py EchoStream shape)."""
+
+    def recv(self):
+        if not self.messages and len(self.sent) == 1:
+            mut = self.sent[0].request_headers.response.header_mutation
+            dest = next(
+                o.header.raw_value.decode() for o in mut.set_headers
+                if o.header.key == mdkeys.DESTINATION_ENDPOINT_KEY)
+            self.messages.append(
+                _resp_headers_msg(served=dest.split(",")[0]))
+        return super().recv()
+
+
+def test_full_pick_record_explains_the_decision():
+    tracer = Tracer(1.0, slow_s=10.0)
+    recorder = FlightRecorder(64)
+    obs.install(tracer=tracer, recorder=recorder)
+    sched, ds, ms, picker = _stack()
+    srv = _server(ds, picker)
+    try:
+        stream = _EchoStream([headers_msg({"traceparent": TRACEPARENT})])
+        srv.process(stream)
+        recs = recorder.snapshot()
+        assert len(recs) == 1
+        rec = recs[0]
+        # The acceptance shape: chosen endpoint, scorer breakdown, rung,
+        # serve outcome — all in one record, joined to the trace.
+        assert rec["trace_id"] == TID
+        assert rec["rung"] == "full"
+        assert rec["chosen"].startswith("10.7.0.")
+        assert rec["chosen_slot"] in rec["candidates"]
+        assert len(rec["candidates"]) == 4
+        assert set(rec["scorers"]) >= {"queue", "kv_cache"}
+        assert all(0.0 <= v <= 1.0 for v in rec["scorers"].values())
+        assert rec["ranked"] and rec["ranked"][0]["slot"] == rec["chosen_slot"]
+        assert rec["outcome"] == "2xx"
+        assert rec["served"] == rec["chosen"]
+        assert rec["fallback_rank"] == 0
+        assert rec["excluded_breaker"] == [] and rec["excluded_drain"] == []
+        # The exported trace carries the pick summary + queue/pick events.
+        tr = tracer.get(TID)
+        assert tr is not None and tr["pick"]["chosen"] == rec["chosen"]
+        stages = [e["stage"] for e in tr["events"]]
+        assert stages[:1] == ["admission"]
+        assert "queued" in stages and "picked" in stages
+        assert "response_headers" in stages
+    finally:
+        picker.close()
+
+
+def test_drain_exclusion_recorded():
+    """A pick whose candidate list still contains a draining endpoint
+    records the wave-level exclusion (the rolling-upgrade audit)."""
+    from gie_tpu.extproc.server import PickRequest
+
+    recorder = FlightRecorder(64)
+    obs.install(recorder=recorder)
+    sched, ds, ms, picker = _stack()
+    try:
+        assert ds.pod_mark_draining("default", "p0")
+        drained_slot = next(
+            ep.slot for ep in ds.endpoints() if ep.pod_name == "p0")
+        # Candidates deliberately include the draining endpoint: the
+        # WAVE filter (not admission candidacy) must exclude it.
+        res = picker.pick(PickRequest(headers={}, body=b"x"),
+                          ds.endpoints())
+        rec = recorder.snapshot()[-1]
+        assert drained_slot in rec["excluded_drain"]
+        assert drained_slot in rec["draining"]
+        assert rec["chosen_slot"] != drained_slot
+        assert res.endpoint != "10.7.0.1:8000"  # p0 is draining
+    finally:
+        picker.close()
+
+
+def test_degraded_pick_records_rung():
+    from gie_tpu.extproc.server import PickRequest
+    from gie_tpu.obs.trace import TraceCtx
+    from gie_tpu.resilience.ladder import (
+        DegradationLadder, LadderConfig, ResilienceState, Rung)
+
+    recorder = FlightRecorder(64)
+    obs.install(recorder=recorder)
+    rs = ResilienceState(ladder=DegradationLadder(
+        LadderConfig(dispatch_error_streak=1, probe_interval_s=3600.0)))
+    sched, ds, ms, _ = _stack()
+    picker = BatchingTPUPicker(sched, ds, ms, max_wait_s=0.002,
+                               resilience=rs)
+    try:
+        rs.ladder.note_dispatch_error()          # -> CACHED
+        assert rs.ladder.rung() == Rung.CACHED
+        rs.ladder.should_probe()                 # consume the first probe
+        tr = TraceCtx(TID, "", True, time.monotonic())
+        res = picker.pick(PickRequest(headers={}, body=b"x", trace=tr),
+                          ds.pick_candidates())
+        assert res.endpoint
+        recs = [r for r in recorder.snapshot() if r["rung"] == "cached"]
+        assert recs, "degraded pick published no record"
+        rec = recs[-1]
+        assert rec["chosen"] == res.endpoint
+        assert rec["trace_id"] == TID
+        assert "degraded_cached" in rec["scorers"]
+        assert rec["outcome"] == "picked"
+        # Degraded picks keep the full trace lifecycle: the "picked"
+        # stage still lands even when the device path was skipped.
+        assert "picked" in [name for name, _ in tr.events]
+    finally:
+        picker.close()
+
+
+# --------------------------------------------------------------------------
+# /debugz plane + exemplars
+# --------------------------------------------------------------------------
+
+
+def _get(port, path, accept=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    if accept:
+        req.add_header("Accept", accept)
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+def test_debugz_server_zpages_and_metrics():
+    srv = DebugzServer(0, own_metrics.REGISTRY, {
+        "ping": lambda q: {"ok": True, "n": q.get("n")},
+        "np": lambda q: {"v": np.float32(1.5)},  # numpy must serialize
+    }, bind="127.0.0.1")
+    try:
+        status, ctype, body = _get(srv.port, "/debugz")
+        assert status == 200 and "json" in ctype
+        catalog = json.loads(body)
+        assert "/debugz/ping" in catalog["pages"]
+        status, _, body = _get(srv.port, "/debugz/ping?n=3")
+        assert json.loads(body) == {"ok": True, "n": "3"}
+        assert json.loads(_get(srv.port, "/debugz/np")[2])["v"] == 1.5
+        # Prometheus text by default...
+        status, ctype, body = _get(srv.port, "/metrics")
+        assert status == 200 and b"gie_picks_total" in body
+        # ...OpenMetrics under negotiation (the exemplar transport).
+        own_metrics.PICK_LATENCY.observe(
+            0.012, {"trace_id": "feed" * 8})
+        status, ctype, body = _get(
+            srv.port, "/metrics",
+            accept="application/openmetrics-text; version=1.0.0")
+        assert "openmetrics" in ctype
+        assert body.rstrip().endswith(b"# EOF")
+        assert b'# {trace_id="' + b"feed" * 8 + b'"}' in body
+        # Unknown zpages 404 without killing the server.
+        assert _get(srv.port, "/debugz/ping")[0] == 200
+        with pytest.raises(urllib.error.HTTPError):
+            _get(srv.port, "/debugz/nope")
+        # prometheus_client handler parity: exposition on any
+        # non-/debugz path, name[] filtering, gzip negotiation.
+        assert b"gie_picks_total" in _get(srv.port, "/")[2]
+        filtered = _get(srv.port, "/metrics?name[]=gie_active_streams")[2]
+        assert b"gie_active_streams" in filtered
+        assert b"gie_picks_total" not in filtered
+        import gzip as _gzip
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/metrics")
+        req.add_header("Accept-Encoding", "gzip")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.headers.get("Content-Encoding") == "gzip"
+            assert b"gie_picks_total" in _gzip.decompress(resp.read())
+    finally:
+        srv.close()
+
+
+def test_admission_exemplar_links_bucket_to_trace():
+    tracer = Tracer(1.0, slow_s=10.0)
+    obs.install(tracer=tracer)
+    StreamingServer(make_ds(), RoundRobinPicker()).process(
+        FakeStream([headers_msg({"traceparent": TRACEPARENT})]))
+    from prometheus_client.openmetrics.exposition import generate_latest
+
+    text = generate_latest(own_metrics.REGISTRY).decode()
+    line = next(
+        (ln for ln in text.splitlines()
+         if ln.startswith("gie_extproc_admission_seconds_bucket")
+         and f'trace_id="{TID}"' in ln), None)
+    assert line is not None, "admission bucket carries no trace exemplar"
+
+
+# --------------------------------------------------------------------------
+# Satellites: catalog lint, build info, artifact dump, accessors, zpages
+# --------------------------------------------------------------------------
+
+
+def test_obs_check_clean_on_real_catalog():
+    from gie_tpu.obs.metricscheck import check_registry
+
+    own_metrics.register_pool_aggregates(lambda: {})
+    assert check_registry(own_metrics.REGISTRY) == []
+
+
+def test_obs_check_catches_bad_metrics():
+    import prometheus_client as prom
+
+    from gie_tpu.obs.metricscheck import check_registry
+
+    reg = prom.CollectorRegistry()
+    prom.Counter("wrong_prefix_total", "has help", registry=reg)
+    prom.Gauge("gie_no_help", "", registry=reg)
+    prom.Gauge("gie_cardinality", "per-endpoint series", ["endpoint"],
+               registry=reg)
+    prom.Counter("gie_wide_total", "too many labels",
+                 ["a", "b", "c", "d", "e"], registry=reg)
+    findings = "\n".join(check_registry(reg))
+    assert "OC001 wrong_prefix" in findings
+    assert "OC002 gie_no_help" in findings
+    assert "OC003 gie_wide" in findings
+    assert "OC004 gie_cardinality" in findings
+
+
+def test_build_info_gauge():
+    own_metrics.set_build_info(fast_lane=True, resilience=True, obs=False)
+    from gie_tpu.version import __version__
+
+    assert own_metrics.REGISTRY.get_sample_value("gie_build_info", {
+        "version": __version__, "fast_lane": "true",
+        "resilience": "true", "obs": "false"}) == 1.0
+
+
+def test_logging_trace_enabled_accessor():
+    from gie_tpu.runtime import logging as own_logging
+
+    own_logging.set_verbosity(2)
+    assert not own_logging.trace_enabled()
+    own_logging.set_verbosity(5)
+    assert own_logging.trace_enabled()
+    own_logging.set_verbosity(2)
+    assert not own_logging.trace_enabled()
+
+
+def test_dump_artifact_roundtrip(tmp_path):
+    recorder = FlightRecorder(16)
+    tracer = Tracer(1.0)
+    obs.install(tracer=tracer, recorder=recorder)
+    recorder.append({"trace_id": "t1", "chosen": "10.0.0.1:8000"})
+    path = obs.dump_artifact(str(tmp_path), name="rolling upgrade/x")
+    assert path is not None and "/" not in path[len(str(tmp_path)) + 1:]
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["records"][0]["chosen"] == "10.0.0.1:8000"
+    assert "traces" in payload
+    obs.uninstall()
+    assert obs.dump_artifact(str(tmp_path), name="nothing") is None
+
+
+def test_zpage_report_shapes():
+    """The provider surfaces the runner wires into /debugz: breaker
+    board, scheduler, datastore, flow queue."""
+    from gie_tpu.resilience.breaker import BreakerBoard
+
+    board = BreakerBoard()
+    for _ in range(6):
+        board.record(3, ok=False)
+    rep = board.report()
+    assert rep["has_open"] and rep["breakers"]["3"]["state"] == "open"
+    assert rep["breakers"]["3"]["opened_by"] == "scrape"
+
+    sched, ds, ms, picker = _stack(n_pods=2)
+    try:
+        srep = sched.debug_report()
+        assert srep["picker"] == "topk" and "queue" in srep["weights"]
+        drep = ds.debug_report()
+        assert drep["pool_synced"] and len(drep["endpoints"]) == 2
+        assert drep["pool_generation"] >= 1
+        qrep = picker.queue_report()
+        assert qrep["depth"] == 0 and "pipeline_depth_limit" in qrep
+    finally:
+        picker.close()
+
+
+def test_pick_result_record_updates_on_abort():
+    """A stream that aborts after its pick closes the record as reset."""
+    recorder = FlightRecorder(16)
+    obs.install(recorder=recorder)
+    sched, ds, ms, picker = _stack()
+    srv = _server(ds, picker)
+    try:
+        srv.process(_AbortStream([headers_msg({})]))
+        rec = recorder.snapshot()[-1]
+        assert rec["outcome"] == "reset"
+    finally:
+        picker.close()

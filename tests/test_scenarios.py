@@ -52,6 +52,19 @@ def _no_leaked_injector():
     faults.uninstall()
 
 
+@pytest.fixture(autouse=True)
+def flight_recorder():
+    """Every scenario runs with a flight recorder armed (gie-obs): the
+    conftest failure hook dumps it to /tmp/gie-obs when a scenario
+    fails, so chaos-ci failures carry their own decision records."""
+    from gie_tpu import obs
+    from gie_tpu.obs.recorder import FlightRecorder
+
+    obs.install(recorder=FlightRecorder(2048))
+    yield obs.RECORDER
+    obs.uninstall()
+
+
 # --------------------------------------------------------------------------
 # Loader
 # --------------------------------------------------------------------------
@@ -265,11 +278,13 @@ def test_reset_storm_releases_every_charge_and_quarantines():
 # --------------------------------------------------------------------------
 
 
-def test_rolling_upgrade_zero_client_visible_5xx():
+def test_rolling_upgrade_zero_client_visible_5xx(flight_recorder):
     """Sequential drain/replace of EVERY endpoint under continuous
     traffic: no pick ever fails (zero client-visible 5xx/429), no pick
-    enqueued after a pod's drain mark lands on it, and at the end no
-    assumed-load slot is orphaned and nothing is still draining."""
+    enqueued after a pod's drain mark lands on it, at the end no
+    assumed-load slot is orphaned and nothing is still draining — and
+    the flight recorder's decision records SHOW the DRAINING exclusions
+    (gie-obs ISSUE 9: a failed upgrade must explain itself)."""
     scn = scenarios.load("rolling-upgrade")
     d = scn.drive
     assert scn.rules == {}             # pure-drive scenario: churn IS the
@@ -347,6 +362,19 @@ def test_rolling_upgrade_zero_client_visible_5xx():
             f"10.9.6.{i + 1}:8000" for i in range(d["pods"])}
         load = sched.snapshot_assumed_load()
         assert float(np.abs(load).sum()) == pytest.approx(0.0, abs=1e-3)
+        # Flight-recorder provenance (gie-obs): waves completed while an
+        # endpoint drained must have recorded the DRAINING set, and no
+        # record may show a pick landing on a slot it listed as
+        # draining — the record is the upgrade's own audit trail.
+        recs = flight_recorder.snapshot()
+        assert recs, "no decision records were published"
+        drained_recs = [r for r in recs if r.get("draining")]
+        assert drained_recs, (
+            "no decision record observed the DRAINING exclusion set")
+        for r in drained_recs:
+            assert r.get("chosen_slot") not in r["draining"], (
+                f"record {r['seq']} picked draining slot "
+                f"{r.get('chosen_slot')}")
     finally:
         stop.set()
         picker.close()
