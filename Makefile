@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
+.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
 
 all: native generate
 
@@ -51,18 +51,34 @@ chaos-ci:
 chaos-smoke: chaos-ci
 	$(PY) -m pytest tests/test_chaos.py -q -m slow
 
+# gie-storm gate (docs/STORM.md): the fast deterministic storm suite —
+# schedule determinism/composition units plus the seeded acceptance
+# storms (storm-flash-upgrade composed run, storm-capacity overload,
+# the outlier-ejection storm) driven through the REAL stack. Arrival
+# schedules are bit-identical per seed; a failure is a degrade-and-
+# recover regression, not flake. The slow multi-phase soak lives in
+# storm-smoke.
+storm-ci:
+	$(PY) -m pytest tests/test_storm.py -q -m 'not slow'
+
+# The storm-soak replay (diurnal + flash crowd + LoRA churn + rolling
+# upgrade + autoscale + standby failover probes over mixed chaos).
+storm-smoke: storm-ci
+	$(PY) -m pytest tests/test_storm.py -q -m slow
+
 # CRD manifests (reference `make generate`).
 generate:
 	$(PY) -m gie_tpu.api.crdgen config/crd/bases
 
 # Full test tier: unit + conformance on the virtual 8-device CPU mesh.
-# Lint, the metrics-catalog check, and the fast chaos gate run first: a
-# hierarchy violation, a malformed metric, or a deterministic-seed
-# resilience regression fails before the full suite. The chaos files
-# are excluded from the main sweep — chaos-ci already ran them (the
-# slow soak lives in chaos-smoke, not here).
-test: lint obs-check chaos-ci
-	$(PY) -m pytest tests/ -q --ignore=tests/test_scenarios.py --ignore=tests/test_chaos.py
+# Lint, the metrics-catalog check, the fast chaos gate, and the storm
+# gate run first: a hierarchy violation, a malformed metric, or a
+# deterministic-seed resilience/degrade-and-recover regression fails
+# before the full suite. The chaos/storm files are excluded from the
+# main sweep — chaos-ci/storm-ci already ran them (the slow soaks live
+# in chaos-smoke/storm-smoke, not here).
+test: lint obs-check chaos-ci storm-ci
+	$(PY) -m pytest tests/ -q --ignore=tests/test_scenarios.py --ignore=tests/test_chaos.py --ignore=tests/test_storm.py
 
 test-unit: lint obs-check
 	$(PY) -m pytest tests/ -q --ignore=tests/test_conformance.py

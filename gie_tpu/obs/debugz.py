@@ -26,6 +26,7 @@ serialization happens here, outside every gie_tpu lock.
 from __future__ import annotations
 
 import gzip
+import ipaddress
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -49,13 +50,46 @@ def _jsonable(obj):
     return str(obj)
 
 
+# --debugz-bind NAMES that keep /debugz loopback-only (the default);
+# numeric values are classified with the same is_loopback predicate the
+# peer gate applies, so 127.0.0.2 (a loopback alias) stays gated and a
+# typo cannot silently disable the hardening.
+_LOOPBACK_BIND_NAMES = frozenset({"", "localhost", "loopback"})
+
+
+def _is_loopback_bind(value: str) -> bool:
+    value = (value or "").strip().lower()
+    if value in _LOOPBACK_BIND_NAMES:
+        return True
+    try:
+        return ipaddress.ip_address(value.split("%")[0]).is_loopback
+    except ValueError:
+        # Unrecognized value: keep the GATE CLOSED — "loopback-only
+        # unless a non-loopback ADDRESS is named" means an unparsable
+        # name must not become an accidental opt-out.
+        return True
+
+
 class DebugzServer:
-    """The combined /metrics + /debugz listener."""
+    """The combined /metrics + /debugz listener.
+
+    The SOCKET stays on ``bind`` (0.0.0.0 by default — Prometheus must
+    scrape /metrics from off-pod), but the /debugz zpages are a
+    different trust story: pick explanations, breaker boards, and
+    datastore dumps are operator introspection, plaintext JSON with no
+    auth. ``debugz_bind`` therefore gates the /debugz PATHS by peer
+    address: with a loopback value (the default) requests from any
+    non-loopback peer get 403 and a pointer at the flag; an explicit
+    non-loopback ``--debugz-bind`` (e.g. the pod IP, or 0.0.0.0) is the
+    operator's opt-out (docs/OBSERVABILITY.md "bind hardening").
+    """
 
     def __init__(self, port: int, registry, providers: Mapping[str, Provider],
-                 bind: str = "0.0.0.0"):
+                 bind: str = "0.0.0.0", debugz_bind: str = "127.0.0.1"):
         self.registry = registry
         self.providers = dict(providers)
+        self.debugz_bind = debugz_bind
+        self._debugz_loopback_only = _is_loopback_bind(debugz_bind)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -85,9 +119,26 @@ class DebugzServer:
 
     # -- request handling --------------------------------------------------
 
+    def _debugz_allowed(self, peer_host: str) -> bool:
+        """May this peer read /debugz pages? Loopback peers always may;
+        anyone else only when the operator opted out of the loopback
+        default with an explicit --debugz-bind."""
+        if not self._debugz_loopback_only:
+            return True
+        try:
+            return ipaddress.ip_address(peer_host.split("%")[0]).is_loopback
+        except ValueError:
+            return False  # unparsable peer: closed by default
+
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
         parsed = urlparse(req.path)
         path = parsed.path.rstrip("/") or "/"
+        if ((path == "/debugz" or path.startswith("/debugz/"))
+                and not self._debugz_allowed(req.client_address[0])):
+            req.send_error(
+                403, "debugz is loopback-only by default; start with an "
+                     "explicit --debugz-bind to expose it")
+            return
         if path == "/debugz":
             self._send_json(req, {
                 "pages": sorted(f"/debugz/{name}" for name in self.providers),
@@ -153,7 +204,8 @@ class DebugzServer:
 
 def start_debugz_server(
     port: int, registry, providers: Mapping[str, Provider] | None = None,
-    bind: str = "0.0.0.0",
+    bind: str = "0.0.0.0", debugz_bind: str = "127.0.0.1",
 ) -> DebugzServer:
     """Start the combined listener (the runner's metrics-port server)."""
-    return DebugzServer(port, registry, providers or {}, bind=bind)
+    return DebugzServer(port, registry, providers or {}, bind=bind,
+                        debugz_bind=debugz_bind)

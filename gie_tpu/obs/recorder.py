@@ -35,6 +35,40 @@ import itertools
 import json
 from typing import Optional
 
+# Record-schema version, stamped as ``v`` on every published record.
+# Offline consumers (the ROADMAP item-3 trainers, replay tooling) key
+# compatibility off it: bump it when a field CHANGES MEANING, never for
+# additive fields — loaders tolerate unknown fields by contract
+# (:func:`load_records`). Version history lives in docs/OBSERVABILITY.md
+# ("record schema").
+SCHEMA_VERSION = 1
+
+
+def load_records(text: str) -> list[dict]:
+    """Tolerant loader for flight-recorder dumps (export_json /
+    obs.dump_artifact artifacts): accepts a bare record list or a
+    ``{"records": [...]}`` envelope, keeps unknown fields verbatim, and
+    treats records from ANY schema version as loadable — pre-version
+    dumps (no ``v``) are stamped ``v: 0``, future-version records are
+    kept as-is rather than dropped (the consumer decides what of a newer
+    record it understands; a trainer that crashed on a new field would
+    rot every archived dump the day the schema grew one)."""
+    raw = json.loads(text)
+    if isinstance(raw, dict):
+        raw = raw.get("records", [])
+    if not isinstance(raw, list):
+        raise ValueError(
+            "flight-recorder dump must be a record list or a "
+            "{'records': [...]} envelope")
+    out: list[dict] = []
+    for rec in raw:
+        if not isinstance(rec, dict):
+            continue  # tolerate-unknown: skip non-record junk entries
+        if not isinstance(rec.get("v"), int):
+            rec = {**rec, "v": 0}
+        out.append(rec)
+    return out
+
 
 class FlightRecorder:
     """Fixed-size lock-free decision-record ring."""
@@ -47,10 +81,12 @@ class FlightRecorder:
         self._tickets = itertools.count()
 
     def append(self, record: dict) -> dict:
-        """Publish one fully-built record (stamps ``seq``); returns it so
-        callers can keep the reference for later outcome updates."""
+        """Publish one fully-built record (stamps ``seq`` + the schema
+        version ``v``); returns it so callers can keep the reference for
+        later outcome updates."""
         i = next(self._tickets)          # atomic ticket
         record["seq"] = i
+        record["v"] = SCHEMA_VERSION
         self._slots[i % self.size] = record  # atomic publish
         return record
 

@@ -23,7 +23,12 @@ Three pieces, one contract:
             exited hysteretically.
   scenarios recorded chaos scenarios: --fault specs grown into
             replayable JSON files (seed + rules + drive), shipped under
-            resilience/scenarios/ and replayed by the chaos-ci suite.
+            resilience/scenarios/ and replayed by the chaos-ci suite
+            (storm scenarios carry a drive.storm section the gie-storm
+            engine interprets directly, gie_tpu/storm).
+  outlier   p99 serve-latency outlier ejection: windowed per-endpoint
+            latency quantile vs pool median tripping the breaker's
+            serve plane (--outlier-ejection).
 """
 
 from gie_tpu.resilience.breaker import (        # noqa: F401
@@ -50,6 +55,10 @@ from gie_tpu.resilience.ladder import (         # noqa: F401
     LadderConfig,
     ResilienceState,
     Rung,
+)
+from gie_tpu.resilience.outlier import (        # noqa: F401
+    OutlierConfig,
+    OutlierEjector,
 )
 from gie_tpu.resilience.policy import (         # noqa: F401
     Backoff,

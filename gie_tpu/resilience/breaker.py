@@ -287,6 +287,27 @@ class BreakerBoard:
         return self._record_with(
             key, ok, lambda b: b.record_serve(ok, latency_s))
 
+    def trip(self, key: int, plane: str = SERVE) -> bool:
+        """Force-open one endpoint's breaker (p99 outlier ejection,
+        resilience/outlier.py): the ejector's verdict is not a single
+        outcome, so it cannot arrive through record()/record_serve_
+        outcome — it trips the breaker directly, on the SERVE plane by
+        default so RECOVERY reuses the serve-opened machinery (dwell,
+        HALF_OPEN re-admission via quarantined(), live-traffic probes
+        closing or re-opening it). Returns True when the call actually
+        opened a closed/half-open breaker."""
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None:
+                b = CircuitBreaker(self.cfg, self.clock)
+                self._breakers[key] = b
+            if b.state == BreakerState.OPEN:
+                return False
+            b.ok_streak = 0
+            b._to(BreakerState.OPEN, plane)
+            self._refresh_has_open()
+            return True
+
     def allow(self, key: int) -> bool:
         if not self.has_open:
             return True

@@ -67,6 +67,15 @@ class LadderConfig:
     serve_window_s: float = 10.0
     serve_error_rate: float = 0.5
     serve_min_samples: int = 20
+    # CACHED-rung score weight: the host-side degraded pick ranks
+    # endpoints by ``queue_depth + cached_kv_weight * kv_util``. The
+    # default comes from the storm sweep recorded in docs/RESILIENCE.md
+    # ("ladder calibration"): under a forced-CACHED flash-crowd storm,
+    # w=0 (KV-blind) is clearly worst (-8% goodput, +58% TTFT p99 —
+    # queue depth alone cannot see a pod whose cache is about to
+    # thrash), while 2..32 sit on a flat plateau with 8 at its optimum
+    # — so 8 stays. The runner wires --ladder-cached-kv-weight.
+    cached_kv_weight: float = 8.0
 
     def __post_init__(self):
         if (self.dispatch_error_streak < 1 or self.recover_streak < 1
@@ -78,6 +87,8 @@ class LadderConfig:
             raise ValueError("serve_error_rate must be in (0, 1]")
         if self.serve_window_s <= 0 or self.serve_min_samples < 1:
             raise ValueError("serve window parameters must be positive")
+        if self.cached_kv_weight < 0:
+            raise ValueError("cached_kv_weight must be >= 0")
 
 
 class DegradationLadder:
@@ -238,6 +249,16 @@ class DegradationLadder:
                    * cfg.blackout_recover_fraction)):
             self._set(serve_floor=Rung.FULL)
 
+    def force_level(self, rung: Rung) -> None:
+        """Pin the error-driven level (storm sweeps + tests): combined
+        with a prohibitive recover_streak/probe_interval_s config this
+        holds the pool on one rung so a sweep can measure THAT rung's
+        policy (e.g. the CACHED kv-weight calibration in
+        docs/RESILIENCE.md) instead of the transition dynamics."""
+        with self._lock:
+            self._ok_streak = 0
+            self._set(level=Rung(rung))
+
     def should_probe(self) -> bool:
         """While degraded by LEVEL, let one wave through the full path
         every probe interval — its outcome is the ascent signal. A pure
@@ -266,29 +287,47 @@ class ResilienceState:
         staleness_fn: Optional[Callable[[], float]] = None,
         static_subset: int = 4,
         on_change: Optional[Callable[[int], None]] = None,
+        ejector=None,
     ):
         self.board = board if board is not None else BreakerBoard()
         self.ladder = ladder if ladder is not None else DegradationLadder(
             on_change=on_change)
-        if ladder is None and on_change is None:
+        if self.ladder.on_change is None and on_change is None:
             # Default observability: the ladder drives gie_degraded_mode
-            # directly (runtime.metrics is import-light).
+            # directly (runtime.metrics is import-light). Applies to a
+            # caller-supplied ladder too — a ladder built from the
+            # --ladder-* flags must not silently lose the gauge.
             from gie_tpu.runtime import metrics as own_metrics
 
             self.ladder.on_change = (
                 lambda r: own_metrics.DEGRADED_MODE.set(r))
         self.staleness_fn = staleness_fn
         self.static_subset = max(static_subset, 1)
+        # Optional p99 serve-latency outlier ejector (resilience/
+        # outlier.py, --outlier-ejection): fed latencies by the serve-
+        # outcome path, evaluated here at wave cadence.
+        self.ejector = ejector
 
     def observe(self) -> None:
         """Per-wave tick from the batching collector: fold the staleness
-        clock into the ladder. Cheap (one callable + one lock) and wave-
-        cadence, never request-cadence."""
+        clock into the ladder and run the outlier-ejection eval. Cheap
+        (one callable + one lock each, and the ejector rate-limits its
+        own eval) and wave-cadence, never request-cadence."""
         if self.staleness_fn is not None:
             try:
                 self.ladder.note_metrics_staleness(float(self.staleness_fn()))
             except Exception:
                 pass  # a broken staleness source must not fail picks
+        if self.ejector is not None:
+            try:
+                ejected = self.ejector.evaluate(self.board)
+                if ejected:
+                    from gie_tpu.runtime import metrics as own_metrics
+
+                    own_metrics.OUTLIER_EJECTIONS.inc(len(ejected))
+                    own_metrics.BREAKER_OPEN.set(self.board.open_count())
+            except Exception:
+                pass  # ejection is advisory: it must never fail picks
 
     def healthy(self) -> bool:
         """The health endpoint's 'resilience' sub-service predicate."""

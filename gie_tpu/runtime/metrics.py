@@ -258,6 +258,12 @@ SERVE_LATENCY = prom.Histogram(
     buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0),
     registry=REGISTRY,
 )
+OUTLIER_EJECTIONS = prom.Counter(
+    "gie_outlier_ejections_total",
+    "Endpoints quarantined by p99 serve-latency outlier ejection "
+    "(windowed per-endpoint quantile vs pool median, --outlier-ejection)",
+    registry=REGISTRY,
+)
 DRAINING_ENDPOINTS = prom.Gauge(
     "gie_draining_endpoints",
     "Endpoints in graceful DRAINING state (excluded from new picks, "
@@ -377,12 +383,16 @@ def register_pool_aggregates(snapshot) -> None:
                 _pool_snapshot_cached().get(field, 0.0)))
 
 
-def start_metrics_server(port: int, providers=None):
+def start_metrics_server(port: int, providers=None,
+                         debugz_bind: str = "127.0.0.1"):
     """Start the operator HTTP listener: /metrics (Prometheus text, or
     OpenMetrics-with-exemplars under content negotiation) plus the
     /debugz introspection plane (gie_tpu/obs/debugz.py) for whatever
-    zpage providers the caller registers. Returns the server (close()
+    zpage providers the caller registers — /debugz answers loopback
+    peers only unless ``debugz_bind`` names a non-loopback address
+    (--debugz-bind, docs/OBSERVABILITY.md). Returns the server (close()
     to stop); replaces prometheus_client's bare start_http_server."""
     from gie_tpu.obs.debugz import start_debugz_server
 
-    return start_debugz_server(port, REGISTRY, providers)
+    return start_debugz_server(port, REGISTRY, providers,
+                               debugz_bind=debugz_bind)

@@ -120,6 +120,27 @@ class Options:
     # STATIC rung pool size: the fixed endpoint subset the bottom ladder
     # rung rotates over.
     resilience_static_subset: int = 4
+    # Degradation-ladder calibration knobs (docs/RESILIENCE.md "ladder
+    # calibration"): the CACHED rung's queue + w*kv weight (default from
+    # the storm sweep) and the pool-wide serve-outcome floor thresholds.
+    ladder_cached_kv_weight: float = 8.0
+    ladder_serve_window_s: float = 10.0
+    ladder_serve_error_rate: float = 0.5
+    ladder_serve_min_samples: int = 20
+    # p99 serve-latency outlier ejection (resilience/outlier.py): a
+    # consistently-slow endpoint (windowed per-endpoint quantile above
+    # --outlier-ratio x the pool median) is quarantined via the breaker
+    # serve plane. Off by default until real-hardware latency
+    # distributions confirm the defaults (ROADMAP item 10).
+    outlier_ejection: bool = False
+    outlier_window_s: float = 30.0
+    outlier_ratio: float = 3.0
+    outlier_quantile: float = 0.99
+    # /debugz peer gate (docs/OBSERVABILITY.md "bind hardening"): the
+    # zpages answer loopback peers only unless this names a non-loopback
+    # address (e.g. the pod IP, or 0.0.0.0). /metrics is unaffected —
+    # Prometheus keeps scraping from off-pod either way.
+    debugz_bind: str = "127.0.0.1"
     # gie-chaos fault injection (resilience/faults.py): repeatable
     # "point=kind:prob[:arg],..." specs plus the schedule seed. Empty =
     # injection disabled (zero hot-path cost beyond one flag check).
@@ -318,6 +339,43 @@ class Options:
                             default=d.resilience_static_subset,
                             help="endpoint pool size of the STATIC "
                                  "ladder rung")
+        parser.add_argument("--ladder-cached-kv-weight", type=float,
+                            default=d.ladder_cached_kv_weight,
+                            help="CACHED-rung score weight: queue + "
+                                 "w*kv_util (default from the storm "
+                                 "sweep, docs/RESILIENCE.md)")
+        parser.add_argument("--ladder-serve-window-s", type=float,
+                            default=d.ladder_serve_window_s,
+                            help="sliding window for the ladder's pool-"
+                                 "wide serve-outcome floor")
+        parser.add_argument("--ladder-serve-error-rate", type=float,
+                            default=d.ladder_serve_error_rate,
+                            help="pool-wide serve error rate that pins "
+                                 "the ladder at ROUND_ROBIN")
+        parser.add_argument("--ladder-serve-min-samples", type=int,
+                            default=d.ladder_serve_min_samples,
+                            help="min serve outcomes in the window "
+                                 "before the serve floor may engage")
+        parser.add_argument("--outlier-ejection", dest="outlier_ejection",
+                            action="store_true",
+                            default=d.outlier_ejection,
+                            help="p99 serve-latency outlier ejection: "
+                                 "quarantine endpoints whose windowed "
+                                 "latency quantile exceeds --outlier-"
+                                 "ratio x the pool median "
+                                 "(docs/RESILIENCE.md)")
+        parser.add_argument("--outlier-window-s", type=float,
+                            default=d.outlier_window_s,
+                            help="sliding serve-latency window per "
+                                 "endpoint")
+        parser.add_argument("--outlier-ratio", type=float,
+                            default=d.outlier_ratio,
+                            help="ejection threshold: endpoint quantile "
+                                 "vs pool median")
+        parser.add_argument("--outlier-quantile", type=float,
+                            default=d.outlier_quantile,
+                            help="per-endpoint latency quantile compared "
+                                 "against the pool median")
         parser.add_argument("--fault", action="append", default=[],
                             dest="fault_specs",
                             metavar="POINT=KIND:PROB[:ARG],...",
@@ -370,6 +428,11 @@ class Options:
         parser.add_argument("--obs-dump-dir", default=d.obs_dump_dir,
                             help="directory for chaos-scenario flight-"
                                  "recorder JSON artifacts")
+        parser.add_argument("--debugz-bind", default=d.debugz_bind,
+                            help="peer gate for the /debugz zpages: "
+                                 "loopback-only by default; name a non-"
+                                 "loopback address (pod IP, 0.0.0.0) to "
+                                 "expose them (/metrics is unaffected)")
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "Options":
@@ -417,6 +480,15 @@ class Options:
             replication_stale_after_s=args.replication_stale_after_s,
             resilience=args.resilience,
             resilience_static_subset=args.resilience_static_subset,
+            ladder_cached_kv_weight=args.ladder_cached_kv_weight,
+            ladder_serve_window_s=args.ladder_serve_window_s,
+            ladder_serve_error_rate=args.ladder_serve_error_rate,
+            ladder_serve_min_samples=args.ladder_serve_min_samples,
+            outlier_ejection=args.outlier_ejection,
+            outlier_window_s=args.outlier_window_s,
+            outlier_ratio=args.outlier_ratio,
+            outlier_quantile=args.outlier_quantile,
+            debugz_bind=args.debugz_bind,
             fault_specs=list(args.fault_specs),
             fault_seed=args.fault_seed,
             fault_scenario=args.fault_scenario,
@@ -491,6 +563,23 @@ class Options:
                 raise ValueError("--autoscale-ttft-slo-ms must be >= 0")
         if self.resilience_static_subset < 1:
             raise ValueError("--resilience-static-subset must be >= 1")
+        if self.ladder_cached_kv_weight < 0:
+            raise ValueError("--ladder-cached-kv-weight must be >= 0")
+        if self.ladder_serve_window_s <= 0:
+            raise ValueError("--ladder-serve-window-s must be > 0")
+        if not (0.0 < self.ladder_serve_error_rate <= 1.0):
+            raise ValueError(
+                "--ladder-serve-error-rate must be in (0, 1]")
+        if self.ladder_serve_min_samples < 1:
+            raise ValueError("--ladder-serve-min-samples must be >= 1")
+        if self.outlier_ejection:
+            if self.outlier_window_s <= 0:
+                raise ValueError("--outlier-window-s must be > 0")
+            if self.outlier_ratio <= 1.0:
+                raise ValueError("--outlier-ratio must be > 1")
+            if not (0.5 <= self.outlier_quantile < 1.0):
+                raise ValueError(
+                    "--outlier-quantile must be in [0.5, 1)")
         if self.fault_specs:
             from gie_tpu.resilience import faults as _faults
 

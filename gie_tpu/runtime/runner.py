@@ -147,10 +147,31 @@ class ExtProcServerRunner:
         # engine's own staleness clock as the blackout signal.
         self.resilience = None
         if opts.resilience:
-            from gie_tpu.resilience import ResilienceState
+            from gie_tpu.resilience import (
+                DegradationLadder,
+                LadderConfig,
+                OutlierConfig,
+                OutlierEjector,
+                ResilienceState,
+            )
 
+            ejector = None
+            if opts.outlier_ejection:
+                # p99 serve-latency outlier ejection (docs/RESILIENCE.md):
+                # fed by the serve-outcome path, evaluated at wave
+                # cadence, tripping the breaker serve plane.
+                ejector = OutlierEjector(OutlierConfig(
+                    window_s=opts.outlier_window_s,
+                    quantile=opts.outlier_quantile,
+                    ratio=opts.outlier_ratio))
             self.resilience = ResilienceState(
-                static_subset=opts.resilience_static_subset)
+                ladder=DegradationLadder(LadderConfig(
+                    cached_kv_weight=opts.ladder_cached_kv_weight,
+                    serve_window_s=opts.ladder_serve_window_s,
+                    serve_error_rate=opts.ladder_serve_error_rate,
+                    serve_min_samples=opts.ladder_serve_min_samples)),
+                static_subset=opts.resilience_static_subset,
+                ejector=ejector)
         # Multiplexed keep-alive scrape engine (metricsio/engine.py,
         # docs/METRICSIO.md): a fixed shard pool polls every endpoint at
         # the fast-poll cadence; attach/detach below are O(1) so endpoint
@@ -471,6 +492,9 @@ class ExtProcServerRunner:
                 lambda q: self.resilience.board.report())
             providers["ladder"] = (
                 lambda q: self.resilience.report())
+            if self.resilience.ejector is not None:
+                providers["outlier"] = (
+                    lambda q: self.resilience.ejector.report())
         return providers
 
     def _autoscale_ttft_probe(self):
@@ -514,6 +538,11 @@ class ExtProcServerRunner:
     def _slot_reclaimed(self, slot: int) -> None:
         self.scheduler.evict_endpoint(slot)
         self.scraper.detach(slot)
+        if self.resilience is not None and self.resilience.ejector is not None:
+            # Latency history must not outlive the endpoint: a reused
+            # slot's new pod starts with a clean quantile window (the
+            # breaker's own drop rides the scrape detach above).
+            self.resilience.ejector.drop(slot)
 
     def _sync_scrapers(self) -> None:
         for ep in self.datastore.endpoints():
@@ -615,7 +644,8 @@ class ExtProcServerRunner:
         try:
             self.debugz_server = own_metrics.start_metrics_server(
                 self.opts.metrics_port,
-                providers=self._debugz_providers())
+                providers=self._debugz_providers(),
+                debugz_bind=self.opts.debugz_bind)
         except OSError as e:
             self.log.error("metrics server failed to start", err=e)
 

@@ -609,6 +609,14 @@ class BatchingTPUPicker:
             # State transition: refresh the gauge here rather than
             # paying open_count()'s lock per request.
             own_metrics.BREAKER_OPEN.set(rs.board.open_count())
+        if (ep is not None and ok and latency_s > 0.0
+                and rs.ejector is not None):
+            # p99 outlier ejection input (resilience/outlier.py): only
+            # SUCCESSFUL serves' latencies — a fast local-reply 503
+            # would drag a sick endpoint's quantile down exactly while
+            # the error plane is what should be judging it. The eval
+            # itself runs at wave cadence (ResilienceState.observe).
+            rs.ejector.note(ep.slot, latency_s)
         rs.ladder.note_serve_outcome(ok)
 
     def observe_response_complete(self, ctx) -> None:
@@ -1403,6 +1411,10 @@ class BatchingTPUPicker:
                 own_metrics.PICKS.labels(outcome="unavailable").inc()
             return
         label = self._RUNG_LABELS.get(rung, "static")
+        # CACHED-rung KV weight from the ladder config (--ladder-cached-
+        # kv-weight; default calibrated by the storm sweep recorded in
+        # docs/RESILIENCE.md).
+        kvw = (rs.ladder.cfg.cached_kv_weight if rs is not None else 8.0)
         # Last-known-good rows: queue depth + KV utilization, read once
         # per wave. On the RR/STATIC rungs these may be arbitrarily stale
         # — they only shape static weights there.
@@ -1431,7 +1443,7 @@ class BatchingTPUPicker:
                 if rung == Rung.CACHED:
                     # Fresh-enough data: least queue+KV now, plus an
                     # in-wave +1 spread per assignment.
-                    scores = [queue[col_of[s]] + 8.0 * kv[col_of[s]]
+                    scores = [queue[col_of[s]] + kvw * kv[col_of[s]]
                               for s in cands]
                     order = sorted(range(len(cands)),
                                    key=lambda j: (scores[j], cands[j]))
@@ -1493,7 +1505,7 @@ class BatchingTPUPicker:
                         "chosen_slot": int(picked[0]),
                         "fallbacks": list(res.fallbacks),
                         "scorers": {"degraded_" + label: round(
-                            float(queue[j] + 8.0 * kv[j]), 5)},
+                            float(queue[j] + kvw * kv[j]), 5)},
                         "queue_depth": float(queue[j]),
                         "kv_util": float(kv[j]),
                     })

@@ -1,0 +1,40 @@
+"""gie-storm: production-shape workload engine (docs/STORM.md).
+
+Composable, seeded-deterministic traffic shapes (diurnal ramp, flash
+crowd, LoRA churn, long-context mix, rolling upgrade, standby-failover
+probes) compiled into bit-identical-per-seed schedules and executed
+against the REAL stack — ext-proc admission, flow queue, wave/pick,
+breakers/ladder/drain/outlier ejection, autoscale, replication digests
+— scored for cluster goodput and SLO attainment into one JSON scorecard
+artifact. ``python -m gie_tpu.storm <scenario>`` replays a recorded
+scenario whose ``drive`` carries a ``storm`` section.
+"""
+
+from gie_tpu.storm.engine import (          # noqa: F401
+    EngineConfig,
+    PoolSpec,
+    StormEngine,
+    StormResult,
+    run_scenario,
+)
+from gie_tpu.storm.scorecard import (       # noqa: F401
+    SCHEMA as SCORECARD_SCHEMA,
+    score_completions,
+)
+from gie_tpu.storm.shapes import (          # noqa: F401
+    Arrival,
+    ConstantRate,
+    ControlEvent,
+    DiurnalRamp,
+    FlashCrowd,
+    LongContextMix,
+    LoraChurn,
+    Program,
+    RollingUpgrade,
+    Schedule,
+    Shape,
+    StandbyFailover,
+    TrafficConfig,
+    program_from_drive,
+    shapes_from_specs,
+)

@@ -1,0 +1,45 @@
+"""CLI: replay a storm scenario and print its scorecard JSON.
+
+    python -m gie_tpu.storm storm-flash-upgrade
+    python -m gie_tpu.storm path/to/scenario.json --seed 7 --out /tmp/storm
+
+The storm is host-dominated (the device cycle is tiny at CI pool
+sizes), so it forces the CPU platform unless GIE_STORM_PLATFORM says
+otherwise — the same guard bench_goodput.py uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(prog="python -m gie_tpu.storm")
+    parser.add_argument("scenario",
+                        help="scenario JSON path or shipped-library name "
+                             "with a drive.storm section")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="override the scenario's seed")
+    parser.add_argument("--out", default=None,
+                        help="directory for the scorecard artifact")
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update(
+        "jax_platforms", os.environ.get("GIE_STORM_PLATFORM", "cpu"))
+
+    from gie_tpu.storm.engine import run_scenario
+
+    result = run_scenario(args.scenario, seed=args.seed,
+                          dump_dir=args.out)
+    json.dump(result.scorecard, sys.stdout, indent=1, default=float)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

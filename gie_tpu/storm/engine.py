@@ -1,0 +1,918 @@
+"""StormEngine: execute a compiled storm schedule against the REAL
+stack (docs/STORM.md).
+
+This is ROADMAP item 5's proving ground: one run drives
+
+  ext-proc admission   every arrival is a real StreamingServer stream
+                       (fast-lane scan of a JSON body, objective /
+                       decode-hint headers, pooled response templates)
+  flow queue + waves   the real BatchingTPUPicker (fair ordering, holds,
+                       micro-batched device cycles)
+  resilience           the real BreakerBoard / DegradationLadder /
+                       graceful drain / outlier ejector, fed by the real
+                       serve-outcome response path
+  scrape plane         the real multiplexed ScrapeEngine polling each
+                       stub's Prometheus text over the fetcher seam
+  autoscale            the real SignalCollector -> CapacityModel ->
+                       AutoscaleRecommender loop, actuated by adding
+                       emulated pods to the live pool
+  replication          the real StatePublisher digest path: a follower
+                       fetches + decodes the leader's state mid-storm
+                       (the warm-standby readiness probe)
+  chaos                optional gie-chaos fault schedules (a scenario's
+                       ``rules``), layered over the storm
+
+against a fleet of VLLMStub model servers advancing in real time. The
+DATA PLANE between Envoy and the model server is emulated: a pick's
+destination is submitted to that stub, the response-headers hop fires
+at the stub's first token (TTFT) with a real ``:status``, and dead
+endpoints serve 503 — exactly the seam the chaos endpoint.serve_5xx /
+endpoint.reset points already rewrite inside the ext-proc server.
+
+Determinism: the SCHEDULE is bit-identical per seed (shapes.py); the
+execution is real threads against real subsystems, so the scorecard's
+aggregate assertions (zero client-visible 5xx, rung down-and-up,
+goodput floors) are the replayable contract, not byte-equal traces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool, Pod
+from gie_tpu.extproc import StreamingServer, metadata as mdkeys, pb
+from gie_tpu.extproc.server import ExtProcError, StreamAborted
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.metricsio.engine import ScrapeEngine
+from gie_tpu.metricsio.mappings import VLLM
+from gie_tpu.resilience import scenarios as scenarios_mod
+from gie_tpu.resilience.breaker import BreakerBoard, BreakerConfig
+from gie_tpu.resilience.ladder import (
+    DegradationLadder,
+    LadderConfig,
+    ResilienceState,
+    Rung,
+)
+from gie_tpu.resilience.outlier import OutlierEjector
+from gie_tpu.sched import Scheduler
+from gie_tpu.sched.batching import BatchingTPUPicker
+from gie_tpu.simulator.vllm_stub import StubConfig, VLLMStub
+from gie_tpu.storm import scorecard as scorecard_mod
+from gie_tpu.storm.shapes import Program, Schedule, program_from_drive
+from gie_tpu.utils.lora import LoraRegistry
+
+POOL = EndpointPool(selector={"app": "storm"}, target_ports=[8000],
+                    namespace="default")
+
+# Engine-default stub dynamics: ~13 req/s per pod at the default decode
+# mix — small enough that a 3-4x flash crowd saturates a 6-pod pool
+# (sheddable traffic sheds, the autoscale loop sees pressure) within a
+# CI-scale run.
+DEFAULT_STUB = StubConfig(
+    max_running=8,
+    num_kv_blocks=4096,
+    prefill_tokens_per_s=6000.0,
+    decode_tokens_per_s=40.0,
+    prefix_cache_chunks=1024,
+    max_lora=4,
+    lora_load_s=0.15,
+)
+
+
+@dataclasses.dataclass
+class PoolSpec:
+    """The emulated fleet the storm starts with."""
+
+    n_pods: int = 6
+    stub: object = None            # StubConfig | list[StubConfig] | None
+    ip_base: str = "10.77.0"
+    replacement_ip_base: str = "10.78.0"
+    drain_deadline_s: float = 10.0
+
+    def stub_cfgs(self) -> list[StubConfig]:
+        s = self.stub if self.stub is not None else DEFAULT_STUB
+        if isinstance(s, list):
+            if len(s) != self.n_pods:
+                raise ValueError("need one StubConfig per pod")
+            return list(s)
+        return [s] * self.n_pods
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    ttft_slo_s: float = 2.5
+    scrape_interval_s: float = 0.025
+    world_dt_s: float = 0.02
+    max_concurrency: int = 128     # client-side in-flight cap
+    batch_window_s: float = 0.002
+    # ProfileConfig saturation bounds scaled to the stub fleet: the
+    # cycle's SHEDDABLE shed (the real 429 path) engages when every
+    # candidate is past these.
+    queue_limit: float = 8.0
+    kv_limit: float = 0.95
+    # Resilience layer (fast-recovery variants of the production
+    # defaults — a CI storm must see descent AND recovery in seconds).
+    ladder: Optional[LadderConfig] = None
+    breaker: Optional[BreakerConfig] = None
+    outlier: object = None         # OutlierConfig | None
+    static_subset: int = 4
+    # Autoscale loop: 0 disables; > 0 allows that many pods ABOVE the
+    # starting pool, added by the real recommender's decisions.
+    autoscale_max_extra: int = 0
+    autoscale_interval_s: float = 0.5
+    autoscale_up_sustain_s: float = 0.75
+    autoscale_shed_high_per_s: float = 1.0
+    # Replication standby (the failover_check control event): when True
+    # the engine maintains a StatePublisher over the live scheduler
+    # state and a follower-style fetch+decode probe.
+    standby: bool = False
+    # Storm sweeps: pin the ladder's error-driven level for the whole
+    # run (e.g. Rung.CACHED for the cached-kv-weight calibration).
+    force_rung: Optional[int] = None
+    # Per-request data-plane resolution timeout (wall seconds).
+    serve_timeout_s: float = 30.0
+
+    def fast_ladder(self) -> LadderConfig:
+        return LadderConfig(
+            dispatch_error_streak=2, blackout_stale_s=2.0,
+            latency_breach_s=5.0, latency_breach_streak=200,
+            recover_streak=2, min_dwell_s=0.3, probe_interval_s=0.15,
+            serve_min_samples=10_000)
+
+
+class _StubSlot:
+    """One emulated model server + its lifecycle state."""
+
+    __slots__ = ("stub", "alive", "zombie")
+
+    def __init__(self, stub: VLLMStub):
+        self.stub = stub
+        self.alive = True      # accepts new submits
+        self.zombie = False    # deleted from the pool; finishing in-flight
+
+
+class _InFlight:
+    """One picked request waiting on its stub's first token."""
+
+    __slots__ = ("stream", "arrival", "t_enqueue", "t_pick", "resolved",
+                 "tokens")
+
+    def __init__(self, stream, arrival, t_enqueue, t_pick):
+        self.stream = stream
+        self.arrival = arrival
+        self.t_enqueue = t_enqueue
+        self.t_pick = t_pick
+        self.resolved = False
+        self.tokens = 0.0
+
+
+class _StormStream:
+    """One ext-proc exchange: request headers + JSON body in, pick out,
+    then a BLOCKING response-headers hop resolved by the engine's data
+    plane at the stub's first token — the stream the real gRPC adapter
+    would carry, minus the wire."""
+
+    def __init__(self, engine: "StormEngine", arrival):
+        self.engine = engine
+        self.arrival = arrival
+        self._stage = 0
+        self._resolved = threading.Event()
+        self.resolution: Optional[tuple] = None  # (kind, served, status)
+        self.dest: Optional[str] = None
+        self.immediate_code: Optional[int] = None
+        self.sent: list = []
+
+    # -- engine side -------------------------------------------------------
+
+    def resolve(self, kind: str, served: str = "", status: int = 200) -> None:
+        self.resolution = (kind, served, status)
+        self._resolved.set()
+
+    # -- Stream interface (extproc/server.py) ------------------------------
+
+    def recv(self):
+        if self._stage == 0:
+            self._stage = 1
+            return self.engine._headers_msg(self.arrival)
+        if self._stage == 1:
+            self._stage = 2
+            return self.engine._body_msg(self.arrival)
+        if self._stage == 2:
+            self._stage = 3
+            if self.dest is None:
+                return None  # shed / immediate response: clean close
+            if not self._resolved.wait(self.engine.cfg.serve_timeout_s):
+                self.resolution = ("timeout", "", 0)
+                raise StreamAborted()
+            kind, served, status = self.resolution
+            if kind == "reset":
+                raise StreamAborted()
+            return self.engine._resp_headers_msg(served, status)
+        return None
+
+    def send(self, resp) -> None:
+        self.sent.append(resp)
+        which = resp.WhichOneof("response")
+        if which == "request_headers":
+            mut = resp.request_headers.response.header_mutation
+            for o in mut.set_headers:
+                if o.header.key == mdkeys.DESTINATION_ENDPOINT_KEY:
+                    self.dest = o.header.raw_value.decode().split(",")[0]
+                    self.engine._submit(self)
+                    break
+        elif which == "immediate_response":
+            self.immediate_code = int(resp.immediate_response.status.code)
+
+
+class StormResult:
+    """A finished run: the scorecard plus live handles for assertions."""
+
+    def __init__(self, card: dict, schedule: Schedule, resilience,
+                 board: BreakerBoard, scheduler: Scheduler, datastore):
+        self.scorecard = card
+        self.schedule = schedule
+        self.resilience = resilience
+        self.board = board
+        self.scheduler = scheduler
+        self.datastore = datastore
+
+
+class StormEngine:
+    def __init__(self, program: Program, pool: Optional[PoolSpec] = None,
+                 cfg: Optional[EngineConfig] = None, name: str = "storm"):
+        self.program = program
+        self.pool = pool if pool is not None else PoolSpec()
+        self.cfg = cfg if cfg is not None else EngineConfig()
+        self.name = name
+        self._sessions = [
+            (b"STORM SYSTEM PROMPT %03d | " % s) * 2
+            + b"s" * max(self.program.traffic.system_prompt_bytes - 52, 0)
+            for s in range(self.program.traffic.n_sessions)
+        ]
+        self._build_stack()
+        # Run state.
+        self._world_lock = threading.Lock()
+        self._pending: dict[tuple[str, int], _InFlight] = {}
+        self._stop = threading.Event()
+        self._t0 = 0.0
+        self._sem = threading.Semaphore(self.cfg.max_concurrency)
+        # Tallies (worker threads append; small lists, GIL-atomic).
+        self._completions: list[tuple] = []   # (ttft_s, tokens)
+        self._client_5xx: list[tuple] = []    # (t, phase, detail)
+        self._resets: list[tuple] = []
+        self._shed = 0
+        self._ok = 0
+        self._timeouts = 0
+        self._client_skipped = 0
+        self._rung_trace: list[tuple] = []
+        self._pool_trace: list[tuple] = []
+        self._autoscale_events: list[dict] = []
+        self._upgrades: list[dict] = []
+        self._failover_checks: list[dict] = []
+
+    # -- stack construction ------------------------------------------------
+
+    def _build_stack(self) -> None:
+        cfg, pool = self.cfg, self.pool
+        # The tuned batch-aware profile (the goodput-bench scheduler),
+        # with the saturation bounds scaled to the stub fleet so the
+        # cycle's sheddable 429 path engages under a genuine overload.
+        from gie_tpu.sched.config import tuned_profile
+
+        prof, weights = tuned_profile()
+        prof = dataclasses.replace(
+            prof, queue_limit=cfg.queue_limit, kv_limit=cfg.kv_limit)
+        self.scheduler = Scheduler(prof, weights=weights)
+        self.metrics_store = MetricsStore()
+        self.lora_registry = LoraRegistry()
+        self.board = BreakerBoard(
+            cfg.breaker if cfg.breaker is not None
+            else BreakerConfig(open_after=4, open_s=1.0, close_after=2,
+                               serve_window_s=4.0, serve_rate_open=0.6,
+                               serve_min_samples=8))
+        ladder = DegradationLadder(
+            cfg.ladder if cfg.ladder is not None else cfg.fast_ladder())
+        ejector = (OutlierEjector(cfg.outlier)
+                   if cfg.outlier is not None else None)
+        self.resilience = ResilienceState(
+            board=self.board, ladder=ladder,
+            static_subset=cfg.static_subset, ejector=ejector)
+        self.datastore = Datastore(
+            on_slot_reclaimed=self._slot_reclaimed,
+            drain_deadline_s=pool.drain_deadline_s)
+        self.datastore.pool_set(POOL)
+        self._stubs: dict[str, _StubSlot] = {}
+        self._pod_names: list[str] = []
+        for i, scfg in enumerate(pool.stub_cfgs()):
+            self._add_pod(f"p{i}", f"{pool.ip_base}.{i + 1}", scfg)
+        self.picker = BatchingTPUPicker(
+            self.scheduler, self.datastore, self.metrics_store,
+            max_wait_s=cfg.batch_window_s,
+            # Wave width capped at 48 so every wave fits the n=64 bucket
+            # the warmup compiles — a storm must never stall mid-crowd
+            # on a first-use jit of a bigger bucket.
+            max_batch=48,
+            lora_registry=self.lora_registry,
+            resilience=self.resilience)
+        self.server = StreamingServer(
+            self.datastore, self.picker,
+            on_served=self.picker.observe_served,
+            on_response_complete=self.picker.observe_response_complete,
+            on_stream_aborted=self.picker.observe_stream_aborted)
+        self.scrape = ScrapeEngine(
+            self.metrics_store, lora=self.lora_registry,
+            interval_s=cfg.scrape_interval_s, max_backoff_s=0.2,
+            fetcher=self._fetch_metrics, workers=2,
+            breaker_board=self.board)
+        self.resilience.staleness_fn = self.scrape.staleness_seconds
+        self._sync_scrapers()
+        # Autoscale loop (optional): the real recommender over the real
+        # signal collector; actuation = pods joining this pool.
+        self.collector = self.recommender = None
+        if cfg.autoscale_max_extra > 0:
+            from gie_tpu.autoscale.model import CapacityModel
+            from gie_tpu.autoscale.recommender import (
+                AutoscaleRecommender,
+                RecommenderConfig,
+            )
+            from gie_tpu.autoscale.signals import SignalCollector
+
+            self.collector = SignalCollector(
+                self.metrics_store, self.datastore.endpoints,
+                queue_limit=cfg.queue_limit, staleness_s=2.0,
+                scrape_engine=self.scrape)
+            self.recommender = AutoscaleRecommender(RecommenderConfig(
+                min_replicas=pool.n_pods,
+                max_replicas=pool.n_pods + cfg.autoscale_max_extra,
+                shed_high_per_s=cfg.autoscale_shed_high_per_s,
+                up_sustain_s=cfg.autoscale_up_sustain_s,
+                down_cooldown_s=3600.0), model=CapacityModel())
+        # Replication standby probe (optional): the leader's digest
+        # publisher over the live scheduler state; failover_check events
+        # fetch + decode it the way a follower would.
+        self.publisher = None
+        if cfg.standby:
+            from gie_tpu.replication import StatePublisher
+
+            self.publisher = StatePublisher(
+                {"sched": self.scheduler.export_state}, era="storm")
+
+    def _slot_reclaimed(self, slot: int) -> None:
+        self.scheduler.evict_endpoint(slot)
+        self.metrics_store.remove(slot)
+        self.scrape.detach(slot)
+        if self.resilience.ejector is not None:
+            self.resilience.ejector.drop(slot)
+
+    def _add_pod(self, name: str, ip: str, scfg: StubConfig) -> None:
+        hostport = f"{ip}:8000"
+        self._stubs[hostport] = _StubSlot(VLLMStub(scfg, name=name))
+        self._stubs[hostport].stub.hostport = hostport
+        self.datastore.pod_update_or_add(
+            Pod(name=name, labels={"app": "storm"}, ip=ip))
+        self._pod_names.append(name)
+
+    def _sync_scrapers(self) -> None:
+        for ep in self.datastore.endpoints():
+            self.scrape.attach(
+                ep.slot, f"http://{ep.hostport}/metrics", VLLM)
+
+    def _fetch_metrics(self, url: str) -> str:
+        hostport = url.split("//", 1)[-1].split("/", 1)[0]
+        with self._world_lock:
+            slot = self._stubs.get(hostport)
+            if slot is None or not slot.alive:
+                raise ConnectionError(f"storm: {hostport} is down")
+            return slot.stub.metrics_text()
+
+    # -- message builders --------------------------------------------------
+
+    def _headers_msg(self, a) -> pb.ProcessingRequest:
+        hm = pb.HeaderMap()
+
+        def add(k: str, v: str) -> None:
+            hm.headers.append(pb.HeaderValue(key=k, raw_value=v.encode()))
+
+        add(":method", "POST")
+        add(":path", "/v1/completions")
+        add("content-type", "application/json")
+        if a.band != "standard":
+            add(mdkeys.OBJECTIVE_KEY, a.band)
+        return pb.ProcessingRequest(
+            request_headers=pb.HttpHeaders(headers=hm, end_of_stream=False))
+
+    def _body_bytes(self, a) -> bytes:
+        # What a client sends: the model (LoRA adapter or base), the
+        # prompt (shared session prefix + unique suffix — real prefix-
+        # affinity input for the scan + chunk hashes), and a max_tokens
+        # cap (the power-of-two client hint; the TRUE decode length
+        # stays engine-side, sim-to-prod signal parity).
+        prompt = (self._sessions[a.session % len(self._sessions)]
+                  + b"u%08x" % (hash((a.t, a.session)) & 0xFFFFFFFF))
+        prompt = prompt[: max(a.prompt_bytes, 64)]
+        if a.prompt_bytes > len(prompt):
+            prompt = prompt + b"L" * (a.prompt_bytes - len(prompt))
+        cap = 1 << max(4, int(np.ceil(np.log2(max(a.decode_tokens, 1.0)))))
+        return json.dumps({
+            "model": a.lora or "base-model",
+            "prompt": prompt.decode("latin-1"),
+            "max_tokens": int(cap),
+        }).encode()
+
+    def _body_msg(self, a) -> pb.ProcessingRequest:
+        return pb.ProcessingRequest(
+            request_body=pb.HttpBody(body=self._body_bytes(a),
+                                     end_of_stream=True))
+
+    @staticmethod
+    def _resp_headers_msg(served: str, status: int) -> pb.ProcessingRequest:
+        from google.protobuf import struct_pb2
+
+        hm = pb.HeaderMap()
+        hm.headers.append(pb.HeaderValue(
+            key=":status", raw_value=str(status).encode()))
+        req = pb.ProcessingRequest(
+            response_headers=pb.HttpHeaders(headers=hm))
+        if served:
+            st = struct_pb2.Struct()
+            st.fields[
+                mdkeys.DESTINATION_ENDPOINT_SERVED_KEY].string_value = served
+            req.metadata_context.filter_metadata[
+                mdkeys.DESTINATION_ENDPOINT_NAMESPACE].CopyFrom(st)
+        return req
+
+    # -- data plane --------------------------------------------------------
+
+    def _submit(self, stream: _StormStream) -> None:
+        """The pick landed: hand the request to the destination stub.
+        A dead destination is an Envoy local-reply 503 (client-visible);
+        the response-headers hop then attributes it to the primary."""
+        a = stream.arrival
+        now = time.monotonic()
+        with self._world_lock:
+            slot = self._stubs.get(stream.dest)
+            if slot is None or not slot.alive:
+                stream.resolve("served", "", 503)
+                return
+            prompt_bytes = max(a.prompt_bytes, 64)
+            rid = slot.stub.submit(
+                b"p" * prompt_bytes, decode_tokens=a.decode_tokens,
+                lora=a.lora)
+            self._pending[(stream.dest, rid)] = _InFlight(
+                stream, a, t_enqueue=getattr(stream, "t_enqueue", now),
+                t_pick=now)
+
+    def _serve_one(self, a) -> None:
+        """One arrival, end to end through the real ext-proc server."""
+        stream = _StormStream(self, a)
+        stream.t_enqueue = time.monotonic()
+        try:
+            self.server.process(stream)
+        except ExtProcError as e:
+            self._client_5xx.append(
+                (self._now(), "extproc", f"{e.code}: {e}"))
+            return
+        except Exception as e:  # engine bug surfacing as a stream error
+            self._client_5xx.append(
+                (self._now(), "internal", f"{type(e).__name__}: {e}"))
+            return
+        finally:
+            self._sem.release()
+        if stream.immediate_code is not None:
+            if stream.immediate_code >= 500:
+                self._client_5xx.append(
+                    (self._now(), "immediate", stream.immediate_code))
+            else:
+                self._shed += 1
+            return
+        res = stream.resolution
+        if res is None:
+            # No pick, no immediate response: the server closed the
+            # stream without answering (should not happen).
+            self._client_5xx.append((self._now(), "unanswered", ""))
+            return
+        kind, _served, status = res
+        if kind == "timeout":
+            self._timeouts += 1
+            self._client_5xx.append((self._now(), "timeout", stream.dest))
+        elif kind == "reset":
+            self._resets.append((self._now(), stream.dest))
+        elif status >= 500:
+            self._client_5xx.append((self._now(), "serve", stream.dest))
+        else:
+            self._ok += 1
+
+    def _now(self) -> float:
+        return time.monotonic() - self._t0
+
+    # -- world loop --------------------------------------------------------
+
+    def _world_tick(self, dt: float) -> None:
+        """Advance every stub, resolve first tokens, finalize
+        completions, reap empty zombies."""
+        resolved: list[tuple[_InFlight, str, float]] = []
+        finished: list[tuple[_InFlight, object]] = []
+        with self._world_lock:
+            for hostport, slot in list(self._stubs.items()):
+                comps = slot.stub.step(dt)
+                # First-token scan: the response-headers hop fires at
+                # TTFT, while decode continues (prod semantics — the
+                # serve latency the breakers/ejector see is TTFT).
+                for r in slot.stub.running:
+                    if r.first_token_at >= 0:
+                        inf = self._pending.get((hostport, r.rid))
+                        if inf is not None and not inf.resolved:
+                            inf.resolved = True
+                            resolved.append((inf, hostport, r.first_token_at))
+                for c in comps:
+                    inf = self._pending.pop((hostport, c.rid), None)
+                    if inf is not None:
+                        finished.append((inf, c))
+                if slot.zombie and not slot.stub.running \
+                        and not slot.stub.queue:
+                    del self._stubs[hostport]
+        for inf, hostport, _t_ft in resolved:
+            inf.stream.resolve("served", hostport, 200)
+        for inf, c in finished:
+            if not inf.resolved:
+                # Completed within one tick: resolve late, still a 200.
+                inf.resolved = True
+                inf.stream.resolve("served", inf.stream.dest, 200)
+            # User TTFT spans the whole chain: the ext-proc leg (enqueue
+            # to pick) plus the stub's submit-relative TTFT (queue +
+            # prefill). Tokens at the TRUE generated length.
+            ttft = (inf.t_pick - inf.t_enqueue) + c.ttft_s
+            self._completions.append((ttft, float(c.output_tokens)))
+
+    def _autoscale_tick(self) -> None:
+        sig = self.collector.sample()
+        current = len(self.datastore.endpoints())
+        rec = self.recommender.observe(sig, current=current)
+        if rec.desired > current:
+            base = len(self._pod_names)
+            for k in range(rec.desired - current):
+                self._add_pod(
+                    f"as{base + k}",
+                    f"{self.pool.replacement_ip_base}.{200 + base + k}",
+                    self.pool.stub_cfgs()[0])
+            self._sync_scrapers()
+            self._autoscale_events.append({
+                "t": round(self._now(), 3), "from": current,
+                "to": rec.desired, "reason": rec.reason})
+
+    def _control_event(self, ev) -> None:
+        if ev.kind == "drain":
+            i = ev.args[0]
+            name = f"p{i}"
+            hostport = f"{self.pool.ip_base}.{i + 1}:8000"
+            if self.datastore.pod_mark_draining("default", name):
+                self._upgrades.append({
+                    "t": round(self._now(), 3), "pod": name,
+                    "step": "drain", "hostport": hostport})
+        elif ev.kind == "replace":
+            i = ev.args[0]
+            name = f"p{i}"
+            hostport = f"{self.pool.ip_base}.{i + 1}:8000"
+            self.datastore.pod_delete("default", name)
+            with self._world_lock:
+                slot = self._stubs.get(hostport)
+                if slot is not None:
+                    # The kubelet grace window: in-flight streams finish
+                    # on the terminating pod, new connects are refused.
+                    slot.alive = False
+                    slot.zombie = True
+            self._add_pod(
+                f"{name}-r", f"{self.pool.replacement_ip_base}.{i + 1}",
+                self.pool.stub_cfgs()[min(i, len(self.pool.stub_cfgs()) - 1)])
+            self._sync_scrapers()
+            self._upgrades.append({
+                "t": round(self._now(), 3), "pod": name,
+                "step": "replace", "hostport": hostport})
+        elif ev.kind == "failover_check" and self.publisher is not None:
+            self._failover_probe()
+
+    def _failover_probe(self) -> None:
+        """Warm-standby readiness: publish the live digest, fetch and
+        decode it the way a follower would (docs/REPLICATION.md). The
+        probe asserts nothing itself — the scorecard records epoch and
+        decoded-section evidence for the test to pin."""
+        from gie_tpu.replication import codec
+
+        self.publisher.refresh()
+        status, _headers, body = self.publisher.serve(
+            since=None, era=None, if_none_match=None)
+        n_arrays = 0
+        digest = codec.decode_digest(body) if status == 200 else None
+        if digest is not None:
+            n_arrays = sum(len(v) for v in digest.sections.values())
+        self._failover_checks.append({
+            "t": round(self._now(), 3), "status": int(status),
+            "epoch": self.publisher.status().get("epoch"),
+            "decoded_arrays": n_arrays,
+            "ok": bool(digest is not None and n_arrays > 0)})
+
+    # -- run ---------------------------------------------------------------
+
+    def warmup(self, schedule: Optional[Schedule] = None) -> None:
+        """Compile the wave lattices OUTSIDE the storm window (the
+        chaos-suite lesson: a bounded fault schedule must not burn out
+        during a first-pick jit, and a mid-run compile stalls every
+        pick behind it — the stall then releases as one giant wave).
+        Bodies must be REAL-SHAPED: the lattice is keyed by the chunk-
+        lane bucket of the wave's longest body, so a tiny warm body
+        compiles a lattice no storm wave will ever use. One solo pick
+        sizes bucket 1; concurrent bursts of 8 and 12 size buckets 8
+        and 64 — every size the 48-wide waves can reach — for each
+        distinct chunk-lane bucket the schedule's prompt-length
+        classes map to."""
+        from gie_tpu.extproc.server import PickRequest
+        from gie_tpu.sched.hashing import batch_chunk_hashes
+        from gie_tpu.sched.types import chunk_bucket_for
+        from gie_tpu.storm.shapes import Arrival
+
+        tc = self.program.traffic
+        sizes = {tc.system_prompt_bytes + tc.user_suffix_bytes}
+        if schedule is not None:
+            sizes.update(a.prompt_bytes for a in schedule.arrivals)
+        # One warm body per distinct CHUNK-LANE BUCKET (the lattice key),
+        # not per raw byte length: several prompt classes often share a
+        # bucket, and each extra class is a multi-second compile.
+        bodies: dict[int, bytes] = {}
+        for pb_ in sorted(sizes):
+            body = self._body_bytes(Arrival(
+                t=0.0, session=0, prompt_bytes=pb_, decode_tokens=16.0))
+            _, counts = batch_chunk_hashes([body])
+            bodies.setdefault(chunk_bucket_for(int(counts.max())), body)
+        bodies = list(bodies.values())
+
+        def one(body: bytes):
+            try:
+                self.picker.pick(PickRequest(headers={}, body=body),
+                                 self.datastore.pick_candidates())
+            except Exception:
+                pass
+
+        for body in bodies:
+            one(body)
+            for n in (8, 12):
+                ts = [threading.Thread(target=one, args=(body,))
+                      for _ in range(n)]
+                [t.start() for t in ts]
+                [t.join() for t in ts]
+
+    def run(self, schedule: Optional[Schedule] = None,
+            warmup: bool = True) -> StormResult:
+        cfg = self.cfg
+        if schedule is None:
+            schedule = self.program.compile()
+        if warmup:
+            self.warmup(schedule)
+        if cfg.force_rung is not None:
+            self.resilience.ladder.force_level(Rung(cfg.force_rung))
+        self._t0 = time.monotonic()
+        world = threading.Thread(target=self._world_loop, daemon=True)
+        world.start()
+        workers: list[threading.Thread] = []
+        events = list(schedule.events)
+        next_ev = 0
+        try:
+            for a in schedule.arrivals:
+                # ONE timeline: due control events fire (at their own
+                # times) before the next arrival; events trailing the
+                # last arrival drain in the loop below.
+                while next_ev < len(events) and events[next_ev].t <= a.t:
+                    ev = events[next_ev]
+                    next_ev += 1
+                    self._wait_until(ev.t)
+                    self._control_event(ev)
+                self._wait_until(a.t)
+                if not self._sem.acquire(blocking=False):
+                    # Client-side concurrency cap: a real client pool is
+                    # finite, and the submitter must NEVER block — a
+                    # stalled walk would delay the control events (the
+                    # upgrade timeline) behind the very overload the
+                    # storm exists to create. Skipped arrivals are load
+                    # the clients never offered; the scorecard records
+                    # them.
+                    self._client_skipped += 1
+                    continue
+                w = threading.Thread(
+                    target=self._serve_one, args=(a,), daemon=True)
+                w.start()
+                workers.append(w)
+            while next_ev < len(events):
+                ev = events[next_ev]
+                next_ev += 1
+                self._wait_until(ev.t)
+                self._control_event(ev)
+            self._wait_until(schedule.traffic.duration_s)
+            # Drain: let in-flight serves finish (bounded).
+            deadline = time.monotonic() + 20.0
+            for w in workers:
+                w.join(timeout=max(deadline - time.monotonic(), 0.0))
+            # Recovery window: keep the world (and probes) ticking until
+            # the ladder climbs home or the bounded window ends.
+            recover_until = time.monotonic() + 10.0
+            from gie_tpu.extproc.server import PickRequest
+
+            while (time.monotonic() < recover_until
+                   and cfg.force_rung is None
+                   and self.resilience.ladder.rung() != Rung.FULL):
+                try:
+                    self.picker.pick(
+                        PickRequest(headers={}, body=b"probe"),
+                        self.datastore.pick_candidates())
+                except Exception:
+                    pass
+                time.sleep(0.05)
+        finally:
+            self._stop.set()
+            world.join(timeout=10)
+        card = self._score(schedule)
+        return StormResult(card, schedule, self.resilience, self.board,
+                           self.scheduler, self.datastore)
+
+    def close(self) -> None:
+        self.scrape.close()
+        self.picker.close()
+
+    def _wait_until(self, t_storm: float) -> None:
+        delay = (self._t0 + t_storm) - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+
+    def _world_loop(self) -> None:
+        cfg = self.cfg
+        next_autoscale = cfg.autoscale_interval_s
+        next_trace = 0.0
+        last = time.monotonic()
+        while not self._stop.is_set():
+            time.sleep(cfg.world_dt_s)
+            now = time.monotonic()
+            dt, last = now - last, now
+            try:
+                self._world_tick(min(dt, 0.25))
+            except Exception:
+                pass  # the world must keep turning
+            t = self._now()
+            if t >= next_trace:
+                next_trace = t + 0.1
+                self._rung_trace.append(
+                    (round(t, 2), int(self.resilience.ladder.rung())))
+                self._pool_trace.append(
+                    (round(t, 2), len(self.datastore.endpoints())))
+            if self.recommender is not None and t >= next_autoscale:
+                next_autoscale = t + cfg.autoscale_interval_s
+                try:
+                    self._autoscale_tick()
+                except Exception:
+                    pass
+
+    # -- scoring -----------------------------------------------------------
+
+    def _score(self, schedule: Schedule) -> dict:
+        ttfts = [c[0] for c in self._completions]
+        tokens = [c[1] for c in self._completions]
+        duration = schedule.traffic.duration_s
+        core = scorecard_mod.score_completions(
+            ttfts, tokens, duration, self.cfg.ttft_slo_s)
+        serve_ms = sorted(t * 1e3 for t in ttfts)
+
+        def pct(p):
+            if not serve_ms:
+                return 0.0
+            return float(serve_ms[min(int(p * (len(serve_ms) - 1)),
+                                      len(serve_ms) - 1)])
+
+        rungs = [r for _, r in self._rung_trace] or [0]
+        ej = (self.resilience.ejector.ejections
+              if self.resilience.ejector is not None else [])
+        card = {
+            "schema": scorecard_mod.SCHEMA,
+            "name": self.name,
+            "seed": schedule.seed,
+            "duration_s": duration,
+            "schedule_fingerprint": schedule.fingerprint(),
+            "arrivals": len(schedule.arrivals),
+            "completed": len(self._completions),
+            "ok": self._ok,
+            "shed": self._shed,
+            "client_5xx": len(self._client_5xx),
+            "client_5xx_detail": [
+                {"t": round(t, 3), "phase": p, "detail": str(d)}
+                for t, p, d in self._client_5xx[:20]],
+            "resets": len(self._resets),
+            "timeouts": self._timeouts,
+            "client_skipped": self._client_skipped,
+            **core,
+            "serve_latency_p50_ms": round(pct(0.50), 1),
+            "serve_latency_p99_ms": round(pct(0.99), 1),
+            "max_rung": int(max(rungs)),
+            "final_rung": int(self.resilience.ladder.rung()),
+            "rung_trace": self._rung_trace,
+            "pool_size_trace": self._pool_trace,
+            "breaker_opens": dict(self.board.states()),
+            "ejections": [
+                {"t": round(max(t - self._t0, 0.0), 3), "slot": s,
+                 "endpoint_q_s": round(q, 4),
+                 "pool_median_s": round(m, 4)}
+                for t, s, q, m in ej],
+            "upgrades": self._upgrades,
+            "autoscale_events": self._autoscale_events,
+            "failover_checks": self._failover_checks,
+            "final_endpoints": sorted(
+                ep.hostport for ep in self.datastore.endpoints()),
+            "lora_arrivals": sum(
+                1 for a in schedule.arrivals if a.lora is not None),
+            "long_context_arrivals": sum(
+                1 for a in schedule.arrivals if a.kind == "long_context"),
+        }
+        return card
+
+
+# -- scenario-file entry point --------------------------------------------
+
+# Everything a drive.storm section may carry: the Program inputs plus
+# the whitelisted engine knobs run_scenario applies.
+_STORM_DRIVE_KEYS = frozenset({
+    "base_qps", "duration_s", "traffic", "shapes", "pool",
+    "ttft_slo_s", "autoscale_max_extra", "queue_limit",
+    "max_concurrency",
+})
+
+
+def run_scenario(name_or_path: str, *, seed: Optional[int] = None,
+                 pool: Optional[PoolSpec] = None,
+                 cfg: Optional[EngineConfig] = None,
+                 dump_dir: Optional[str] = None) -> StormResult:
+    """Replay a recorded scenario whose ``drive`` carries a ``storm``
+    section: arm the scenario's chaos rules (AFTER warmup — the chaos
+    suite's bounded-schedule lesson), execute the storm program against
+    the real stack, and score it. This is the ROADMAP item-8 follow-on:
+    the workload engine interprets ``resilience/scenarios/`` drive
+    sections directly, so one JSON file IS the whole reproducible run
+    (chaos schedule + traffic shapes + pool + assertions' inputs)."""
+    from gie_tpu.resilience import faults
+
+    scn = scenarios_mod.load(name_or_path)
+    storm = (scn.drive or {}).get("storm")
+    if not isinstance(storm, dict):
+        raise ValueError(
+            f"scenario {scn.name!r} has no drive.storm section — not a "
+            "storm scenario (see docs/STORM.md)")
+    unknown = set(storm) - _STORM_DRIVE_KEYS
+    if unknown:
+        # Same contract as shapes_from_specs: a typoed knob silently
+        # falling back to a default would replay a DIFFERENT storm than
+        # the file records.
+        raise ValueError(
+            f"scenario {scn.name!r}: unknown drive.storm keys "
+            f"{sorted(unknown)}; known: {sorted(_STORM_DRIVE_KEYS)}")
+    program = program_from_drive(
+        storm, seed=scn.seed if seed is None else seed)
+    pool_kw = dict(storm.get("pool") or {})
+    if pool is None and pool_kw:
+        unknown = set(pool_kw) - {
+            f.name for f in dataclasses.fields(PoolSpec)}
+        if unknown:
+            raise ValueError(f"unknown storm pool fields {sorted(unknown)}")
+        pool = PoolSpec(**pool_kw)
+    if cfg is None:
+        cfg = EngineConfig()
+    # Whitelisted engine knobs a scenario may pin (everything else in
+    # EngineConfig is harness policy, not scenario content).
+    for key, cast in (("ttft_slo_s", float), ("autoscale_max_extra", int),
+                      ("queue_limit", float), ("max_concurrency", int)):
+        if key in storm:
+            cfg = dataclasses.replace(cfg, **{key: cast(storm[key])})
+    if any(s.get("kind") == "standby_failover"
+           for s in storm.get("shapes") or []):
+        # failover_check events need the replication publisher armed.
+        cfg = dataclasses.replace(cfg, standby=True)
+    engine = StormEngine(program, pool=pool, cfg=cfg, name=scn.name)
+    try:
+        schedule = program.compile()
+        engine.warmup(schedule)
+        # Arm AFTER warmup: bounded fault schedules (after=/max_fires=)
+        # must spend their draws on storm waves, not compile stalls.
+        inj = scn.arm() if scn.rules else None
+        try:
+            result = engine.run(schedule=schedule, warmup=False)
+        finally:
+            if inj is not None:
+                faults.uninstall()
+        result.scorecard["fault_log_len"] = len(inj.log) if inj else 0
+        result.scorecard["fault_fired"] = dict(inj.fired) if inj else {}
+        if dump_dir:
+            result.scorecard["artifact"] = scorecard_mod.dump(
+                result.scorecard, dump_dir, name=scn.name)
+        return result
+    finally:
+        engine.close()

@@ -1,0 +1,406 @@
+"""gie-storm workload-shape primitives (docs/STORM.md).
+
+A *shape* is one production traffic pattern, expressed as three
+composable contributions:
+
+  rate(t)            a multiplicative arrival-rate factor (diurnal ramp,
+                     flash crowd) — factors from every shape in a
+                     program MULTIPLY, so "diurnal valley x flash crowd"
+                     means exactly that.
+  decorate(a, rng, t) per-arrival attribute assignment (LoRA adapter
+                     churn, long-context mix) — decorators CHAIN in the
+                     order shapes are listed.
+  control_events()   timed control-plane actions (rolling upgrade drain/
+                     replace steps, a standby failover check) — events
+                     from every shape UNION into one sorted timeline.
+
+A :class:`Program` composes shapes over a :class:`TrafficConfig` and
+compiles them into a :class:`Schedule`: the full arrival list plus the
+control-event timeline. Compilation is SEEDED AND SINGLE-STREAM — one
+``numpy`` generator, drawn in a fixed order — so the same (program,
+seed) produces a bit-identical schedule on every machine, which is the
+replay contract the storm suite asserts (``Schedule.fingerprint``).
+The engine (storm/engine.py) then executes a schedule against the real
+stack; determinism of the *schedule* is the pinned property (execution
+interleaving is real threads against real subsystems, by design).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+from typing import Optional
+
+import numpy as np
+
+BANDS = ("critical", "standard", "sheddable")
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One request the storm will inject."""
+
+    t: float                  # storm seconds from run start
+    session: int              # shared-system-prompt session id
+    prompt_bytes: int
+    decode_tokens: float      # TRUE generated length (engine-side secret)
+    band: str = "standard"    # criticality band (objective header)
+    lora: Optional[str] = None
+    kind: str = "chat"        # "chat" | "long_context"
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlEvent:
+    """One timed control-plane action the engine interprets."""
+
+    t: float
+    kind: str                 # "drain" | "replace" | "failover_check"
+    args: tuple = ()          # hashable payload (pod index, ...)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficConfig:
+    """The base workload the shapes modulate."""
+
+    base_qps: float = 40.0
+    duration_s: float = 10.0
+    n_sessions: int = 16
+    system_prompt_bytes: int = 1024
+    user_suffix_bytes: int = 96
+    decode_tokens_mean: float = 24.0
+    sheddable_fraction: float = 0.25
+    critical_fraction: float = 0.05
+    dt: float = 0.05          # arrival-bin width for the Poisson draw
+
+    def __post_init__(self):
+        if self.base_qps < 0 or self.duration_s <= 0 or self.dt <= 0:
+            raise ValueError("traffic rates/durations must be positive")
+        if not (0 <= self.sheddable_fraction + self.critical_fraction <= 1):
+            raise ValueError("band fractions must sum within [0, 1]")
+
+
+class Shape:
+    """Base shape: identity rate, no decoration, no events."""
+
+    def rate(self, t: float) -> float:
+        return 1.0
+
+    def decorate(self, a: dict, rng: np.random.Generator, t: float) -> None:
+        pass
+
+    def control_events(self, duration_s: float) -> list[ControlEvent]:
+        return []
+
+
+class ConstantRate(Shape):
+    """Flat rate scaling — the unit of the composition algebra's
+    multiplication (useful in tests and sweeps)."""
+
+    def __init__(self, factor: float):
+        if factor < 0:
+            raise ValueError("rate factor must be >= 0")
+        self.factor = factor
+
+    def rate(self, t: float) -> float:
+        return self.factor
+
+
+class DiurnalRamp(Shape):
+    """Smooth day-shaped load: floor at the valley, peak mid-period.
+    ``rate = floor + (peak - floor) * (1 - cos(2*pi*(t+phase)/period))/2``.
+    """
+
+    def __init__(self, period_s: float = 20.0, floor: float = 0.3,
+                 peak: float = 1.0, phase_s: float = 0.0):
+        if period_s <= 0 or floor < 0 or peak < floor:
+            raise ValueError("need period > 0 and 0 <= floor <= peak")
+        self.period_s = period_s
+        self.floor = floor
+        self.peak = peak
+        self.phase_s = phase_s
+
+    def rate(self, t: float) -> float:
+        x = (1.0 - math.cos(
+            2.0 * math.pi * (t + self.phase_s) / self.period_s)) / 2.0
+        return self.floor + (self.peak - self.floor) * x
+
+
+class FlashCrowd(Shape):
+    """A traffic spike: ramp to ``magnitude`` over ``ramp_s``, hold for
+    ``hold_s``, decay back over ``decay_s``. Multiplies whatever the
+    other shapes say the rate is (a flash crowd during a diurnal valley
+    is magnitude x valley)."""
+
+    def __init__(self, at_s: float = 2.0, ramp_s: float = 1.0,
+                 hold_s: float = 3.0, magnitude: float = 3.0,
+                 decay_s: Optional[float] = None):
+        if magnitude < 1.0 or ramp_s < 0 or hold_s < 0:
+            raise ValueError("flash crowd needs magnitude >= 1")
+        self.at_s = at_s
+        self.ramp_s = ramp_s
+        self.hold_s = hold_s
+        self.magnitude = magnitude
+        self.decay_s = ramp_s if decay_s is None else decay_s
+
+    def rate(self, t: float) -> float:
+        dt = t - self.at_s
+        if dt < 0:
+            return 1.0
+        if dt < self.ramp_s:
+            return 1.0 + (self.magnitude - 1.0) * (dt / self.ramp_s)
+        dt -= self.ramp_s
+        if dt < self.hold_s:
+            return self.magnitude
+        dt -= self.hold_s
+        if self.decay_s > 0 and dt < self.decay_s:
+            return self.magnitude - (self.magnitude - 1.0) * (
+                dt / self.decay_s)
+        return 1.0
+
+    def window(self) -> tuple[float, float]:
+        """(start, end) of the elevated-rate window (ramp..decay)."""
+        return (self.at_s,
+                self.at_s + self.ramp_s + self.hold_s + self.decay_s)
+
+
+class LoraChurn(Shape):
+    """Multi-tenant LoRA adapter churn: a HOT set of ``hot`` adapters
+    (out of ``adapters`` total) receives the adapter traffic; the hot
+    window rotates every ``rotate_every_s`` so residency churns — the
+    cold-load penalty and max_lora queueing the stubs model are what
+    this shape is aimed at."""
+
+    def __init__(self, adapters: int = 8, hot: int = 2,
+                 rotate_every_s: float = 4.0, p: float = 0.7):
+        if adapters < 1 or not (1 <= hot <= adapters) or not (0 <= p <= 1):
+            raise ValueError("need adapters >= hot >= 1 and p in [0, 1]")
+        self.adapters = adapters
+        self.hot = hot
+        self.rotate_every_s = rotate_every_s
+        self.p = p
+
+    def hot_set(self, t: float) -> list[str]:
+        w = int(t // self.rotate_every_s)
+        return [f"adapter-{(w * self.hot + i) % self.adapters}"
+                for i in range(self.hot)]
+
+    def decorate(self, a: dict, rng: np.random.Generator, t: float) -> None:
+        # Fixed two draws per arrival regardless of outcome, so a churn
+        # parameter change cannot shift every later draw in the stream.
+        u = rng.random()
+        pick = int(rng.integers(self.hot))
+        if u < self.p:
+            a["lora"] = self.hot_set(t)[pick]
+
+
+class LongContextMix(Shape):
+    """A long-context / pd-disaggregated-style slice: ``fraction`` of
+    arrivals carry a long prompt (prefill-heavy) and a scaled decode
+    (decode-heavy tail) — the mix that separates prefill and decode
+    pressure the way a pd-disaggregated pool would see it."""
+
+    def __init__(self, fraction: float = 0.15, prompt_bytes: int = 8192,
+                 decode_scale: float = 2.0):
+        if not (0 <= fraction <= 1) or prompt_bytes < 1:
+            raise ValueError("need fraction in [0, 1], prompt_bytes >= 1")
+        self.fraction = fraction
+        self.prompt_bytes = prompt_bytes
+        self.decode_scale = decode_scale
+
+    def decorate(self, a: dict, rng: np.random.Generator, t: float) -> None:
+        if rng.random() < self.fraction:
+            a["kind"] = "long_context"
+            a["prompt_bytes"] = self.prompt_bytes
+            a["decode_tokens"] = a["decode_tokens"] * self.decode_scale
+
+
+class RollingUpgrade(Shape):
+    """Sequential drain/replace of every pod under traffic: pod ``i``
+    is DRAINED at ``start_s + i*interval_s`` and REPLACED ``settle_s``
+    later (the settle window is what lets in-flight streams finish on
+    the old pod). Pure control-plane shape — rate 1.0."""
+
+    def __init__(self, start_s: float = 3.0, pods: int = 4,
+                 interval_s: float = 1.5, settle_s: float = 1.0):
+        if pods < 1 or interval_s <= 0 or settle_s < 0:
+            raise ValueError("need pods >= 1 and positive intervals")
+        if settle_s >= interval_s:
+            # Two pods draining at once halves the pool mid-upgrade; the
+            # shape models the one-at-a-time rollout a Deployment does.
+            raise ValueError("settle_s must be < interval_s")
+        self.start_s = start_s
+        self.pods = pods
+        self.interval_s = interval_s
+        self.settle_s = settle_s
+
+    def control_events(self, duration_s: float) -> list[ControlEvent]:
+        out = []
+        for i in range(self.pods):
+            t0 = self.start_s + i * self.interval_s
+            if t0 + self.settle_s >= duration_s:
+                break  # an upgrade step the run cannot finish is skipped
+            out.append(ControlEvent(t0, "drain", (i,)))
+            out.append(ControlEvent(t0 + self.settle_s, "replace", (i,)))
+        return out
+
+    def end_s(self) -> float:
+        return self.start_s + (self.pods - 1) * self.interval_s \
+            + self.settle_s
+
+
+class StandbyFailover(Shape):
+    """Warm-standby sync checkpoints: at each event the engine publishes
+    the live scheduler's replication digest and has a follower fetch +
+    decode it (the failover-readiness probe of docs/REPLICATION.md) —
+    proving the standby would promote WARM at that instant of the
+    storm."""
+
+    def __init__(self, every_s: float = 2.0, start_s: float = 1.0):
+        if every_s <= 0:
+            raise ValueError("every_s must be > 0")
+        self.every_s = every_s
+        self.start_s = start_s
+
+    def control_events(self, duration_s: float) -> list[ControlEvent]:
+        out = []
+        t = self.start_s
+        while t < duration_s:
+            out.append(ControlEvent(t, "failover_check", ()))
+            t += self.every_s
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """A compiled storm: the deterministic artifact the engine replays."""
+
+    arrivals: tuple
+    events: tuple
+    seed: int
+    traffic: TrafficConfig
+
+    def fingerprint(self) -> str:
+        """Stable digest of the full schedule — two compiles of the same
+        (program, seed) must agree bit-for-bit (the determinism claim
+        tests/test_storm.py pins)."""
+        h = hashlib.sha256()
+        for a in self.arrivals:
+            h.update(repr(dataclasses.astuple(a)).encode())
+        for e in self.events:
+            h.update(repr(dataclasses.astuple(e)).encode())
+        return h.hexdigest()
+
+
+class Program:
+    """Shapes composed over a base traffic config. ``compile()`` is the
+    only place randomness happens; everything downstream replays the
+    compiled schedule."""
+
+    def __init__(self, traffic: TrafficConfig, shapes: list[Shape],
+                 seed: int = 0):
+        self.traffic = traffic
+        self.shapes = list(shapes)
+        self.seed = seed
+
+    def rate(self, t: float) -> float:
+        r = 1.0
+        for s in self.shapes:
+            r *= s.rate(t)
+        return r
+
+    def compile(self) -> Schedule:
+        tc = self.traffic
+        rng = np.random.default_rng(self.seed)
+        arrivals: list[Arrival] = []
+        t = 0.0
+        while t < tc.duration_s:
+            lam = tc.base_qps * self.rate(t) * tc.dt
+            n = int(rng.poisson(lam)) if lam > 0 else 0
+            for _ in range(n):
+                # Fixed draw order per arrival — the determinism contract.
+                off = float(rng.random()) * tc.dt
+                session = int(rng.integers(tc.n_sessions))
+                decode = float(max(rng.exponential(
+                    tc.decode_tokens_mean), 4.0))
+                ub = float(rng.random())
+                band = ("sheddable" if ub < tc.sheddable_fraction
+                        else "critical"
+                        if ub < tc.sheddable_fraction + tc.critical_fraction
+                        else "standard")
+                a = {
+                    "t": round(t + off, 6),
+                    "session": session,
+                    "prompt_bytes": tc.system_prompt_bytes
+                    + tc.user_suffix_bytes,
+                    "decode_tokens": decode,
+                    "band": band,
+                    "lora": None,
+                    "kind": "chat",
+                }
+                for shape in self.shapes:
+                    shape.decorate(a, rng, t)
+                arrivals.append(Arrival(**a))
+            t = round(t + tc.dt, 9)
+        events: list[ControlEvent] = []
+        for shape in self.shapes:
+            events.extend(shape.control_events(tc.duration_s))
+        events.sort(key=lambda e: (e.t, e.kind, e.args))
+        return Schedule(arrivals=tuple(arrivals), events=tuple(events),
+                        seed=self.seed, traffic=tc)
+
+
+# -- JSON drive-section interpretation (resilience/scenarios.py) ----------
+
+SHAPE_KINDS = {
+    "constant": ConstantRate,
+    "diurnal": DiurnalRamp,
+    "flash_crowd": FlashCrowd,
+    "lora_churn": LoraChurn,
+    "long_context": LongContextMix,
+    "rolling_upgrade": RollingUpgrade,
+    "standby_failover": StandbyFailover,
+}
+
+
+def shapes_from_specs(specs: list[dict]) -> list[Shape]:
+    """Shape list from a scenario file's ``drive.storm.shapes`` section:
+    each entry is ``{"kind": <SHAPE_KINDS name>, ...constructor kwargs}``.
+    Unknown kinds and kwargs are rejected loudly — a scenario file that
+    silently dropped a shape would replay a different storm than it
+    records."""
+    out: list[Shape] = []
+    for spec in specs:
+        if not isinstance(spec, dict) or "kind" not in spec:
+            raise ValueError(
+                f"storm shape spec must be an object with 'kind': {spec!r}")
+        kind = spec["kind"]
+        cls = SHAPE_KINDS.get(kind)
+        if cls is None:
+            raise ValueError(
+                f"unknown storm shape kind {kind!r}; known: "
+                f"{sorted(SHAPE_KINDS)}")
+        kwargs = {k: v for k, v in spec.items() if k != "kind"}
+        try:
+            out.append(cls(**kwargs))
+        except TypeError as e:
+            raise ValueError(f"bad kwargs for shape {kind!r}: {e}") from None
+    return out
+
+
+def program_from_drive(storm: dict, seed: int) -> Program:
+    """``drive.storm`` section -> Program. The section's ``traffic``
+    object maps onto TrafficConfig fields; ``base_qps``/``duration_s``
+    may also sit at the top level for readability."""
+    traffic_kw = dict(storm.get("traffic") or {})
+    for k in ("base_qps", "duration_s"):
+        if k in storm:
+            traffic_kw[k] = storm[k]
+    unknown = set(traffic_kw) - {
+        f.name for f in dataclasses.fields(TrafficConfig)}
+    if unknown:
+        raise ValueError(
+            f"unknown storm traffic fields {sorted(unknown)}")
+    tc = TrafficConfig(**traffic_kw)
+    return Program(tc, shapes_from_specs(storm.get("shapes") or []),
+                   seed=seed)

@@ -1,0 +1,90 @@
+"""CACHED-rung kv-weight calibration sweep (docs/RESILIENCE.md "ladder
+calibration"; ISSUE 10 satellite).
+
+The degraded CACHED pick ranks endpoints by ``queue + w * kv_util``.
+This sweep pins the ladder at CACHED (DegradationLadder.force_level)
+and runs the same seeded flash-crowd storm through the REAL stack for
+each candidate weight, scoring goodput / SLO attainment / TTFT p99 —
+the rung's OWN performance, isolated from transition dynamics. The
+resulting table is recorded in docs/RESILIENCE.md and sets the
+``--ladder-cached-kv-weight`` default.
+
+    JAX_PLATFORMS=cpu python hack/storm_sweep.py [--weights 0,2,8,32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--weights", default="0,2,4,8,16,32",
+                        help="comma-separated cached_kv_weight candidates")
+    parser.add_argument("--seed", type=int, default=626262)
+    parser.add_argument("--duration-s", type=float, default=8.0)
+    parser.add_argument("--out", default=None,
+                        help="optional JSON artifact path")
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update(
+        "jax_platforms", os.environ.get("GIE_STORM_PLATFORM", "cpu"))
+
+    from gie_tpu.resilience.ladder import LadderConfig, Rung
+    from gie_tpu.storm import shapes as S
+    from gie_tpu.storm.engine import EngineConfig, PoolSpec, StormEngine
+
+    rows = []
+    for w in [float(x) for x in args.weights.split(",")]:
+        tc = S.TrafficConfig(base_qps=36.0, duration_s=args.duration_s,
+                             n_sessions=16, decode_tokens_mean=20.0)
+        prog = S.Program(tc, [
+            S.FlashCrowd(at_s=1.5, ramp_s=0.8, hold_s=3.0, magnitude=3.0),
+        ], seed=args.seed)
+        # Prohibitive recovery thresholds + force_level pin the rung so
+        # the sweep measures the CACHED policy, not the ladder dynamics.
+        ladder = LadderConfig(
+            dispatch_error_streak=10_000, recover_streak=10_000,
+            min_dwell_s=1e9, probe_interval_s=1e9,
+            serve_min_samples=10_000, cached_kv_weight=w)
+        eng = StormEngine(
+            prog, pool=PoolSpec(n_pods=6),
+            cfg=EngineConfig(ttft_slo_s=2.5, ladder=ladder,
+                             force_rung=int(Rung.CACHED)),
+            name=f"cached-w{w:g}")
+        try:
+            card = eng.run().scorecard
+        finally:
+            eng.close()
+        row = {
+            "cached_kv_weight": w,
+            "goodput_tokens_per_s": round(card["goodput_tokens_per_s"], 1),
+            "slo_attainment": round(card["slo_attainment"], 3),
+            "ttft_p50_s": round(card["ttft_p50_s"], 3),
+            "ttft_p99_s": round(card["ttft_p99_s"], 3),
+            "completed": card["completed"],
+            "shed": card["shed"],
+            "client_5xx": card["client_5xx"],
+        }
+        rows.append(row)
+        print(f"w={w:5g}  goodput={row['goodput_tokens_per_s']:8.1f} tok/s"
+              f"  slo={row['slo_attainment']:.3f}"
+              f"  p99={row['ttft_p99_s']:.3f}s"
+              f"  completed={row['completed']}", file=sys.stderr)
+    artifact = {"sweep": "ladder-cached-kv-weight", "seed": args.seed,
+                "scenario": "flash-crowd x3 @36qps, 6 pods, forced CACHED",
+                "rows": rows}
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(artifact, fh, indent=1)
+    print(json.dumps(artifact))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

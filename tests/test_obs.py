@@ -443,6 +443,63 @@ def test_debugz_server_zpages_and_metrics():
         srv.close()
 
 
+def test_debugz_bind_hardening_loopback_only_by_default():
+    """ISSUE 10 satellite (docs/OBSERVABILITY.md "bind hardening"): the
+    /debugz zpages answer loopback peers only unless --debugz-bind names
+    a non-loopback address; /metrics is unaffected either way."""
+    srv = DebugzServer(0, own_metrics.REGISTRY,
+                       {"ping": lambda q: {"ok": True}}, bind="127.0.0.1")
+    try:
+        # Default: loopback-only. The peer-gate predicate is the unit
+        # under test (a non-loopback client cannot be faked over lo).
+        assert srv._debugz_allowed("127.0.0.1")
+        assert srv._debugz_allowed("::1")
+        assert not srv._debugz_allowed("10.0.0.5")
+        assert not srv._debugz_allowed("192.168.1.9")
+        assert not srv._debugz_allowed("not-an-ip")   # closed by default
+        # Integration: a loopback client reads zpages AND metrics.
+        assert _get(srv.port, "/debugz/ping")[0] == 200
+        assert _get(srv.port, "/metrics")[0] == 200
+    finally:
+        srv.close()
+    # Explicit opt-out: a non-loopback --debugz-bind opens the gate.
+    opened = DebugzServer(0, own_metrics.REGISTRY, {},
+                          bind="127.0.0.1", debugz_bind="0.0.0.0")
+    try:
+        assert opened._debugz_allowed("10.0.0.5")
+        assert opened._debugz_allowed("127.0.0.1")
+    finally:
+        opened.close()
+    # Loopback ALIASES and unparsable values stay gated — only an
+    # explicit non-loopback ADDRESS opts out (a typo must not silently
+    # disable the hardening).
+    for bind in ("127.0.0.2", " 127.0.0.1 ", "Localhost", "wat"):
+        aliased = DebugzServer(0, own_metrics.REGISTRY, {},
+                               bind="127.0.0.1", debugz_bind=bind)
+        try:
+            assert not aliased._debugz_allowed("10.0.0.5"), bind
+        finally:
+            aliased.close()
+
+
+def test_debugz_gate_returns_403_not_404(monkeypatch):
+    """A blocked peer gets 403 on every /debugz path — including ones
+    that exist — so the gate does not leak the zpage catalog shape."""
+    srv = DebugzServer(0, own_metrics.REGISTRY,
+                       {"ping": lambda q: {"ok": True}}, bind="127.0.0.1")
+    try:
+        monkeypatch.setattr(
+            srv, "_debugz_allowed", lambda peer: False)
+        for path in ("/debugz", "/debugz/ping", "/debugz/nope"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.port, path)
+            assert ei.value.code == 403
+        # The exposition stays reachable for Prometheus regardless.
+        assert _get(srv.port, "/metrics")[0] == 200
+    finally:
+        srv.close()
+
+
 def test_admission_exemplar_links_bucket_to_trace():
     tracer = Tracer(1.0, slow_s=10.0)
     obs.install(tracer=tracer)

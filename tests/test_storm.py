@@ -1,0 +1,598 @@
+"""gie-storm test suite (ISSUE 10, docs/STORM.md).
+
+Three tiers:
+
+  shapes     pure schedule compilation — bit-identical-per-seed arrival
+             schedules, the composition algebra (rates multiply,
+             decorators chain, control events union), the JSON drive-
+             section interpreter.
+  outlier    p99 serve-latency outlier ejection — deterministic-clock
+             hysteresis unit tests, then a storm run proving a
+             consistently-slow endpoint quarantines while a merely-
+             loaded one never flaps.
+  engine     the composed acceptance storm (flash crowd x rolling
+             upgrade x LoRA churn over a device-dispatch chaos burst)
+             driven through the REAL stack once per module and asserted
+             from its scorecard: zero client-visible 5xx, ladder down-
+             and-recovered, sheddable 429s at the peak, goodput/SLO
+             scored, artifact written, schedule fingerprint stable.
+
+The slow-marked soak replays storm-soak (diurnal + crowd + upgrade +
+autoscale + standby failover probes + mixed chaos) — `make storm-smoke`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from gie_tpu.resilience import faults
+from gie_tpu.resilience.breaker import (
+    SERVE,
+    BreakerBoard,
+    BreakerConfig,
+    BreakerState,
+)
+from gie_tpu.resilience.outlier import OutlierConfig, OutlierEjector
+from gie_tpu.storm import shapes as S
+from gie_tpu.storm import scorecard as SC
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# ==========================================================================
+# Shapes: schedule determinism + composition algebra
+# ==========================================================================
+
+
+def _program(seed=7, **traffic):
+    tc = S.TrafficConfig(base_qps=40.0, duration_s=6.0, **traffic)
+    return S.Program(tc, [
+        S.FlashCrowd(at_s=2.0, ramp_s=0.5, hold_s=1.5, magnitude=3.0),
+        S.LoraChurn(adapters=6, hot=2, rotate_every_s=2.0, p=0.8),
+        S.LongContextMix(fraction=0.2, prompt_bytes=4096),
+        S.RollingUpgrade(start_s=1.0, pods=4, interval_s=1.0, settle_s=0.5),
+    ], seed=seed)
+
+
+def test_same_seed_bit_identical_schedule():
+    s1, s2 = _program(seed=7).compile(), _program(seed=7).compile()
+    assert s1.arrivals == s2.arrivals
+    assert s1.events == s2.events
+    assert s1.fingerprint() == s2.fingerprint()
+
+
+def test_different_seed_different_schedule():
+    s1, s2 = _program(seed=7).compile(), _program(seed=8).compile()
+    assert s1.fingerprint() != s2.fingerprint()
+
+
+def test_rate_composition_multiplies():
+    tc = S.TrafficConfig(base_qps=30.0, duration_s=4.0)
+    base = S.Program(tc, [], seed=5).compile()
+    tripled = S.Program(tc, [S.ConstantRate(3.0)], seed=5).compile()
+    ratio = len(tripled.arrivals) / max(len(base.arrivals), 1)
+    assert 2.5 < ratio < 3.5
+    # Two stacked factors multiply (3 * 2 = 6x).
+    six = S.Program(
+        tc, [S.ConstantRate(3.0), S.ConstantRate(2.0)], seed=5).compile()
+    # Wide bounds: the deterministic Poisson draw still carries sampling
+    # variance relative to the base program's own draw.
+    assert 4.5 < len(six.arrivals) / max(len(base.arrivals), 1) < 7.5
+
+
+def test_flash_crowd_elevates_its_window_only():
+    crowd = S.FlashCrowd(at_s=2.0, ramp_s=0.5, hold_s=1.5, magnitude=4.0)
+    assert crowd.rate(0.0) == 1.0
+    assert crowd.rate(2.25) == pytest.approx(2.5)   # mid-ramp
+    assert crowd.rate(3.0) == 4.0                   # hold
+    assert crowd.rate(10.0) == 1.0                  # passed
+    tc = S.TrafficConfig(base_qps=40.0, duration_s=6.0)
+    sched = S.Program(tc, [crowd], seed=3).compile()
+    lo, hi = crowd.window()
+    inside = sum(1 for a in sched.arrivals if lo <= a.t < hi)
+    per_s_in = inside / (hi - lo)
+    outside = len(sched.arrivals) - inside
+    per_s_out = outside / (tc.duration_s - (hi - lo))
+    assert per_s_in > 2.0 * per_s_out
+
+
+def test_diurnal_ramp_floor_and_peak():
+    d = S.DiurnalRamp(period_s=10.0, floor=0.25, peak=1.0)
+    assert d.rate(0.0) == pytest.approx(0.25)    # valley
+    assert d.rate(5.0) == pytest.approx(1.0)     # mid-period peak
+    assert d.rate(10.0) == pytest.approx(0.25)   # next valley
+
+
+def test_lora_churn_hot_set_rotates_and_bounds_adapters():
+    churn = S.LoraChurn(adapters=6, hot=2, rotate_every_s=2.0, p=1.0)
+    assert churn.hot_set(0.0) != churn.hot_set(2.0)
+    sched = S.Program(
+        S.TrafficConfig(base_qps=40.0, duration_s=6.0),
+        [churn], seed=9).compile()
+    with_lora = [a for a in sched.arrivals if a.lora is not None]
+    assert with_lora, "p=1.0 churn produced no adapter traffic"
+    for a in with_lora:
+        assert a.lora in churn.hot_set(
+            (a.t // churn.rotate_every_s) * churn.rotate_every_s)
+
+
+def test_long_context_mix_fraction_and_attributes():
+    mix = S.LongContextMix(fraction=0.25, prompt_bytes=8192,
+                           decode_scale=2.0)
+    sched = S.Program(
+        S.TrafficConfig(base_qps=60.0, duration_s=5.0),
+        [mix], seed=4).compile()
+    long = [a for a in sched.arrivals if a.kind == "long_context"]
+    frac = len(long) / len(sched.arrivals)
+    assert 0.15 < frac < 0.35
+    assert all(a.prompt_bytes == 8192 for a in long)
+
+
+def test_rolling_upgrade_events_pair_and_order():
+    up = S.RollingUpgrade(start_s=1.0, pods=3, interval_s=1.0,
+                          settle_s=0.4)
+    events = up.control_events(duration_s=10.0)
+    assert [(e.kind, e.args[0]) for e in events] == [
+        ("drain", 0), ("replace", 0), ("drain", 1), ("replace", 1),
+        ("drain", 2), ("replace", 2)]
+    # A step the run cannot finish is skipped, never half-applied.
+    short = up.control_events(duration_s=2.3)
+    assert [(e.kind, e.args[0]) for e in short] == [
+        ("drain", 0), ("replace", 0)]
+    with pytest.raises(ValueError, match="settle_s"):
+        S.RollingUpgrade(interval_s=1.0, settle_s=1.0)
+
+
+def test_control_events_union_sorted_across_shapes():
+    tc = S.TrafficConfig(base_qps=10.0, duration_s=8.0)
+    sched = S.Program(tc, [
+        S.RollingUpgrade(start_s=1.0, pods=2, interval_s=2.0, settle_s=1.0),
+        S.StandbyFailover(every_s=3.0, start_s=0.5),
+    ], seed=1).compile()
+    kinds = {e.kind for e in sched.events}
+    assert kinds == {"drain", "replace", "failover_check"}
+    assert [e.t for e in sched.events] == sorted(e.t for e in sched.events)
+
+
+def test_shapes_from_specs_registry():
+    built = S.shapes_from_specs([
+        {"kind": "flash_crowd", "at_s": 1.0, "magnitude": 2.0},
+        {"kind": "lora_churn", "adapters": 4},
+    ])
+    assert isinstance(built[0], S.FlashCrowd)
+    assert isinstance(built[1], S.LoraChurn)
+    with pytest.raises(ValueError, match="unknown storm shape"):
+        S.shapes_from_specs([{"kind": "nope"}])
+    with pytest.raises(ValueError, match="bad kwargs"):
+        S.shapes_from_specs([{"kind": "flash_crowd", "wat": 1}])
+    with pytest.raises(ValueError, match="kind"):
+        S.shapes_from_specs(["flash_crowd"])
+
+
+def test_program_from_drive_rejects_unknown_traffic_fields():
+    with pytest.raises(ValueError, match="unknown storm traffic"):
+        S.program_from_drive(
+            {"base_qps": 10, "duration_s": 2,
+             "traffic": {"qqps": 1}}, seed=0)
+
+
+# ==========================================================================
+# Outlier ejection: deterministic-clock hysteresis units
+# ==========================================================================
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _ejector(clock, **kw):
+    cfg = dict(window_s=8.0, quantile=0.9, ratio=3.0, min_samples=4,
+               pool_min_samples=12, breach_streak=2, eval_interval_s=1.0,
+               cooldown_s=5.0, max_eject_fraction=0.34, floor_s=0.001)
+    cfg.update(kw)
+    return OutlierEjector(OutlierConfig(**cfg), clock=clock)
+
+
+def _feed(ej, clock, latencies_by_slot, n=4):
+    for _ in range(n):
+        for slot, lat in latencies_by_slot.items():
+            ej.note(slot, lat)
+
+
+def test_outlier_ejects_sustained_slow_endpoint_on_serve_plane():
+    clock = _Clock()
+    ej = _ejector(clock)
+    board = BreakerBoard(BreakerConfig(open_s=30.0), clock=clock)
+    pool = {0: 0.05, 1: 0.06, 2: 0.04, 3: 1.0}
+    _feed(ej, clock, pool)
+    assert ej.evaluate(board) == []          # streak 1: no ejection yet
+    clock.t += 1.0
+    _feed(ej, clock, pool)
+    assert ej.evaluate(board) == [3]         # streak 2: ejected
+    assert board.state(3) == BreakerState.OPEN
+    assert board.report()["breakers"]["3"]["opened_by"] == SERVE
+    assert ej.ejections and ej.ejections[0][1] == 3
+
+
+def test_outlier_single_spike_does_not_eject():
+    # Short window so the spike AGES OUT between evals — a breach must
+    # be sustained across consecutive evals to eject, and one spike
+    # followed by recovery resets the streak.
+    clock = _Clock()
+    ej = _ejector(clock, window_s=2.0)
+    board = BreakerBoard(clock=clock)
+    _feed(ej, clock, {0: 0.05, 1: 0.06, 2: 0.04, 3: 1.0})
+    assert ej.evaluate(board) == []          # breach eval #1 (streak 1)
+    clock.t += 2.5                           # spike leaves the window
+    _feed(ej, clock, {0: 0.05, 1: 0.06, 2: 0.04, 3: 0.05})  # recovered
+    assert ej.evaluate(board) == []          # streak reset, not ejected
+    clock.t += 2.5
+    _feed(ej, clock, {0: 0.05, 1: 0.06, 2: 0.04, 3: 1.0})
+    assert ej.evaluate(board) == []          # a fresh streak starts at 1
+    assert board.state(3) == BreakerState.CLOSED
+
+
+def test_outlier_pool_wide_slowdown_ejects_nobody():
+    clock = _Clock()
+    ej = _ejector(clock)
+    board = BreakerBoard(clock=clock)
+    slow_everywhere = {0: 2.0, 1: 2.2, 2: 1.8, 3: 2.1}
+    for _ in range(4):
+        _feed(ej, clock, slow_everywhere)
+        assert ej.evaluate(board) == []
+        clock.t += 1.0
+    assert not board.has_open
+
+
+def test_outlier_eject_budget_never_empties_the_pool():
+    clock = _Clock()
+    # Two of three endpoints "slow": the 1/3 budget ejects at most one.
+    ej = _ejector(clock, max_eject_fraction=0.34, ratio=2.0)
+    board = BreakerBoard(BreakerConfig(open_s=60.0), clock=clock)
+    pool = {0: 0.05, 1: 5.0, 2: 5.0}
+    for _ in range(4):
+        _feed(ej, clock, pool, n=6)
+        ej.evaluate(board)
+        clock.t += 1.0
+    assert board.open_count() <= 1
+
+
+def test_outlier_cooldown_bounds_reejection_cadence():
+    clock = _Clock()
+    ej = _ejector(clock, cooldown_s=100.0)
+    board = BreakerBoard(BreakerConfig(open_s=0.5, close_after=1),
+                         clock=clock)
+    pool = {0: 0.05, 1: 0.06, 2: 0.04, 3: 1.0}
+    for _ in range(3):
+        _feed(ej, clock, pool)
+        ej.evaluate(board)
+        clock.t += 1.0
+    assert len(ej.ejections) == 1
+    # The breaker heals (serve-opened probe path)...
+    clock.t += 1.0
+    board.quarantined(3)                     # dwell elapsed: HALF_OPEN
+    board.record_serve_outcome(3, ok=True)
+    assert board.state(3) == BreakerState.CLOSED
+    # ...and keeps breaching, but the cooldown refuses a re-eject storm.
+    for _ in range(4):
+        _feed(ej, clock, pool)
+        ej.evaluate(board)
+        clock.t += 1.0
+    assert len(ej.ejections) == 1
+
+
+def test_outlier_drop_clears_slot_state():
+    clock = _Clock()
+    ej = _ejector(clock)
+    _feed(ej, clock, {0: 0.05, 1: 1.0})
+    ej.drop(1)
+    assert 1 not in ej.report()["tracked"]
+    assert ej.report()["streaks"] == {}
+
+
+# ==========================================================================
+# Flight-recorder schema version (ISSUE 10 satellite; gie_tpu/obs)
+# ==========================================================================
+
+
+def test_flight_recorder_stamps_schema_version():
+    from gie_tpu.obs.recorder import SCHEMA_VERSION, FlightRecorder
+
+    rec = FlightRecorder(8)
+    published = rec.append({"model": "m", "outcome": "picked"})
+    assert published["v"] == SCHEMA_VERSION
+    assert all(r["v"] == SCHEMA_VERSION for r in rec.snapshot())
+
+
+def test_flight_recorder_load_is_tolerant():
+    from gie_tpu.obs.recorder import SCHEMA_VERSION, load_records
+
+    dump = json.dumps([
+        {"v": SCHEMA_VERSION, "seq": 0, "model": "m"},
+        {"seq": 1, "model": "old"},                    # pre-version dump
+        {"v": SCHEMA_VERSION + 7, "seq": 2, "brand_new_field": [1, 2]},
+        "junk-entry",                                  # tolerated, skipped
+    ])
+    recs = load_records(dump)
+    assert [r["seq"] for r in recs] == [0, 1, 2]
+    assert recs[1]["v"] == 0                           # stamped legacy
+    assert recs[2]["brand_new_field"] == [1, 2]        # unknown kept
+    # Envelope form loads identically.
+    assert load_records(json.dumps({"records": [{"seq": 9}]}))[0]["seq"] == 9
+    with pytest.raises(ValueError):
+        load_records(json.dumps("not-a-dump"))
+
+
+# ==========================================================================
+# Engine: the composed acceptance storm (one run, many assertions)
+# ==========================================================================
+
+
+@pytest.fixture(scope="module")
+def composed(tmp_path_factory):
+    """ONE storm-flash-upgrade replay through the real stack (flash
+    crowd x rolling upgrade x LoRA churn x long-context over a bounded
+    device-dispatch chaos burst, autoscale armed), shared by every
+    assertion below — the run is the expensive part, the claims are
+    cheap reads of its scorecard."""
+    from gie_tpu import obs
+    from gie_tpu.obs.recorder import FlightRecorder
+    from gie_tpu.storm.engine import run_scenario
+
+    faults.uninstall()
+    obs.install(recorder=FlightRecorder(4096))
+    dump_dir = str(tmp_path_factory.mktemp("storm"))
+    try:
+        result = run_scenario("storm-flash-upgrade", dump_dir=dump_dir)
+        records = obs.RECORDER.snapshot()
+    finally:
+        obs.uninstall()
+        faults.uninstall()
+    return result, records
+
+
+def test_composed_zero_client_visible_5xx(composed):
+    """The ISSUE 10 acceptance core: a full rolling upgrade under
+    continuous flash-crowd traffic with chaos layered on top — and not
+    one client-visible 5xx, reset, or wedged stream."""
+    card = composed[0].scorecard
+    assert card["client_5xx"] == 0, card["client_5xx_detail"]
+    assert card["resets"] == 0
+    assert card["timeouts"] == 0
+    assert card["ok"] > 300, "the storm barely served"
+
+
+def test_composed_upgrade_replaced_every_pod(composed):
+    card = composed[0].scorecard
+    steps = [(u["step"], u["pod"]) for u in card["upgrades"]]
+    assert steps.count(("drain", f"p{0}")) == 1
+    assert sum(1 for s, _ in steps if s == "drain") == 6
+    assert sum(1 for s, _ in steps if s == "replace") == 6
+    # Every original 10.77.* endpoint is gone; replacements serve.
+    assert card["final_endpoints"]
+    assert not [hp for hp in card["final_endpoints"]
+                if hp.startswith("10.77.")]
+    # Traffic genuinely reached replacement pods after the upgrade.
+    assert composed[0].datastore.endpoints()
+
+
+def test_composed_ladder_descends_and_recovers(composed):
+    """The device-dispatch chaos burst must push the ladder off FULL
+    mid-storm, and hysteretic ascent must bring it home after."""
+    card = composed[0].scorecard
+    assert card["fault_fired"].get("device.dispatch", 0) >= 1
+    assert card["max_rung"] >= 1, "the chaos burst never degraded picks"
+    assert card["final_rung"] == 0, "the ladder never recovered to FULL"
+    rungs = [r for _, r in card["rung_trace"]]
+    assert rungs[-1] == 0 and max(rungs) >= 1
+
+
+def test_composed_goodput_and_slo_scored(composed):
+    card = composed[0].scorecard
+    assert card["goodput_tokens_per_s"] > 0
+    assert 0.0 < card["slo_attainment"] <= 1.0
+    assert card["completed"] > 300
+    assert card["lora_arrivals"] > 100
+    assert card["long_context_arrivals"] > 20
+
+
+def test_composed_scorecard_schema_and_artifact(composed):
+    card = composed[0].scorecard
+    SC.validate(card)
+    path = card["artifact"]
+    assert os.path.exists(path)
+    with open(path, "r", encoding="utf-8") as fh:
+        loaded = json.load(fh)
+    assert loaded["schema"] == SC.SCHEMA
+    assert loaded["client_5xx"] == card["client_5xx"]
+
+
+def test_composed_schedule_bit_identical_per_seed(composed):
+    """The replay contract: recompiling the scenario's storm program
+    from the file yields the exact arrival schedule the run executed."""
+    from gie_tpu.resilience import scenarios
+
+    card = composed[0].scorecard
+    scn = scenarios.load("storm-flash-upgrade")
+    prog = S.program_from_drive(scn.drive["storm"], seed=scn.seed)
+    assert prog.compile().fingerprint() == card["schedule_fingerprint"]
+    assert card["seed"] == scn.seed
+
+
+def test_composed_flight_recorder_explains_the_storm(composed):
+    """gie-obs rides along: decision records exist for both the full
+    path and the degraded rungs, all stamped with the schema version."""
+    from gie_tpu.obs.recorder import SCHEMA_VERSION
+
+    _, records = composed
+    assert records, "no decision records published"
+    rungs = {r.get("rung") for r in records}
+    assert "full" in rungs
+    assert rungs - {"full"}, (
+        "no degraded-rung records — the chaos burst left no audit trail")
+    assert all(r.get("v") == SCHEMA_VERSION for r in records)
+
+
+def test_composed_pool_capacity_trace(composed):
+    card = composed[0].scorecard
+    sizes = [n for _, n in card["pool_size_trace"]]
+    assert sizes and max(sizes) >= 6
+    assert sizes[-1] >= 6, "the pool ended the storm smaller than it began"
+
+
+# ==========================================================================
+# Engine: outlier ejection under a storm (the satellite's storm proof)
+# ==========================================================================
+
+
+def test_storm_outlier_ejects_slow_endpoint_not_loaded_one():
+    """A pod serving 2xx at ~20x the pool's first-token latency is
+    quarantined by ejection alone (its breaker never sees an error);
+    a merely-loaded pod (fewer slots, slower decode — latency within
+    the pool's band) is never touched. Hysteresis: ejections are
+    cooldown-bounded, not a flap storm."""
+    from gie_tpu.storm.engine import (
+        DEFAULT_STUB,
+        EngineConfig,
+        PoolSpec,
+        StormEngine,
+    )
+
+    slow = dataclasses.replace(
+        DEFAULT_STUB, prefill_tokens_per_s=300.0, prefix_cache_chunks=1)
+    loaded = dataclasses.replace(
+        DEFAULT_STUB, decode_tokens_per_s=28.0, max_running=6)
+    fleet = [DEFAULT_STUB] * 4 + [slow, loaded]
+    prog = S.Program(
+        S.TrafficConfig(base_qps=30.0, duration_s=8.0, n_sessions=12),
+        [], seed=11)
+    cfg = EngineConfig(ttft_slo_s=3.0, outlier=OutlierConfig(
+        window_s=5.0, quantile=0.95, ratio=2.5, min_samples=10,
+        pool_min_samples=40, breach_streak=2, eval_interval_s=0.5,
+        cooldown_s=3.0))
+    eng = StormEngine(prog, pool=PoolSpec(n_pods=6, stub=fleet), cfg=cfg,
+                      name="outlier-storm")
+    try:
+        result = eng.run()
+    finally:
+        eng.close()
+    card = result.scorecard
+    slow_slot = eng.datastore.endpoint_by_hostport("10.77.0.5:8000").slot
+    loaded_slot = eng.datastore.endpoint_by_hostport("10.77.0.6:8000").slot
+    ejected_slots = [e["slot"] for e in card["ejections"]]
+    assert slow_slot in ejected_slots, (
+        f"the slow endpoint was never ejected: {card['ejections']}")
+    assert set(ejected_slots) == {slow_slot}, (
+        f"ejection touched healthy endpoints: {card['ejections']}")
+    # Hysteresis: cooldown bounds re-ejection cadence (no flap storm).
+    assert len(ejected_slots) <= 3
+    # The merely-loaded endpoint's breaker never tripped at all.
+    loaded_rep = result.board.report()["breakers"].get(str(loaded_slot))
+    assert loaded_rep is None or loaded_rep["transitions"] == 0
+    # The quarantine came from LATENCY, not errors: zero 5xx all run.
+    assert card["client_5xx"] == 0
+    slow_rep = result.board.report()["breakers"][str(slow_slot)]
+    assert slow_rep["opened_by"] == SERVE
+
+
+# ==========================================================================
+# Engine: overload -> sheddable 429s -> shed-driven autoscale
+# ==========================================================================
+
+
+def test_storm_capacity_sheds_and_scales_under_overload(tmp_path):
+    """storm-capacity (docs/STORM.md): a 6x crowd against a 4-pod pool
+    with no upgrade escape hatch. Every candidate saturates, so the
+    cycle's SHEDDABLE path sheds with 429 (never a 5xx), the sustained
+    shed rate drives the real recommender's fast-up, and the pool grows
+    — the whole closed capacity loop in one storm."""
+    from gie_tpu.storm.engine import run_scenario
+
+    result = run_scenario("storm-capacity", dump_dir=str(tmp_path))
+    card = result.scorecard
+    if card["shed"] == 0:
+        # The engine runs in REAL time: on a heavily loaded box the
+        # submitter can fall behind its own crowd (client_skipped eats
+        # the overload before the stubs queue). One seeded retry keeps
+        # the claim strict — a genuine shed-path regression fails both
+        # runs — without flaking on CPU contention.
+        result = run_scenario("storm-capacity", seed=515152,
+                              dump_dir=str(tmp_path))
+        card = result.scorecard
+    assert card["client_5xx"] == 0, card["client_5xx_detail"]
+    assert card["shed"] > 0, (
+        "the 6x crowd never shed sheddable traffic — the overload was "
+        "not an overload")
+    assert card["goodput_tokens_per_s"] > 0
+    sizes = [n for _, n in card["pool_size_trace"]]
+    assert max(sizes) > 4, (
+        f"the autoscale loop never added capacity: {card['autoscale_events']}")
+    assert card["autoscale_events"], "no autoscale decision was recorded"
+
+
+# ==========================================================================
+# Scenario-drive interpretation errors
+# ==========================================================================
+
+
+def test_run_scenario_requires_storm_drive():
+    from gie_tpu.storm.engine import run_scenario
+
+    with pytest.raises(ValueError, match="drive.storm"):
+        run_scenario("mixed-soak")
+
+
+def test_storm_scenarios_ship_in_the_library():
+    from gie_tpu.resilience import scenarios
+
+    names = scenarios.list_scenarios()
+    assert {"storm-flash-upgrade", "storm-soak"} <= set(names)
+    for name in ("storm-flash-upgrade", "storm-soak"):
+        scn = scenarios.load(name)
+        prog = S.program_from_drive(scn.drive["storm"], seed=scn.seed)
+        sched = prog.compile()
+        assert sched.arrivals and sched.events
+
+
+# ==========================================================================
+# Slow soak: the whole stack in one run
+# ==========================================================================
+
+
+@pytest.mark.slow
+def test_storm_soak_full_stack_degrades_and_recovers(tmp_path):
+    """storm-soak (docs/STORM.md): diurnal ramp + flash crowd + LoRA
+    churn + long-context + rolling upgrade + autoscale + warm-standby
+    failover probes, over scrape-latency and device-dispatch chaos —
+    ext-proc to replication in ONE run, recovered at the end."""
+    from gie_tpu.storm.engine import run_scenario
+
+    result = run_scenario("storm-soak", dump_dir=str(tmp_path))
+    card = result.scorecard
+    assert card["client_5xx"] == 0, card["client_5xx_detail"]
+    assert card["resets"] == 0
+    assert card["ok"] > 300
+    assert card["final_rung"] == 0
+    assert card["max_rung"] >= 1
+    assert sum(1 for u in card["upgrades"] if u["step"] == "replace") == 6
+    # Warm-standby readiness held THROUGH the storm: every failover
+    # probe decoded a live digest, at monotonically advancing epochs.
+    checks = card["failover_checks"]
+    assert len(checks) >= 5
+    assert all(c["ok"] for c in checks), checks
+    epochs = [c["epoch"] for c in checks]
+    assert epochs == sorted(epochs) and epochs[-1] > epochs[0]
+    SC.validate(card)
