@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc cost release clean
+.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc bench-cpu cost release clean
 
 all: native generate
 
@@ -110,6 +110,16 @@ bench-scrape:
 # the fast lane stops beating legacy — the CI regression guard).
 bench-extproc: native
 	$(PY) bench_extproc.py
+
+# CPU-fallback bench lane (ROADMAP item 8: BENCH r03-r05 aborted
+# backend-unreachable and the perf trajectory went dark). Runs the
+# admission bench + the goodput sim on the CPU platform with every JSON
+# record tagged "backend":"cpu-fallback" (bench.py's tag convention),
+# so a box with no reachable TPU still captures a comparable trajectory
+# point instead of nothing.
+bench-cpu: native
+	JAX_PLATFORMS=cpu GIE_BENCH_BACKEND=cpu-fallback $(PY) bench_extproc.py
+	JAX_PLATFORMS=cpu GIE_GOODPUT_PLATFORM=cpu $(PY) bench_goodput.py
 
 # Versioned release artifacts (CRDs, tuned profile, conformance report).
 release:

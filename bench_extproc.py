@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -55,6 +56,13 @@ from gie_tpu.extproc import pb
 from gie_tpu.extproc.server import RoundRobinPicker, StreamingServer
 
 N_ENDPOINTS = 16
+
+# Bench-lane backend tag (ROADMAP item 8 / make bench-cpu): the CPU
+# fallback lane exports "backend":"cpu-fallback" on every JSON record —
+# the same tag bench.py uses — so artifact consumers can segregate
+# CPU-lane numbers from real-hardware captures and the BENCH trajectory
+# never goes dark when no TPU is reachable.
+_BACKEND_TAG = os.environ.get("GIE_BENCH_BACKEND", "")
 
 
 def _log(msg: str) -> None:
@@ -226,6 +234,7 @@ def run_one(impl: str, workload: str, n_requests: int) -> dict:
         "impl": impl,
         "workload": workload,
         "requests": n_requests,
+        **({"backend": _BACKEND_TAG} if _BACKEND_TAG else {}),
         "cpu_us_per_req": round(cpu / n_requests * 1e6, 2),
         "wall_p50_us": round(float(np.percentile(wall, 50)) * 1e6, 2),
         "wall_p99_us": round(float(np.percentile(wall, 99)) * 1e6, 2),
@@ -285,6 +294,7 @@ def main() -> None:
         "metric": "extproc_admission_cpu_speedup",
         "value": round(speedup, 2),
         "unit": "x",
+        **({"backend": _BACKEND_TAG} if _BACKEND_TAG else {}),
         "fast_cpu_us_per_req": fast["cpu_us_per_req"],
         "fast_wall_p99_us": fast["wall_p99_us"],
         "legacy_cpu_us_per_req": legacy["cpu_us_per_req"],

@@ -25,7 +25,7 @@ import os
 import sys
 
 
-def _force_platform() -> None:
+def _force_platform() -> str:
     platform = os.environ.get("GIE_GOODPUT_PLATFORM", "cpu")
     import jax
 
@@ -41,6 +41,7 @@ def _force_platform() -> None:
             "timings reflect that backend",
             file=sys.stderr,
         )
+    return active
 
 
 # The HEADLINE operating point, shared with bench_goodput_sweep.py and
@@ -69,7 +70,7 @@ HEADLINE_DURATION_S = 20.0
 
 
 def main() -> None:
-    _force_platform()
+    backend = _force_platform()
     from gie_tpu.simulator import StubConfig
     from gie_tpu.simulator.cluster import SimCluster, WorkloadConfig, tuned_scheduler
 
@@ -130,6 +131,10 @@ def main() -> None:
                 "value": round(results["tpu"].goodput_tokens_per_s, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(ratio, 2),
+                # bench.py's tag convention (make bench-cpu): CPU-lane
+                # records are segregated from real-hardware captures.
+                "backend": ("cpu-fallback" if backend == "cpu"
+                            else backend),
             }
         )
     )

@@ -78,7 +78,18 @@ class ExtProcError(Exception):
 
 
 class ShedError(Exception):
-    """Request shed under load -> ImmediateResponse 429 (004 README:80)."""
+    """Request shed under load -> ImmediateResponse 429 (004 README:80).
+
+    Band- and tenant-aware (gie_tpu/fairness): shed sites stamp WHO was
+    shed so the response path, tests, and the storm scorecard can prove
+    sheds land on the over-budget tenant's SHEDDABLE traffic, never on
+    CRITICAL work while lower bands hold queued requests."""
+
+    def __init__(self, message: str = "request shed",
+                 band=None, tenant: str = ""):
+        super().__init__(message)
+        self.band = band
+        self.tenant = tenant
 
 
 @dataclasses.dataclass(slots=True)

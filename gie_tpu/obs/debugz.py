@@ -26,6 +26,7 @@ serialization happens here, outside every gie_tpu lock.
 from __future__ import annotations
 
 import gzip
+import hmac
 import ipaddress
 import json
 import threading
@@ -85,11 +86,21 @@ class DebugzServer:
     """
 
     def __init__(self, port: int, registry, providers: Mapping[str, Provider],
-                 bind: str = "0.0.0.0", debugz_bind: str = "127.0.0.1"):
+                 bind: str = "0.0.0.0", debugz_bind: str = "127.0.0.1",
+                 debugz_token: str | None = None):
         self.registry = registry
         self.providers = dict(providers)
         self.debugz_bind = debugz_bind
         self._debugz_loopback_only = _is_loopback_bind(debugz_bind)
+        # Bearer-token auth for off-loopback zpage access
+        # (--debugz-token, docs/OBSERVABILITY.md "bind hardening"): with
+        # a token configured, a NON-loopback peer must present
+        # ``Authorization: Bearer <token>`` (constant-time compare) on
+        # every /debugz path — 401 otherwise — regardless of the
+        # debugz_bind opt-out (the token is the stronger gate and always
+        # wins for remote peers). Loopback peers never need it, and
+        # /metrics is untouched either way.
+        self._debugz_token = debugz_token or None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -123,22 +134,50 @@ class DebugzServer:
         """May this peer read /debugz pages? Loopback peers always may;
         anyone else only when the operator opted out of the loopback
         default with an explicit --debugz-bind."""
-        if not self._debugz_loopback_only:
-            return True
+        return (not self._debugz_loopback_only
+                or self._peer_is_loopback(peer_host))
+
+    def _peer_is_loopback(self, peer_host: str) -> bool:
+        """THE peer-classification predicate — both gates (bind opt-out
+        and token) route through it, so they can never disagree about
+        the same peer. Unparsable peers are treated as remote."""
         try:
             return ipaddress.ip_address(peer_host.split("%")[0]).is_loopback
         except ValueError:
-            return False  # unparsable peer: closed by default
+            return False
+
+    def _token_ok(self, req: BaseHTTPRequestHandler) -> bool:
+        """Constant-time bearer-token check (hmac.compare_digest — the
+        zpage gate must not become a timing oracle for its own secret).
+        Compared as BYTES: compare_digest rejects non-ASCII strings with
+        a TypeError, which would turn a hostile non-ASCII Authorization
+        header into a 500 instead of the documented 401."""
+        auth = req.headers.get("Authorization", "") or ""
+        if not auth.startswith("Bearer "):
+            return False
+        return hmac.compare_digest(
+            auth[7:].strip().encode("utf-8", "surrogateescape"),
+            self._debugz_token.encode("utf-8", "surrogateescape"))
 
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
         parsed = urlparse(req.path)
         path = parsed.path.rstrip("/") or "/"
-        if ((path == "/debugz" or path.startswith("/debugz/"))
-                and not self._debugz_allowed(req.client_address[0])):
-            req.send_error(
-                403, "debugz is loopback-only by default; start with an "
-                     "explicit --debugz-bind to expose it")
-            return
+        if path == "/debugz" or path.startswith("/debugz/"):
+            peer = req.client_address[0]
+            if self._debugz_token and not self._peer_is_loopback(peer):
+                # Token configured: it is the remote-peer gate, stronger
+                # than (and overriding) the bind opt-out.
+                if not self._token_ok(req):
+                    req.send_error(
+                        401, "debugz requires Authorization: Bearer "
+                             "<--debugz-token> from non-loopback peers")
+                    return
+            elif not self._debugz_allowed(peer):
+                req.send_error(
+                    403, "debugz is loopback-only by default; start with "
+                         "an explicit --debugz-bind (or --debugz-token) "
+                         "to expose it")
+                return
         if path == "/debugz":
             self._send_json(req, {
                 "pages": sorted(f"/debugz/{name}" for name in self.providers),
@@ -205,7 +244,8 @@ class DebugzServer:
 def start_debugz_server(
     port: int, registry, providers: Mapping[str, Provider] | None = None,
     bind: str = "0.0.0.0", debugz_bind: str = "127.0.0.1",
+    debugz_token: str | None = None,
 ) -> DebugzServer:
     """Start the combined listener (the runner's metrics-port server)."""
     return DebugzServer(port, registry, providers or {}, bind=bind,
-                        debugz_bind=debugz_bind)
+                        debugz_bind=debugz_bind, debugz_token=debugz_token)

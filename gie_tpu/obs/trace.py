@@ -135,8 +135,31 @@ class Tracer:
     })
 
     def __init__(self, sample_rate: float, seed: int = 0,
-                 slow_s: float = 0.25, keep: int = 256):
+                 slow_s: float = 0.25, keep: int = 256,
+                 tenant_rates: Optional[dict] = None):
         self.sampler = Sampler(sample_rate, seed)
+        # Per-tenant head-sampling overrides (--obs-tenant-sample,
+        # docs/FAIRNESS.md): one noisy tenant traced at 1.0 while the
+        # fleet stays at the fleet rate. Keyed by the request's fairness
+        # ID (x-gateway-inference-fairness-id); same deterministic
+        # seeded-CRC32 keep/drop as the fleet sampler, so replicas agree
+        # per trace ID within a tenant too. Empty map = zero extra work
+        # in begin() beyond one falsy check.
+        self.tenant_rates = dict(tenant_rates or {})
+        self._tenant_thresholds: dict[str, int] = {}
+        self._tenant_header = ""
+        if self.tenant_rates:
+            # Deferred import: extproc.metadata is constant-only, but the
+            # package import edge must not run at obs-module import time.
+            from gie_tpu.extproc import metadata as _md
+
+            self._tenant_header = _md.FLOW_FAIRNESS_ID_KEY
+            for tenant, rate in self.tenant_rates.items():
+                if not (0.0 <= rate <= 1.0):
+                    raise ValueError(
+                        f"tenant sample rate must be in [0, 1]: "
+                        f"{tenant}={rate}")
+                self._tenant_thresholds[tenant] = int(rate * 0x1_0000_0000)
         # Latency tail threshold: a request slower than this exports even
         # unsampled (the "why did request X take 900 ms" class).
         self.slow_s = slow_s
@@ -158,7 +181,21 @@ class Tracer:
             # (pid-prefixed counter — deterministic, no RNG).
             tid = f"{self._gen_prefix}{next(self._gen):012x}" + "0" * 16
         self.started_total += 1  # GIL-atomic; approximate under races
-        return TraceCtx(tid, rid, self.sampler.keep(tid), time.monotonic())
+        sampled = self.sampler.keep(tid)
+        if self._tenant_thresholds:
+            vals = headers.get(self._tenant_header)
+            if vals:
+                threshold = self._tenant_thresholds.get(vals[0])
+                if threshold is not None:
+                    # Tenant override REPLACES the fleet decision both
+                    # ways: a noisy tenant at 1.0 always keeps, a spammy
+                    # one at 0.0 always drops (errors still always
+                    # export via finish()).
+                    sampled = (
+                        threshold >= 0x1_0000_0000
+                        or (threshold > 0 and zlib.crc32(
+                            tid.encode(), self.sampler.seed) < threshold))
+        return TraceCtx(tid, rid, sampled, time.monotonic())
 
     def finish(self, ctx: TraceCtx, outcome: str,
                record: Optional[dict] = None, detail: str = "") -> None:

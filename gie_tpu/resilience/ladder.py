@@ -76,6 +76,13 @@ class LadderConfig:
     # thrash), while 2..32 sit on a flat plateau with 8 at its optimum
     # — so 8 stays. The runner wires --ladder-cached-kv-weight.
     cached_kv_weight: float = 8.0
+    # ROUND_ROBIN-rung smooth-WRR weight shape: per-endpoint weight is
+    # ``(1 + last_known_queue_depth) ** -wrr_queue_alpha``. 0 = uniform
+    # rotation (ignore the stale rows entirely), 1 = the inverse-queue
+    # default, larger = steer harder away from queues the blackout froze.
+    # Calibrated by the storm sweep recorded in docs/RESILIENCE.md
+    # ("ladder calibration"); the runner wires --ladder-wrr-alpha.
+    wrr_queue_alpha: float = 1.0
 
     def __post_init__(self):
         if (self.dispatch_error_streak < 1 or self.recover_streak < 1
@@ -89,6 +96,8 @@ class LadderConfig:
             raise ValueError("serve window parameters must be positive")
         if self.cached_kv_weight < 0:
             raise ValueError("cached_kv_weight must be >= 0")
+        if self.wrr_queue_alpha < 0:
+            raise ValueError("wrr_queue_alpha must be >= 0")
 
 
 class DegradationLadder:

@@ -309,6 +309,40 @@ TRACES_EXPORTED = prom.Counter(
     ["reason"],  # sampled|error|slow
     registry=REGISTRY,
 )
+# gie-fair (gie_tpu/fairness, docs/FAIRNESS.md): per-tenant flow-control
+# accounting. The tenant label is BOUNDED by construction: the fairness
+# labeler exports the top-K tenants by traffic under their own value and
+# folds the long tail into "other" (empty fairness ID -> "default"), so
+# these series scale with K, never with the tenant population.
+TENANT_REQUESTS = prom.Counter(
+    "gie_tenant_requests_total",
+    "Flow-queue enqueues by tenant (x-gateway-inference-fairness-id; "
+    "top-K tenants labeled individually, the long tail as 'other')",
+    ["tenant"],
+    registry=REGISTRY,
+)
+TENANT_COST = prom.Counter(
+    "gie_tenant_cost_total",
+    "Drained request cost (scheduler request_cost units) by tenant — "
+    "the capacity each tenant actually consumed through the flow queue",
+    ["tenant"],
+    registry=REGISTRY,
+)
+TENANT_SHED = prom.Counter(
+    "gie_tenant_shed_total",
+    "Requests shed (429) by tenant and criticality band, all shed "
+    "sources: queue bounds, cycle saturation, SLO reversal, and the "
+    "over-fair-share preemptive shed",
+    ["tenant", "band"],
+    registry=REGISTRY,
+)
+TENANT_SERVE_ERRORS = prom.Counter(
+    "gie_tenant_serve_errors_total",
+    "Data-plane serve errors (5xx/reset) observed per tenant at the "
+    "response hop — the per-tenant half of gie_serve_outcome_total",
+    ["tenant"],
+    registry=REGISTRY,
+)
 
 
 def set_build_info(fast_lane: bool, resilience: bool, obs: bool) -> None:
@@ -384,7 +418,8 @@ def register_pool_aggregates(snapshot) -> None:
 
 
 def start_metrics_server(port: int, providers=None,
-                         debugz_bind: str = "127.0.0.1"):
+                         debugz_bind: str = "127.0.0.1",
+                         debugz_token=None):
     """Start the operator HTTP listener: /metrics (Prometheus text, or
     OpenMetrics-with-exemplars under content negotiation) plus the
     /debugz introspection plane (gie_tpu/obs/debugz.py) for whatever
@@ -395,4 +430,5 @@ def start_metrics_server(port: int, providers=None,
     from gie_tpu.obs.debugz import start_debugz_server
 
     return start_debugz_server(port, REGISTRY, providers,
-                               debugz_bind=debugz_bind)
+                               debugz_bind=debugz_bind,
+                               debugz_token=debugz_token)

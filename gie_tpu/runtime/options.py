@@ -127,6 +127,22 @@ class Options:
     ladder_serve_window_s: float = 10.0
     ladder_serve_error_rate: float = 0.5
     ladder_serve_min_samples: int = 20
+    # ROUND_ROBIN-rung smooth-WRR queue-shape exponent (weight =
+    # (1+queue)^-alpha; docs/RESILIENCE.md "ladder calibration").
+    ladder_wrr_alpha: float = 1.0
+    # Multi-tenant fairness (gie_tpu/fairness, docs/FAIRNESS.md):
+    # "tenant=weight" pairs for the weighted-DRR flow queue (repeatable,
+    # comma-joinable; unlisted tenants weigh 1.0 — uniform by default).
+    fairness_weights: list = dataclasses.field(default_factory=list)
+    # Over-fair-share factor: a tenant offering more than factor x its
+    # weighted fair share of windowed cost becomes eligible for the
+    # preemptive SHEDDABLE shed under saturation.
+    fairness_over_factor: float = 2.0
+    # Sliding window for every per-tenant budget ledger.
+    fairness_window_s: float = 10.0
+    # gie_tenant_* label cardinality: top-K tenants by traffic keep
+    # their own label value, the long tail exports as "other".
+    fairness_top_k: int = 8
     # p99 serve-latency outlier ejection (resilience/outlier.py): a
     # consistently-slow endpoint (windowed per-endpoint quantile above
     # --outlier-ratio x the pool median) is quarantined via the breaker
@@ -141,6 +157,11 @@ class Options:
     # address (e.g. the pod IP, or 0.0.0.0). /metrics is unaffected —
     # Prometheus keeps scraping from off-pod either way.
     debugz_bind: str = "127.0.0.1"
+    # Bearer token required from NON-loopback peers on /debugz paths
+    # (constant-time compare, 401 without it). Stronger than — and, when
+    # set, overriding — the --debugz-bind opt-out for remote peers;
+    # loopback access and /metrics are unaffected.
+    debugz_token: Optional[str] = None
     # gie-chaos fault injection (resilience/faults.py): repeatable
     # "point=kind:prob[:arg],..." specs plus the schedule seed. Empty =
     # injection disabled (zero hot-path cost beyond one flag check).
@@ -180,6 +201,11 @@ class Options:
     # Latency tail-outlier threshold: a request slower than this exports
     # its trace even when head sampling dropped it.
     obs_slow_ms: float = 250.0
+    # Per-tenant trace-rate overrides ("tenant=rate", repeatable): one
+    # noisy tenant traced at 1.0 while the fleet stays at
+    # --obs-sample-rate. A tenant map alone (fleet rate 0) still
+    # installs the tracer — only the mapped tenants head-sample.
+    obs_tenant_sample: list = dataclasses.field(default_factory=list)
     # Where --fault-scenario runs (and failed chaos tests) dump the
     # flight-recorder JSON artifact.
     obs_dump_dir: str = "/tmp/gie-obs"
@@ -356,6 +382,34 @@ class Options:
                             default=d.ladder_serve_min_samples,
                             help="min serve outcomes in the window "
                                  "before the serve floor may engage")
+        parser.add_argument("--ladder-wrr-alpha", type=float,
+                            default=d.ladder_wrr_alpha,
+                            help="ROUND_ROBIN-rung WRR queue-shape "
+                                 "exponent: weight=(1+queue)^-alpha; 0 "
+                                 "= uniform rotation (default from the "
+                                 "storm sweep, docs/RESILIENCE.md)")
+        parser.add_argument("--fairness-weights", action="append",
+                            default=[], dest="fairness_weights",
+                            metavar="TENANT=WEIGHT[,TENANT=WEIGHT...]",
+                            help="weighted-DRR tenant weights for the "
+                                 "flow queue (repeatable; unlisted "
+                                 "tenants weigh 1.0 — docs/FAIRNESS.md)")
+        parser.add_argument("--fairness-over-factor", type=float,
+                            default=d.fairness_over_factor,
+                            help="over-fair-share factor: offered-cost "
+                                 "share beyond factor x fair share "
+                                 "makes a tenant's SHEDDABLE traffic "
+                                 "shed first under saturation")
+        parser.add_argument("--fairness-window-s", type=float,
+                            default=d.fairness_window_s,
+                            help="sliding window for per-tenant budget "
+                                 "ledgers (cost shares, shed/error "
+                                 "rates)")
+        parser.add_argument("--fairness-top-k", type=int,
+                            default=d.fairness_top_k,
+                            help="gie_tenant_* label cardinality: top-K "
+                                 "tenants by traffic keep their own "
+                                 "label, the long tail exports 'other'")
         parser.add_argument("--outlier-ejection", dest="outlier_ejection",
                             action="store_true",
                             default=d.outlier_ejection,
@@ -428,11 +482,23 @@ class Options:
         parser.add_argument("--obs-dump-dir", default=d.obs_dump_dir,
                             help="directory for chaos-scenario flight-"
                                  "recorder JSON artifacts")
+        parser.add_argument("--obs-tenant-sample", action="append",
+                            default=[], dest="obs_tenant_sample",
+                            metavar="TENANT=RATE",
+                            help="per-tenant trace-rate override "
+                                 "(repeatable): trace one noisy tenant "
+                                 "at 1.0 while the fleet stays at "
+                                 "--obs-sample-rate")
         parser.add_argument("--debugz-bind", default=d.debugz_bind,
                             help="peer gate for the /debugz zpages: "
                                  "loopback-only by default; name a non-"
                                  "loopback address (pod IP, 0.0.0.0) to "
                                  "expose them (/metrics is unaffected)")
+        parser.add_argument("--debugz-token", default=d.debugz_token,
+                            help="bearer token required from non-"
+                                 "loopback peers on /debugz paths "
+                                 "(constant-time compare, 401 without "
+                                 "it; /metrics unaffected)")
 
     @classmethod
     def from_args(cls, args: argparse.Namespace) -> "Options":
@@ -484,11 +550,17 @@ class Options:
             ladder_serve_window_s=args.ladder_serve_window_s,
             ladder_serve_error_rate=args.ladder_serve_error_rate,
             ladder_serve_min_samples=args.ladder_serve_min_samples,
+            ladder_wrr_alpha=args.ladder_wrr_alpha,
+            fairness_weights=list(args.fairness_weights),
+            fairness_over_factor=args.fairness_over_factor,
+            fairness_window_s=args.fairness_window_s,
+            fairness_top_k=args.fairness_top_k,
             outlier_ejection=args.outlier_ejection,
             outlier_window_s=args.outlier_window_s,
             outlier_ratio=args.outlier_ratio,
             outlier_quantile=args.outlier_quantile,
             debugz_bind=args.debugz_bind,
+            debugz_token=args.debugz_token,
             fault_specs=list(args.fault_specs),
             fault_seed=args.fault_seed,
             fault_scenario=args.fault_scenario,
@@ -499,6 +571,7 @@ class Options:
             obs_sample_seed=args.obs_sample_seed,
             obs_ring=args.obs_ring,
             obs_slow_ms=args.obs_slow_ms,
+            obs_tenant_sample=list(args.obs_tenant_sample),
             obs_dump_dir=args.obs_dump_dir,
         )
 
@@ -572,6 +645,36 @@ class Options:
                 "--ladder-serve-error-rate must be in (0, 1]")
         if self.ladder_serve_min_samples < 1:
             raise ValueError("--ladder-serve-min-samples must be >= 1")
+        if self.ladder_wrr_alpha < 0:
+            raise ValueError("--ladder-wrr-alpha must be >= 0")
+        if self.fairness_weights:
+            from gie_tpu.fairness import parse_weights
+
+            try:
+                parse_weights(self.fairness_weights)
+            except ValueError as e:
+                raise ValueError(f"--fairness-weights: {e}") from None
+        if self.fairness_over_factor <= 1.0:
+            raise ValueError("--fairness-over-factor must be > 1")
+        if self.fairness_window_s <= 0:
+            raise ValueError("--fairness-window-s must be > 0")
+        if self.fairness_top_k < 1:
+            raise ValueError("--fairness-top-k must be >= 1")
+        for spec in self.obs_tenant_sample:
+            name, sep, raw = str(spec).partition("=")
+            if not sep or not name:
+                raise ValueError(
+                    f"--obs-tenant-sample {spec!r} must be TENANT=RATE")
+            try:
+                rate = float(raw)
+            except ValueError:
+                raise ValueError(
+                    f"--obs-tenant-sample {spec!r}: rate must be a "
+                    "number") from None
+            if not (0.0 <= rate <= 1.0):
+                raise ValueError(
+                    f"--obs-tenant-sample {spec!r}: rate must be in "
+                    "[0, 1]")
         if self.outlier_ejection:
             if self.outlier_window_s <= 0:
                 raise ValueError("--outlier-window-s must be > 0")

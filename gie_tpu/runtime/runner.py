@@ -133,11 +133,19 @@ class ExtProcServerRunner:
             from gie_tpu.obs.recorder import FlightRecorder
             from gie_tpu.obs.trace import Tracer
 
+            tenant_rates = {
+                spec.partition("=")[0]: float(spec.partition("=")[2])
+                for spec in opts.obs_tenant_sample
+            }
             tracer = None
-            if opts.obs_sample_rate > 0:
+            if opts.obs_sample_rate > 0 or tenant_rates:
+                # A tenant-rate map alone (fleet rate 0) still installs
+                # the tracer: "one noisy tenant at 1.0 while the fleet
+                # stays dark" is exactly the per-tenant override's job.
                 tracer = Tracer(
                     opts.obs_sample_rate, seed=opts.obs_sample_seed,
-                    slow_s=opts.obs_slow_ms / 1000.0)
+                    slow_s=opts.obs_slow_ms / 1000.0,
+                    tenant_rates=tenant_rates)
             obs.install(tracer=tracer,
                         recorder=FlightRecorder(opts.obs_ring))
             self._obs_installed = True
@@ -169,9 +177,24 @@ class ExtProcServerRunner:
                     cached_kv_weight=opts.ladder_cached_kv_weight,
                     serve_window_s=opts.ladder_serve_window_s,
                     serve_error_rate=opts.ladder_serve_error_rate,
-                    serve_min_samples=opts.ladder_serve_min_samples)),
+                    serve_min_samples=opts.ladder_serve_min_samples,
+                    wrr_queue_alpha=opts.ladder_wrr_alpha)),
                 static_subset=opts.resilience_static_subset,
                 ejector=ejector)
+        # Multi-tenant fairness (gie_tpu/fairness, docs/FAIRNESS.md):
+        # weighted-DRR flow ordering + per-tenant budgets; uniform
+        # weights unless --fairness-weights names tenants.
+        from gie_tpu.fairness import (
+            FairnessConfig,
+            FairnessState,
+            parse_weights,
+        )
+
+        self.fairness = FairnessState(FairnessConfig(
+            weights=parse_weights(opts.fairness_weights),
+            over_share_factor=opts.fairness_over_factor,
+            window_s=opts.fairness_window_s,
+            top_k=opts.fairness_top_k))
         # Multiplexed keep-alive scrape engine (metricsio/engine.py,
         # docs/METRICSIO.md): a fixed shard pool polls every endpoint at
         # the fast-poll cadence; attach/detach below are O(1) so endpoint
@@ -211,6 +234,7 @@ class ExtProcServerRunner:
             # never stalls the dispatcher on first-use jit (ROADMAP item).
             background_warm=True,
             resilience=self.resilience,
+            fairness=self.fairness,
         )
         own_metrics.register_pool_aggregates(self._pool_snapshot)
         self._train_stop = threading.Event()
@@ -475,6 +499,7 @@ class ExtProcServerRunner:
             "picks": picks,
             "pick": pick,
             "queue": lambda q: self.picker.queue_report(),
+            "tenants": lambda q: self.picker.tenants_report(),
             "datastore": lambda q: self.datastore.debug_report(),
             "scheduler": lambda q: self.scheduler.debug_report(),
             "drain": drain,
@@ -645,7 +670,8 @@ class ExtProcServerRunner:
             self.debugz_server = own_metrics.start_metrics_server(
                 self.opts.metrics_port,
                 providers=self._debugz_providers(),
-                debugz_bind=self.opts.debugz_bind)
+                debugz_bind=self.opts.debugz_bind,
+                debugz_token=self.opts.debugz_token)
         except OSError as e:
             self.log.error("metrics server failed to start", err=e)
 

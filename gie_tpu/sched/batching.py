@@ -42,6 +42,7 @@ from gie_tpu.extproc import metadata as mdkeys
 from gie_tpu.resilience import deadline as deadline_mod
 from gie_tpu.resilience import faults
 from gie_tpu.resilience.ladder import ResilienceState, Rung
+from gie_tpu.fairness import FairnessState
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.filters import drain_filter
 from gie_tpu.sched.hashing import batch_chunk_hashes
@@ -73,43 +74,39 @@ def _band_for(headers: dict, registry=None) -> int:
                              int(C.Criticality.STANDARD))
 
 
+def _ctx_tenant(ctx) -> str:
+    """Fairness ID from a stream's captured headers (the response hops
+    have the RequestContext, not the _Pending): same defensive shape as
+    the enqueue-time extraction."""
+    vals = getattr(ctx, "headers", None)
+    vals = vals.get(mdkeys.FLOW_FAIRNESS_ID_KEY) if vals else None
+    return (vals[0] if isinstance(vals, list) and vals
+            and isinstance(vals[0], str) else "")
+
+
 def _fair_order(items: list["_Pending"]) -> list["_Pending"]:
-    """Criticality bands first, round-robin by fairness ID within a band.
+    """Criticality bands first, weighted deficit-round-robin by fairness
+    ID within a band (gie_tpu/fairness, docs/FAIRNESS.md).
 
     Proposal 1199 scopes fairness within a priority band: CRITICAL drains
     before STANDARD before SHEDDABLE, and inside each band tenants
-    (x-gateway-inference-fairness-id) interleave round-robin with per-tenant
-    FIFO preserved. O(n) via deques. Bands come from the value CACHED on
-    each item at enqueue time — never a header re-parse per drain.
-    """
-    from collections import deque
+    (x-gateway-inference-fairness-id) share drained COST — each drain
+    charges the item's request cost against the tenant's deficit, so a
+    tenant of 8k-prompt requests no longer wins 10x the capacity of a
+    chat neighbor per interleave slot. Bands and tenants come from values
+    CACHED on each item at enqueue time — never a header re-parse per
+    drain. This module-level form is STATELESS (uniform weights, fresh
+    deficits) for tests and direct callers; the picker itself orders
+    through its persistent FairnessState."""
+    from gie_tpu.fairness.drr import DeficitRoundRobin
 
-    bands: dict[int, dict[str, deque]] = {}
-    band_order: dict[int, list[str]] = {}
-    for it in items:
-        band = it.band
-        fid = it.req.headers.get(mdkeys.FLOW_FAIRNESS_ID_KEY, [""])[0]
-        per = bands.setdefault(band, {})
-        if fid not in per:
-            per[fid] = deque()
-            band_order.setdefault(band, []).append(fid)
-        per[fid].append(it)
-
-    out: list[_Pending] = []
-    for band in sorted(bands):
-        queues = deque(bands[band][fid] for fid in band_order[band])
-        while queues:
-            q = queues.popleft()
-            out.append(q.popleft())
-            if q:
-                queues.append(q)
-    return out
+    return DeficitRoundRobin().order(items)
 
 
 class _Pending:
     __slots__ = ("req", "candidates", "event", "result", "error",
                  "enqueued_at", "abandoned", "band", "cand_slots",
-                 "excl_breaker", "excl_drain")
+                 "excl_breaker", "excl_drain", "tenant", "cost")
 
     def __init__(self, req: PickRequest, candidates: list, band: Optional[int] = None):
         self.req = req
@@ -138,6 +135,19 @@ class _Pending:
         # graceful drain. Empty tuples until a filter actually fires.
         self.excl_breaker: tuple = ()
         self.excl_drain: tuple = ()
+        # Tenant identity + request cost, resolved ONCE at enqueue for
+        # the fairness layer (gie_tpu/fairness): DRR ordering, budget
+        # accounting, and the preemptive shed all read these per drain.
+        # Cost shares request_cost_host's units so fairness charges the
+        # same quantity the scheduler's assumed-load does. The isinstance
+        # guard keeps a malformed header value (None, not a list) from
+        # poisoning the collector's pre-batch section.
+        vals = req.headers.get(mdkeys.FLOW_FAIRNESS_ID_KEY)
+        self.tenant = (vals[0] if isinstance(vals, list) and vals
+                       and isinstance(vals[0], str) else "")
+        self.cost = request_cost_host(
+            float(len(req.body) if req.body else 0.0),
+            float(req.decode_tokens or 0.0) * C.CHARS_PER_TOKEN)
 
 
 def assemble_wave(
@@ -245,6 +255,7 @@ class BatchingTPUPicker:
         pipeline_depth=2,
         background_warm: bool = False,
         resilience: Optional[ResilienceState] = None,
+        fairness: Optional["FairnessState"] = None,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
@@ -360,6 +371,12 @@ class BatchingTPUPicker:
         # per WAVE whether this wave takes the full device path, a probe
         # wave, or a host-side degraded pick. None = seed behavior.
         self.resilience = resilience
+        # Multi-tenant fairness layer (gie_tpu/fairness, docs/FAIRNESS.md):
+        # weighted-DRR flow ordering, per-tenant budget ledgers, and the
+        # over-fair-share preemptive shed. Always on (uniform weights by
+        # default = the proposal-1199 fair interleave, now cost-weighted);
+        # the runner passes a weighted instance from --fairness-weights.
+        self.fairness = fairness if fairness is not None else FairnessState()
         # Smooth-weighted-round-robin credit per slot and the static-
         # subset rotation cursor (degraded rungs; collector/completer
         # threads only — the two never pick the same wave).
@@ -401,6 +418,9 @@ class BatchingTPUPicker:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"malformed objective header: {type(e).__name__}: {e}")
         item = _Pending(req, candidates, band=band)
+        # Fairness ledger (gie_tpu/fairness): offered-cost accounting +
+        # gie_tenant_requests_total — one leaf-lock note per enqueue.
+        self.fairness.note_arrival(item.tenant, item.cost)
         tr = req.trace
         if tr is not None:
             tr.event("queued")
@@ -408,7 +428,7 @@ class BatchingTPUPicker:
             if self._closed:
                 raise ExtProcError(grpc.StatusCode.UNAVAILABLE, "picker shut down")
             if self.queue_bound > 0 and len(self._pending) >= self.queue_bound:
-                self._admit_into_full_queue(band)
+                self._admit_into_full_queue(band, tenant=item.tenant)
             self._pending.append(item)
             own_metrics.QUEUE_DEPTH.set(len(self._pending))
             self._cond.notify()
@@ -426,14 +446,17 @@ class BatchingTPUPicker:
         assert item.result is not None
         return item.result
 
-    def _admit_into_full_queue(self, band: int) -> None:
+    def _admit_into_full_queue(self, band: int, tenant: str = "") -> None:
         """Overload policy for a full flow-control queue (caller holds the
         lock): free a slot by dropping an abandoned waiter if one exists,
         else evict the newest waiter in the lowest-criticality band present
         (which must be strictly lower than the arrival's; it sheds with 429
         — within-band FIFO is preserved, and a band never evicts itself),
         else shed the arrival. Raises ShedError when the arrival loses.
-        `band` is the arrival's already-resolved criticality band."""
+        Within the victim band, an over-fair-share tenant's waiter is
+        evicted FIRST (gie_tpu/fairness): under queue pressure the
+        flooding tenant absorbs the eviction, not an in-budget neighbor.
+        `band`/`tenant` are the arrival's already-resolved identity."""
         for i in range(len(self._pending) - 1, -1, -1):
             if self._pending[i].abandoned:
                 del self._pending[i]
@@ -448,13 +471,66 @@ class BatchingTPUPicker:
         if worst_i < 0:
             own_metrics.QUEUE_SHED.labels(
                 reason="depth", band=_BAND_NAMES.get(band, "standard")).inc()
-            raise ShedError("flow-control queue full")
+            self.fairness.note_shed(
+                tenant, _BAND_NAMES.get(band, "standard"))
+            raise ShedError("flow-control queue full",
+                            band=band, tenant=tenant)
+        # Tenant-aware victim selection: the newest same-band waiter of
+        # an over-share tenant beats plain newest-in-band. _cond (rank
+        # 30) -> budgets leaf lock (rank 83) is hierarchy-clean.
+        over = self.fairness.over_share_set()
+        if over:
+            for i in range(len(self._pending) - 1, -1, -1):
+                it = self._pending[i]
+                if it.band == worst_band and it.tenant in over:
+                    worst_i = i
+                    break
         victim = self._pending.pop(worst_i)
-        victim.error = ShedError("evicted by higher-criticality arrival")
+        victim.error = ShedError("evicted by higher-criticality arrival",
+                                 band=victim.band, tenant=victim.tenant)
         victim.event.set()
         own_metrics.QUEUE_SHED.labels(
             reason="evicted",
             band=_BAND_NAMES.get(worst_band, "standard")).inc()
+        self.fairness.note_shed(
+            victim.tenant, _BAND_NAMES.get(worst_band, "standard"))
+
+    def _preemptive_shed(self, batch: list["_Pending"],
+                         over: frozenset) -> list["_Pending"]:
+        """SLO-tier enforcement under saturation (docs/FAIRNESS.md):
+        SHEDDABLE items of over-fair-share tenants shed 429 when every
+        candidate endpoint is past the scheduler's queue saturation
+        bound — the same pressure the cycle's sheddable-429 machinery
+        detects, applied tenant-first so the flooding tenant absorbs the
+        overload. CRITICAL and STANDARD are never touched here, and an
+        unsaturated pool sheds nobody (over-share alone is not a crime
+        while capacity is free). getattr guards: latency tests stub the
+        store/scheduler."""
+        host_q = getattr(self.metrics_store, "host_queue_depths", None)
+        cfg = getattr(self.scheduler, "cfg", None)
+        limit = float(getattr(cfg, "queue_limit", 0.0) or 0.0)
+        if host_q is None or limit <= 0.0:
+            return batch
+        queues = host_q()
+        kept: list[_Pending] = []
+        for it in batch:
+            if (it.band != int(C.Criticality.SHEDDABLE)
+                    or it.tenant not in over):
+                kept.append(it)
+                continue
+            slots = it.cand_slots
+            slots = slots[(slots >= 0) & (slots < queues.shape[0])]
+            if slots.size and bool(np.all(queues[slots] >= limit)):
+                it.error = ShedError(
+                    "tenant over fair share under saturation",
+                    band=it.band, tenant=it.tenant)
+                it.event.set()
+                own_metrics.QUEUE_SHED.labels(
+                    reason="tenant", band="sheddable").inc()
+                self.fairness.note_shed(it.tenant, "sheddable")
+            else:
+                kept.append(it)
+        return kept
 
     def observe_served(self, served_hostport: str, ctx) -> None:
         """Served-endpoint feedback -> assumed-load release
@@ -504,7 +580,9 @@ class BatchingTPUPicker:
                 max(time.monotonic() - picked_at, 0.0) if picked_at else 0.0)
             self._note_serve_outcome(
                 served_hostport, ok=status < 500,
-                cls=f"{status // 100}xx", latency_s=latency_s)
+                cls=f"{status // 100}xx", latency_s=latency_s,
+                trace=getattr(ctx, "trace", None),
+                tenant=_ctx_tenant(ctx))
             if status >= 500:
                 # An errored serve trains nothing: an Envoy local-reply
                 # 503 (connect refused) arrives FAST, and a low-latency
@@ -590,16 +668,27 @@ class BatchingTPUPicker:
         if rec is not None:
             rec["outcome"] = "reset" if aborted else "closed"
         if primary and aborted:
-            self._note_serve_outcome(primary, ok=False, cls="reset")
+            self._note_serve_outcome(primary, ok=False, cls="reset",
+                                     tenant=_ctx_tenant(ctx))
 
     def _note_serve_outcome(self, hostport: str, ok: bool, cls: str,
-                            latency_s: float = 0.0) -> None:
+                            latency_s: float = 0.0, trace=None,
+                            tenant: str = "") -> None:
         """Fan one data-plane serve outcome into the resilience layer:
         gie_serve_outcome_total, the serving endpoint's breaker (windowed
-        error-rate + streak), and the ladder's pool-wide serve floor."""
+        error-rate + streak), the ladder's pool-wide serve floor, and the
+        per-tenant budget ledger. A head-sampled request's serve-latency
+        observation carries a trace-ID exemplar — the same bucket->trace
+        join the admission/pick histograms already expose
+        (docs/OBSERVABILITY.md)."""
         own_metrics.SERVE_OUTCOME.labels(cls).inc()
         if latency_s > 0.0:
-            own_metrics.SERVE_LATENCY.observe(latency_s)
+            if trace is not None and getattr(trace, "sampled", False):
+                own_metrics.SERVE_LATENCY.observe(
+                    latency_s, {"trace_id": trace.trace_id})
+            else:
+                own_metrics.SERVE_LATENCY.observe(latency_s)
+        self.fairness.note_serve(tenant, ok=ok, cls=cls)
         rs = self.resilience
         if rs is None:
             return
@@ -683,6 +772,24 @@ class BatchingTPUPicker:
             "waves_in_flight": self._inflight,
         }
 
+    def tenants_report(self) -> dict:
+        """Per-tenant zpage (/debugz/tenants, gie_tpu/obs): live
+        per-tenant queue composition joined with the fairness layer's
+        budgets, weights, over-share verdicts, and DRR deficits — the
+        end-to-end explanation of one tenant's deficit/shed state. The
+        queue lock is held only for the identity copy."""
+        with self._cond:
+            pending = [(it.tenant, it.band) for it in self._pending]
+        queue: dict[str, dict[str, int]] = {}
+        for tenant, band in pending:
+            per = queue.setdefault(tenant or "default", {})
+            name = _BAND_NAMES.get(band, str(band))
+            per[name] = per.get(name, 0) + 1
+        rep = self.fairness.report()
+        rep["queue"] = queue
+        rep["queue_depth"] = len(pending)
+        return rep
+
     def close(self) -> None:
         with self._cond:
             self._closed = True
@@ -740,10 +847,14 @@ class BatchingTPUPicker:
                         self._cond.wait(self.max_wait_s)
                     if len(self._pending) > self.max_batch:
                         # Flow-control fairness: when demand exceeds one
-                        # cycle, interleave round-robin across fairness IDs
-                        # (x-gateway-inference-fairness-id header, proposal
-                        # 1199) so one tenant cannot monopolize a wave.
-                        self._pending = _fair_order(self._pending)
+                        # cycle, weighted deficit-round-robin across
+                        # fairness IDs (x-gateway-inference-fairness-id,
+                        # proposal 1199 + gie_tpu/fairness) so one tenant
+                        # cannot monopolize a wave by count OR by cost.
+                        # Only the drained prefix (the next wave) charges
+                        # the persistent deficit state.
+                        self._pending = self.fairness.order(
+                            self._pending, take=self.max_batch)
                     batch = self._pending[: self.max_batch]
                     self._pending = self._pending[self.max_batch :]
                     own_metrics.QUEUE_DEPTH.set(len(self._pending))
@@ -866,14 +977,27 @@ class BatchingTPUPicker:
                     it.band != int(C.Criticality.CRITICAL)
                     and now - it.enqueued_at > self.queue_max_age_s
                 ):
-                    it.error = ShedError("queued beyond flow-control age bound")
+                    it.error = ShedError("queued beyond flow-control age bound",
+                                         band=it.band, tenant=it.tenant)
                     it.event.set()
                     own_metrics.QUEUE_SHED.labels(
                         reason="age",
                         band=_BAND_NAMES.get(it.band, "standard")).inc()
+                    self.fairness.note_shed(
+                        it.tenant, _BAND_NAMES.get(it.band, "standard"))
                 else:
                     kept.append(it)
             batch = kept
+        if batch:
+            # Preemptive per-tenant shed (gie_tpu/fairness, the SLO-tier
+            # contract): under saturation, SHEDDABLE work of tenants over
+            # their weighted fair share sheds 429 BEFORE the wave — the
+            # abuser absorbs the overload, an in-budget neighbor's p99
+            # does not. The over-share set is a cached frozenset; with
+            # nobody over budget this is one read and a falsy branch.
+            over = self.fairness.over_share_set()
+            if over:
+                batch = self._preemptive_shed(batch, over)
         if not batch:
             return []
         # Graceful-drain housekeeping at wave cadence (docs/RESILIENCE.md):
@@ -951,6 +1075,11 @@ class BatchingTPUPicker:
             batch = runnable
             if not batch:
                 return held
+        # Drained-cost ledger (gie_tpu/fairness): this batch IS the wave
+        # — full device path or degraded rung alike — so charge each
+        # tenant's windowed drained cost + gie_tenant_cost_total here,
+        # once, at wave cadence.
+        self.fairness.note_wave(batch)
         rs = self.resilience
         if rs is not None:
             # Per-WAVE resilience decision (never per request): fold the
@@ -1202,7 +1331,9 @@ class BatchingTPUPicker:
                 own_metrics.PICK_LATENCY.observe(lat)
             if status[i] == C.Status.SHED:
                 own_metrics.PICKS.labels(outcome="shed").inc()
-                item.error = ShedError()
+                item.error = ShedError(band=item.band, tenant=item.tenant)
+                self.fairness.note_shed(
+                    item.tenant, _BAND_NAMES.get(item.band, "standard"))
                 if recorder is not None:
                     rec = _rec_base(item)
                     rec["outcome"] = "shed"
@@ -1450,12 +1581,19 @@ class BatchingTPUPicker:
                     picked = [cands[j] for j in order]
                     queue[col_of[picked[0]]] += 1.0
                 elif rung == Rung.ROUND_ROBIN:
-                    # Smooth WRR: weight ~ 1/(1+queue) from the last good
-                    # rows; every candidate gains its weight, the winner
-                    # pays the pot back — long-run shares track weights
-                    # with no starvation.
-                    weights = {s: 1.0 / (1.0 + max(queue[col_of[s]], 0.0))
-                               for s in cands}
+                    # Smooth WRR: weight ~ (1+queue)^-alpha from the last
+                    # good rows; every candidate gains its weight, the
+                    # winner pays the pot back — long-run shares track
+                    # weights with no starvation. The queue-shape
+                    # exponent (--ladder-wrr-alpha) is storm-swept
+                    # (docs/RESILIENCE.md "ladder calibration"): alpha 0
+                    # is uniform RR (stale-data-blind), 1 the calibrated
+                    # default.
+                    alpha = (rs.ladder.cfg.wrr_queue_alpha
+                             if rs is not None else 1.0)
+                    weights = {
+                        s: (1.0 + max(queue[col_of[s]], 0.0)) ** -alpha
+                        for s in cands}
                     for s, w in weights.items():
                         self._wrr_credit[s] = (
                             self._wrr_credit.get(s, 0.0) + w)
@@ -1570,7 +1708,9 @@ class BatchingTPUPicker:
             if pred[j] > slos[j]:
                 res = item.result
                 item.result = None
-                item.error = ShedError()
+                item.error = ShedError(band=item.band, tenant=item.tenant)
+                self.fairness.note_shed(
+                    item.tenant, _BAND_NAMES.get(item.band, "standard"))
                 if res.record is not None:
                     # The decision record outlives the reversal: the
                     # request was picked, then SLO-shed post-pick.

@@ -29,6 +29,10 @@ REQUIRED_FIELDS = (
     "serve_latency_p99_ms", "max_rung", "final_rung", "rung_trace",
     "pool_size_trace", "breaker_opens", "ejections", "upgrades",
     "autoscale_events",
+    # gie-fair (ISSUE 11): per-tenant goodput/p99/SLO/shed breakdowns +
+    # which criticality bands absorbed the sheds — the noisy-neighbor
+    # isolation property is asserted on these.
+    "per_tenant", "shed_by_band",
 )
 
 

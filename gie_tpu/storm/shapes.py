@@ -48,6 +48,9 @@ class Arrival:
     band: str = "standard"    # criticality band (objective header)
     lora: Optional[str] = None
     kind: str = "chat"        # "chat" | "long_context"
+    # Fairness ID (x-gateway-inference-fairness-id); None = no header
+    # (the engine's tallies bucket those as "default").
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -215,6 +218,126 @@ class LongContextMix(Shape):
             a["decode_tokens"] = a["decode_tokens"] * self.decode_scale
 
 
+class TenantMix(Shape):
+    """Zipf tenant assignment (gie-fair, docs/FAIRNESS.md): arrival i
+    belongs to tenant ``t<k>`` with probability proportional to
+    ``1/(k+1)^zipf_a`` — the head-heavy population a real multi-tenant
+    gateway serves. One fixed draw per arrival (determinism contract).
+    Compose BEFORE the abusive/pinned tenant decorators, which override
+    a slice of the mix."""
+
+    def __init__(self, tenants: int = 8, zipf_a: float = 1.1,
+                 prefix: str = "t"):
+        if tenants < 1 or zipf_a < 0:
+            raise ValueError("need tenants >= 1 and zipf_a >= 0")
+        self.tenants = tenants
+        self.zipf_a = zipf_a
+        self.prefix = prefix
+        raw = [1.0 / (k + 1) ** zipf_a for k in range(tenants)]
+        total = sum(raw)
+        cum, acc = [], 0.0
+        for w in raw:
+            acc += w / total
+            cum.append(acc)
+        self._cum = cum
+
+    def decorate(self, a: dict, rng: np.random.Generator, t: float) -> None:
+        u = rng.random()
+        for k, edge in enumerate(self._cum):
+            if u < edge:
+                a["tenant"] = f"{self.prefix}{k}"
+                return
+        a["tenant"] = f"{self.prefix}{self.tenants - 1}"
+
+
+class PinnedTenant(Shape):
+    """A dedicated tenant owning a fixed ``share`` of arrivals, with a
+    pinned criticality band — the latency-sensitive CRITICAL tenant
+    riding through a batch tenant's flash crowd. Assigns tenant AND band
+    together so a later abusive decorator stealing the arrival cannot
+    leave an orphaned CRITICAL band on the abuser's traffic. One fixed
+    draw per arrival."""
+
+    def __init__(self, tenant: str = "vip", share: float = 0.05,
+                 band: str = "critical"):
+        if not (0.0 <= share <= 1.0) or band not in BANDS:
+            raise ValueError(f"need share in [0, 1] and band in {BANDS}")
+        self.tenant = tenant
+        self.share = share
+        self.band = band
+
+    def decorate(self, a: dict, rng: np.random.Generator, t: float) -> None:
+        if rng.random() < self.share:
+            a["tenant"] = self.tenant
+            a["band"] = self.band
+
+
+class AbusiveTenant(Shape):
+    """One tenant multiplies its OWN arrival rate by ``rate_x`` inside a
+    flash-crowd-shaped window while every other tenant's absolute rate
+    stays unchanged: the global rate scales by ``m = 1 + share*(x-1)``
+    and a matching fraction ``share*x/m`` of arrivals is reassigned to
+    the abuser (the algebra keeps victims' rates exactly constant —
+    docs/FAIRNESS.md "noisy neighbor"). Reassigned arrivals also
+    re-draw their band from the abuser's own mix (a batch tenant:
+    mostly sheddable/standard, never critical), so a stolen CRITICAL
+    arrival cannot smuggle unsheddable priority into the flood. Two
+    fixed draws per arrival. Compose AFTER TenantMix/PinnedTenant."""
+
+    def __init__(self, tenant: str = "abuser", share: float = 0.1,
+                 rate_x: float = 20.0, at_s: float = 0.0,
+                 ramp_s: float = 0.5, hold_s: float = 4.0,
+                 decay_s: Optional[float] = None,
+                 sheddable_fraction: float = 0.7):
+        if not (0.0 < share < 1.0) or rate_x < 1.0:
+            raise ValueError("need share in (0, 1) and rate_x >= 1")
+        if ramp_s < 0 or hold_s < 0:
+            raise ValueError("window durations must be >= 0")
+        if not (0.0 <= sheddable_fraction <= 1.0):
+            raise ValueError("sheddable_fraction must be in [0, 1]")
+        self.tenant = tenant
+        self.share = share
+        self.rate_x = rate_x
+        self.at_s = at_s
+        self.ramp_s = ramp_s
+        self.hold_s = hold_s
+        self.decay_s = ramp_s if decay_s is None else decay_s
+        self.sheddable_fraction = sheddable_fraction
+
+    def _x(self, t: float) -> float:
+        """Current rate multiplier for the abuser's own traffic."""
+        dt = t - self.at_s
+        if dt < 0:
+            return 1.0
+        if dt < self.ramp_s:
+            return 1.0 + (self.rate_x - 1.0) * (dt / self.ramp_s)
+        dt -= self.ramp_s
+        if dt < self.hold_s:
+            return self.rate_x
+        dt -= self.hold_s
+        if self.decay_s > 0 and dt < self.decay_s:
+            return self.rate_x - (self.rate_x - 1.0) * (dt / self.decay_s)
+        return 1.0
+
+    def rate(self, t: float) -> float:
+        return 1.0 + self.share * (self._x(t) - 1.0)
+
+    def window(self) -> tuple[float, float]:
+        return (self.at_s,
+                self.at_s + self.ramp_s + self.hold_s + self.decay_s)
+
+    def decorate(self, a: dict, rng: np.random.Generator, t: float) -> None:
+        # Two fixed draws regardless of outcome (determinism contract).
+        u = rng.random()
+        ub = rng.random()
+        x = self._x(t)
+        m = 1.0 + self.share * (x - 1.0)
+        if u < self.share * x / m:
+            a["tenant"] = self.tenant
+            a["band"] = ("sheddable" if ub < self.sheddable_fraction
+                         else "standard")
+
+
 class RollingUpgrade(Shape):
     """Sequential drain/replace of every pod under traffic: pod ``i``
     is DRAINED at ``start_s + i*interval_s`` and REPLACED ``settle_s``
@@ -337,6 +460,7 @@ class Program:
                     "band": band,
                     "lora": None,
                     "kind": "chat",
+                    "tenant": None,
                 }
                 for shape in self.shapes:
                     shape.decorate(a, rng, t)
@@ -360,6 +484,9 @@ SHAPE_KINDS = {
     "long_context": LongContextMix,
     "rolling_upgrade": RollingUpgrade,
     "standby_failover": StandbyFailover,
+    "tenant_mix": TenantMix,
+    "pinned_tenant": PinnedTenant,
+    "abusive_tenant": AbusiveTenant,
 }
 
 

@@ -1,15 +1,22 @@
-"""CACHED-rung kv-weight calibration sweep (docs/RESILIENCE.md "ladder
-calibration"; ISSUE 10 satellite).
+"""Degraded-rung calibration sweeps (docs/RESILIENCE.md "ladder
+calibration"; ISSUE 10/11 satellites).
 
-The degraded CACHED pick ranks endpoints by ``queue + w * kv_util``.
-This sweep pins the ladder at CACHED (DegradationLadder.force_level)
-and runs the same seeded flash-crowd storm through the REAL stack for
-each candidate weight, scoring goodput / SLO attainment / TTFT p99 —
-the rung's OWN performance, isolated from transition dynamics. The
-resulting table is recorded in docs/RESILIENCE.md and sets the
-``--ladder-cached-kv-weight`` default.
+Two sweeps, one harness: pin the ladder on a rung
+(DegradationLadder.force_level + prohibitive recovery thresholds), run
+the same seeded flash-crowd storm through the REAL stack per candidate
+value, score goodput / SLO attainment / TTFT percentiles — the rung's
+OWN policy performance, isolated from transition dynamics — and record
+the winning default.
 
-    JAX_PLATFORMS=cpu python hack/storm_sweep.py [--weights 0,2,8,32]
+  cached-kv   the CACHED rung's ``queue + w*kv`` weight
+              (--ladder-cached-kv-weight; ISSUE 10, table recorded).
+  wrr-alpha   the ROUND_ROBIN rung's smooth-WRR queue-shape exponent
+              ``weight = (1+queue)^-alpha`` (--ladder-wrr-alpha;
+              ISSUE 11 — alpha 0 is uniform rotation, ignoring the
+              last-known-good rows the blackout froze; larger alphas
+              trust the stale queue column harder).
+
+    JAX_PLATFORMS=cpu python hack/storm_sweep.py --sweep wrr-alpha
 """
 
 from __future__ import annotations
@@ -20,10 +27,40 @@ import os
 import sys
 
 
+def _run_rung_storm(*, seed: int, duration_s: float, ladder_kw: dict,
+                    rung: int, name: str) -> dict:
+    from gie_tpu.resilience.ladder import LadderConfig
+    from gie_tpu.storm import shapes as S
+    from gie_tpu.storm.engine import EngineConfig, PoolSpec, StormEngine
+
+    tc = S.TrafficConfig(base_qps=36.0, duration_s=duration_s,
+                         n_sessions=16, decode_tokens_mean=20.0)
+    prog = S.Program(tc, [
+        S.FlashCrowd(at_s=1.5, ramp_s=0.8, hold_s=3.0, magnitude=3.0),
+    ], seed=seed)
+    # Prohibitive recovery thresholds + force_level pin the rung so the
+    # sweep measures the rung's policy, not the ladder dynamics.
+    ladder = LadderConfig(
+        dispatch_error_streak=10_000, recover_streak=10_000,
+        min_dwell_s=1e9, probe_interval_s=1e9,
+        serve_min_samples=10_000, **ladder_kw)
+    eng = StormEngine(
+        prog, pool=PoolSpec(n_pods=6),
+        cfg=EngineConfig(ttft_slo_s=2.5, ladder=ladder, force_rung=rung),
+        name=name)
+    try:
+        return eng.run().scorecard
+    finally:
+        eng.close()
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--weights", default="0,2,4,8,16,32",
-                        help="comma-separated cached_kv_weight candidates")
+    parser.add_argument("--sweep", default="cached-kv",
+                        choices=["cached-kv", "wrr-alpha"])
+    parser.add_argument("--values", default=None,
+                        help="comma-separated candidate values "
+                             "(defaults per sweep)")
     parser.add_argument("--seed", type=int, default=626262)
     parser.add_argument("--duration-s", type=float, default=8.0)
     parser.add_argument("--out", default=None,
@@ -35,34 +72,25 @@ def main() -> int:
     jax.config.update(
         "jax_platforms", os.environ.get("GIE_STORM_PLATFORM", "cpu"))
 
-    from gie_tpu.resilience.ladder import LadderConfig, Rung
-    from gie_tpu.storm import shapes as S
-    from gie_tpu.storm.engine import EngineConfig, PoolSpec, StormEngine
+    from gie_tpu.resilience.ladder import Rung
+
+    if args.sweep == "cached-kv":
+        values = args.values or "0,2,4,8,16,32"
+        knob, rung = "cached_kv_weight", int(Rung.CACHED)
+        scenario = "flash-crowd x3 @36qps, 6 pods, forced CACHED"
+    else:
+        values = args.values or "0,0.5,1,2,4"
+        knob, rung = "wrr_queue_alpha", int(Rung.ROUND_ROBIN)
+        scenario = "flash-crowd x3 @36qps, 6 pods, forced ROUND_ROBIN"
 
     rows = []
-    for w in [float(x) for x in args.weights.split(",")]:
-        tc = S.TrafficConfig(base_qps=36.0, duration_s=args.duration_s,
-                             n_sessions=16, decode_tokens_mean=20.0)
-        prog = S.Program(tc, [
-            S.FlashCrowd(at_s=1.5, ramp_s=0.8, hold_s=3.0, magnitude=3.0),
-        ], seed=args.seed)
-        # Prohibitive recovery thresholds + force_level pin the rung so
-        # the sweep measures the CACHED policy, not the ladder dynamics.
-        ladder = LadderConfig(
-            dispatch_error_streak=10_000, recover_streak=10_000,
-            min_dwell_s=1e9, probe_interval_s=1e9,
-            serve_min_samples=10_000, cached_kv_weight=w)
-        eng = StormEngine(
-            prog, pool=PoolSpec(n_pods=6),
-            cfg=EngineConfig(ttft_slo_s=2.5, ladder=ladder,
-                             force_rung=int(Rung.CACHED)),
-            name=f"cached-w{w:g}")
-        try:
-            card = eng.run().scorecard
-        finally:
-            eng.close()
+    for v in [float(x) for x in values.split(",")]:
+        card = _run_rung_storm(
+            seed=args.seed, duration_s=args.duration_s,
+            ladder_kw={knob: v}, rung=rung,
+            name=f"{args.sweep}-{v:g}")
         row = {
-            "cached_kv_weight": w,
+            knob: v,
             "goodput_tokens_per_s": round(card["goodput_tokens_per_s"], 1),
             "slo_attainment": round(card["slo_attainment"], 3),
             "ttft_p50_s": round(card["ttft_p50_s"], 3),
@@ -72,13 +100,12 @@ def main() -> int:
             "client_5xx": card["client_5xx"],
         }
         rows.append(row)
-        print(f"w={w:5g}  goodput={row['goodput_tokens_per_s']:8.1f} tok/s"
-              f"  slo={row['slo_attainment']:.3f}"
+        print(f"{knob}={v:5g}  goodput={row['goodput_tokens_per_s']:8.1f}"
+              f" tok/s  slo={row['slo_attainment']:.3f}"
               f"  p99={row['ttft_p99_s']:.3f}s"
               f"  completed={row['completed']}", file=sys.stderr)
-    artifact = {"sweep": "ladder-cached-kv-weight", "seed": args.seed,
-                "scenario": "flash-crowd x3 @36qps, 6 pods, forced CACHED",
-                "rows": rows}
+    artifact = {"sweep": f"ladder-{args.sweep}", "seed": args.seed,
+                "scenario": scenario, "rows": rows}
     if args.out:
         with open(args.out, "w", encoding="utf-8") as fh:
             json.dump(artifact, fh, indent=1)

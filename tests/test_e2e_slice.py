@@ -267,6 +267,17 @@ def test_flow_control_critical_not_held():
     try:
         from gie_tpu.extproc.server import PickRequest
 
+        # Warm pick (also critical — a non-critical warm pick would be
+        # HELD against the saturated pool): the first pick pays the
+        # multi-second jit compile of the cycle, which is not the claim
+        # under test. The TIMED pick below measures the hold decision —
+        # a held request waits hold_max_s (5 s); the bound catches that
+        # without flaking on compile time under CPU contention.
+        picker2.pick(
+            PickRequest(headers={mdkeys.OBJECTIVE_KEY: ["critical"]},
+                        body=b"x"),
+            ds2.endpoints(),
+        )
         start = time.monotonic()
         res = picker2.pick(
             PickRequest(headers={mdkeys.OBJECTIVE_KEY: ["critical"]}, body=b"x"),

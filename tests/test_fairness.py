@@ -1,0 +1,427 @@
+"""gie-fair unit suite (ISSUE 11, docs/FAIRNESS.md): weighted-DRR
+ordering invariants (seeded property fuzz), budget ledgers + the
+over-fair-share verdict, the bounded-cardinality tenant labeler, and
+the picker's preemptive per-tenant shed.
+
+The DRR invariants pinned here are the flow queue's contract:
+
+  * the output is a permutation of the input;
+  * criticality bands drain strictly CRITICAL -> STANDARD -> SHEDDABLE;
+  * per-tenant FIFO is preserved within a band;
+  * long-run drained-cost shares converge to the configured weight
+    ratios while tenants stay backlogged;
+  * empty / single-item / single-tenant inputs degenerate to FIFO.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from gie_tpu.fairness import FairnessState, parse_weights
+from gie_tpu.fairness.budgets import TenantBudgets, WindowedSum
+from gie_tpu.fairness.drr import DeficitRoundRobin, FairnessConfig
+
+
+class Item:
+    __slots__ = ("band", "tenant", "cost", "seq")
+
+    def __init__(self, band, tenant, cost=1.0, seq=0):
+        self.band = band
+        self.tenant = tenant
+        self.cost = cost
+        self.seq = seq
+
+    def __repr__(self):
+        return f"Item(b{self.band},{self.tenant},c{self.cost},#{self.seq})"
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ==========================================================================
+# DRR ordering invariants
+# ==========================================================================
+
+
+def _check_invariants(items, out):
+    assert sorted(map(id, out)) == sorted(map(id, items)), "not a permutation"
+    bands = [it.band for it in out]
+    assert bands == sorted(bands), "band ordering not strict"
+    seen: dict[tuple, list] = {}
+    for it in out:
+        seen.setdefault((it.band, it.tenant), []).append(it.seq)
+    for key, seqs in seen.items():
+        assert seqs == sorted(seqs), f"per-tenant FIFO broken for {key}"
+
+
+def test_fuzz_invariants_random_mixes():
+    rng = np.random.default_rng(20260804)
+    for trial in range(25):
+        n_tenants = int(rng.integers(1, 7))
+        weights = {f"w{t}": float(rng.uniform(0.5, 4.0))
+                   for t in range(n_tenants) if rng.random() < 0.5}
+        drr = DeficitRoundRobin(FairnessConfig(weights=weights))
+        counters: dict[tuple, int] = {}
+        for wave in range(3):  # persistent state across waves
+            items = []
+            for _ in range(int(rng.integers(0, 60))):
+                band = int(rng.integers(1, 4))
+                tenant = f"w{int(rng.integers(n_tenants))}"
+                key = (band, tenant)
+                counters[key] = counters.get(key, 0) + 1
+                items.append(Item(band, tenant,
+                                  cost=float(rng.uniform(0.25, 8.0)),
+                                  seq=counters[key]))
+            take = int(rng.integers(0, len(items) + 2)) if items else 0
+            out = drr.order(items, take=take)
+            _check_invariants(items, out)
+
+
+def test_degenerate_cases():
+    drr = DeficitRoundRobin()
+    assert drr.order([]) == []
+    one = Item(2, "a", 1.0, 0)
+    assert drr.order([one]) == [one]
+    # Single tenant: plain FIFO regardless of costs.
+    items = [Item(2, "a", float(c), i) for i, c in enumerate([8, 1, 4, 2])]
+    assert drr.order(items) == items
+    assert drr.deficits() == {}
+
+
+def test_weighted_share_convergence_over_waves():
+    """Two permanently-backlogged equal-cost tenants at weights 3:1
+    drain ~3:1 over many waves (the persistent-deficit carry)."""
+    drr = DeficitRoundRobin(FairnessConfig(weights={"a": 3.0, "b": 1.0}))
+    pending: list = []
+    seqs = {"a": 0, "b": 0}
+    drained = {"a": 0, "b": 0}
+    for wave in range(50):
+        for t in ("a", "b"):
+            while sum(1 for it in pending if it.tenant == t) < 16:
+                seqs[t] += 1
+                pending.append(Item(2, t, 1.0, seqs[t]))
+        pending = drr.order(pending, take=8)
+        batch, pending = pending[:8], pending[8:]
+        for it in batch:
+            drained[it.tenant] += 1
+    ratio = drained["a"] / max(drained["b"], 1)
+    assert 2.4 < ratio < 3.6, drained
+
+
+def test_cost_weighted_shares_equal_cost_not_equal_count():
+    """Uniform weights + 4x cost asymmetry: drained COST equalizes, so
+    the big-request tenant gets ~1/4 the SLOTS — the exact hole the
+    count-RR seed had."""
+    drr = DeficitRoundRobin()
+    pending: list = []
+    seqs = {"big": 0, "small": 0}
+    cost_drained = {"big": 0.0, "small": 0.0}
+    for wave in range(40):
+        for t, c in (("big", 4.0), ("small", 1.0)):
+            while sum(1 for it in pending if it.tenant == t) < 24:
+                seqs[t] += 1
+                pending.append(Item(2, t, c, seqs[t]))
+        pending = drr.order(pending, take=10)
+        batch, pending = pending[:10], pending[10:]
+        for it in batch:
+            cost_drained[it.tenant] += it.cost
+    ratio = cost_drained["big"] / cost_drained["small"]
+    assert 0.7 < ratio < 1.4, cost_drained
+
+
+def test_bands_drain_strictly_before_fairness():
+    drr = DeficitRoundRobin()
+    items = ([Item(3, "flood", 1.0, i) for i in range(8)]
+             + [Item(2, "std", 1.0, i) for i in range(2)]
+             + [Item(1, "crit", 1.0, 0)])
+    out = drr.order(items)
+    assert out[0].tenant == "crit"
+    assert [it.band for it in out[:3]] == [1, 2, 2]
+
+
+def test_deficit_state_bounded_and_reported():
+    drr = DeficitRoundRobin(FairnessConfig(max_tracked=4))
+    for wave in range(10):
+        items = [Item(2, f"t{wave}-{k}", 1.0, i)
+                 for k in range(3) for i in range(4)]
+        drr.order(items, take=3)
+    assert len(drr._deficit) <= 4 + 3  # cap + one wave's live tenants
+    for key, val in drr.deficits().items():
+        assert ":" in key and val >= 0.0
+
+
+# ==========================================================================
+# Budgets: windows, over-share verdict, labeler
+# ==========================================================================
+
+
+def test_windowed_sum_ages_out():
+    clock = Clock()
+    ws = WindowedSum(8.0)
+    ws.note(5.0, clock.t)
+    assert ws.total(clock.t) == 5.0
+    assert ws.total(clock.t + 4.0) == 5.0
+    assert ws.total(clock.t + 20.0) == 0.0
+
+
+def _budgets(clock, **cfg_kw):
+    cfg = dict(window_s=8.0, eval_interval_s=0.0001, top_k=2)
+    cfg.update(cfg_kw)
+    return TenantBudgets(FairnessConfig(**cfg), clock=clock)
+
+
+def test_over_share_flags_flooder_not_balanced_pair():
+    clock = Clock()
+    b = _budgets(clock)
+    for _ in range(90):
+        b.note_arrival("hog", 1.0)
+    for _ in range(10):
+        b.note_arrival("quiet", 1.0)
+    clock.t += 0.01
+    over = b.over_share_set()
+    assert "hog" in over and "quiet" not in over
+    # Balanced pair: nobody over (factor 2 x fair share 0.5 = 1.0).
+    b2 = _budgets(clock)
+    for _ in range(50):
+        b2.note_arrival("a", 1.0)
+        b2.note_arrival("b", 1.0)
+    clock.t += 0.01
+    assert b2.over_share_set() == frozenset()
+
+
+def test_over_share_never_flags_a_lone_tenant():
+    clock = Clock()
+    b = _budgets(clock)
+    for _ in range(200):
+        b.note_arrival("only", 4.0)
+    clock.t += 0.01
+    assert b.over_share_set() == frozenset()
+
+
+def test_over_share_respects_weights():
+    clock = Clock()
+    b = _budgets(clock, weights={"paid": 8.0})
+    # "paid" offers 6x the neighbor — but its weight entitles it to 8/9.
+    for _ in range(60):
+        b.note_arrival("paid", 1.0)
+    for _ in range(10):
+        b.note_arrival("small", 1.0)
+    clock.t += 0.01
+    assert "paid" not in b.over_share_set()
+
+
+def test_over_share_ages_out_with_the_window():
+    clock = Clock()
+    b = _budgets(clock)
+    for _ in range(90):
+        b.note_arrival("hog", 1.0)
+    b.note_arrival("quiet", 1.0)
+    clock.t += 0.01
+    assert "hog" in b.over_share_set()
+    clock.t += 30.0  # the flood ages out entirely
+    b.note_arrival("quiet", 1.0)
+    assert b.over_share_set() == frozenset()
+
+
+def test_labeler_top_k_other_and_default():
+    clock = Clock()
+    b = _budgets(clock, top_k=2)
+    for _ in range(300):
+        b.note_arrival("big1", 1.0)
+    for _ in range(200):
+        b.note_arrival("big2", 1.0)
+    for i in range(40):
+        b.note_arrival(f"tail{i}", 1.0)
+    assert b.label("big1") == "big1"
+    assert b.label("big2") == "big2"
+    assert b.label("tail3") == "other"
+    assert b.label("never-seen") == "other"
+    assert b.label("") == "default"
+
+
+def test_labeler_cardinality_hard_cap():
+    """Adversarial tenant churn cannot mint unbounded label values: at
+    most label_cap (4 x top_k) distinct tenants are ever promoted."""
+    clock = Clock()
+    b = _budgets(clock, top_k=2, max_tracked=16)
+    promoted = set()
+    for round_ in range(60):
+        t = f"churn{round_}"
+        for _ in range(300):  # each churn tenant becomes top-traffic
+            b.note_arrival(t, 1.0)
+        label = b.label(t)
+        if label not in ("other", "default"):
+            promoted.add(label)
+        clock.t += 10.0  # previous rounds age out of the window
+    assert len(promoted) <= 8  # label_cap = 4 * top_k
+
+
+def test_report_shape():
+    clock = Clock()
+    b = _budgets(clock)
+    b.note_arrival("a", 2.0)
+    b.note_drained("a", 2.0)
+    b.note_shed("a")
+    b.note_serve("a", ok=False)
+    rep = b.report()
+    row = rep["tenants"]["a"]
+    assert row["requests_total"] == 1
+    assert row["arrival_cost_w"] == 2.0
+    assert row["drained_cost_w"] == 2.0
+    assert row["shed_samples_w"] == 2  # 1 arrival + 1 shed
+    # A fully-shed tenant reads 1.0, not 0.5: the shed request notes
+    # BOTH an arrival and a shed, and the rate is sheds/ARRIVALS.
+    assert row["shed_rate_w"] == 1.0
+    assert row["serve_error_rate_w"] == 1.0
+    assert rep["window_s"] == 8.0
+    # Half-shed tenant: 4 arrivals, 2 sheds -> 0.5.
+    b2 = _budgets(clock)
+    for _ in range(4):
+        b2.note_arrival("h", 1.0)
+    b2.note_shed("h")
+    b2.note_shed("h")
+    assert b2.report()["tenants"]["h"]["shed_rate_w"] == 0.5
+
+
+def test_parse_weights():
+    assert parse_weights(["a=2", "b=0.5,c=1.5"]) == {
+        "a": 2.0, "b": 0.5, "c": 1.5}
+    assert parse_weights([]) == {}
+    with pytest.raises(ValueError, match="TENANT=WEIGHT"):
+        parse_weights(["nope"])
+    with pytest.raises(ValueError, match="not a number"):
+        parse_weights(["a=fast"])
+    with pytest.raises(ValueError, match="> 0"):
+        parse_weights(["a=0"])
+
+
+# ==========================================================================
+# Picker integration: preemptive shed + tenants_report
+# ==========================================================================
+
+
+def _picker_stack(**picker_kw):
+    from gie_tpu.datastore import Datastore
+    from gie_tpu.datastore.objects import EndpointPool, Pod
+    from gie_tpu.metricsio import MetricsStore
+    from gie_tpu.sched import ProfileConfig, Scheduler
+    from gie_tpu.sched.batching import BatchingTPUPicker
+
+    sched = Scheduler(ProfileConfig(load_decay=1.0, queue_limit=4.0))
+    ms = MetricsStore()
+    ds = Datastore(on_slot_reclaimed=lambda s: (sched.evict_endpoint(s),
+                                                ms.remove(s)))
+    ds.pool_set(EndpointPool({"app": "x"}, [8000], "default"))
+    for i in range(2):
+        ds.pod_update_or_add(
+            Pod(name=f"p{i}", labels={"app": "x"}, ip=f"10.9.0.{i + 1}"))
+    picker = BatchingTPUPicker(sched, ds, ms, **picker_kw)
+    return sched, ds, ms, picker
+
+
+def _pending(band_name, tenant, body=b"x" * 256):
+    from gie_tpu.extproc import metadata as mdkeys
+    from gie_tpu.extproc.server import PickRequest
+    from gie_tpu.sched.batching import _Pending
+
+    headers = {mdkeys.OBJECTIVE_KEY: [band_name]}
+    if tenant:
+        headers[mdkeys.FLOW_FAIRNESS_ID_KEY] = [tenant]
+    return _Pending(PickRequest(headers=headers, body=body),
+                    candidates=[type("E", (), {"slot": 0})()])
+
+
+def test_preemptive_shed_targets_over_share_sheddable_only():
+    from gie_tpu.extproc.server import ShedError
+    from gie_tpu.sched import constants as C
+
+    sched, ds, ms, picker = _picker_stack()
+    try:
+        # Saturate every slot in the fairness path's view.
+        picker.metrics_store.host_queue_depths = (
+            lambda: np.full(C.M_MAX, 100.0))
+        # "hog" floods the offered-cost ledger; "quiet" trickles.
+        for _ in range(90):
+            picker.fairness.note_arrival("hog", 1.0)
+        picker.fairness.note_arrival("quiet", 1.0)
+        over = picker.fairness.over_share_set()
+        assert "hog" in over
+        batch = [
+            _pending("sheddable", "hog"),
+            _pending("sheddable", "quiet"),
+            _pending("standard", "hog"),
+            _pending("critical", "hog"),
+        ]
+        kept = picker._preemptive_shed(batch, over)
+        # Only the over-share tenant's SHEDDABLE item was shed.
+        assert kept == batch[1:]
+        err = batch[0].error
+        assert isinstance(err, ShedError)
+        assert err.tenant == "hog"
+        assert batch[0].event.is_set()
+    finally:
+        picker.close()
+
+
+def test_preemptive_shed_spares_everyone_without_saturation():
+    from gie_tpu.sched import constants as C
+
+    sched, ds, ms, picker = _picker_stack()
+    try:
+        picker.metrics_store.host_queue_depths = (
+            lambda: np.zeros(C.M_MAX))  # free capacity everywhere
+        for _ in range(90):
+            picker.fairness.note_arrival("hog", 1.0)
+        picker.fairness.note_arrival("quiet", 1.0)
+        over = picker.fairness.over_share_set()
+        batch = [_pending("sheddable", "hog")]
+        assert picker._preemptive_shed(batch, over) == batch
+        assert batch[0].error is None
+    finally:
+        picker.close()
+
+
+def test_tenants_report_explains_queue_and_budgets():
+    sched, ds, ms, picker = _picker_stack()
+    try:
+        picker.fairness.note_arrival("a", 1.0)
+        picker.fairness.note_shed("a", "sheddable")
+        with picker._cond:
+            picker._pending.append(_pending("standard", "a"))
+        rep = picker.tenants_report()
+        assert rep["queue"] == {"a": {"standard": 1}}
+        assert rep["queue_depth"] == 1
+        assert "a" in rep["tenants"]
+        assert rep["tenants"]["a"]["requests_total"] == 1
+        assert "deficits" in rep and "weights" in rep
+        with picker._cond:
+            picker._pending.clear()
+    finally:
+        picker.close()
+
+
+def test_fairness_state_metrics_use_bounded_labels():
+    """gie_tenant_* series go through the labeler: a long-tail tenant's
+    series lands on 'other', the empty ID on 'default'."""
+    from gie_tpu.runtime import metrics as own_metrics
+
+    state = FairnessState(FairnessConfig(top_k=1))
+    for _ in range(50):
+        state.note_arrival("whale", 1.0)
+    state.note_arrival("minnow", 1.0)
+    state.note_arrival("", 1.0)
+    reg = own_metrics.REGISTRY
+    assert reg.get_sample_value(
+        "gie_tenant_requests_total", {"tenant": "whale"}) >= 50
+    assert reg.get_sample_value(
+        "gie_tenant_requests_total", {"tenant": "other"}) >= 1
+    assert reg.get_sample_value(
+        "gie_tenant_requests_total", {"tenant": "default"}) >= 1
+    assert reg.get_sample_value(
+        "gie_tenant_requests_total", {"tenant": "minnow"}) is None

@@ -500,6 +500,125 @@ def test_debugz_gate_returns_403_not_404(monkeypatch):
         srv.close()
 
 
+def test_debugz_token_authenticates_remote_peers(monkeypatch):
+    """ISSUE 11 satellite (docs/OBSERVABILITY.md "bind hardening"):
+    with --debugz-token set, a NON-loopback peer must present the
+    bearer token on /debugz paths — 401 without it or with a wrong one,
+    200 with it — while loopback access needs no token and /metrics is
+    untouched either way."""
+    import urllib.error
+
+    srv = DebugzServer(0, own_metrics.REGISTRY,
+                       {"ping": lambda q: {"ok": True}},
+                       bind="127.0.0.1", debugz_token="s3cret-tok")
+    try:
+        # Loopback peer: no token needed (unchanged default).
+        assert _get(srv.port, "/debugz/ping")[0] == 200
+        # Simulate a remote peer (a non-loopback client cannot be faked
+        # over lo; the peer predicate is the seam, same as the bind
+        # tests above).
+        monkeypatch.setattr(srv, "_peer_is_loopback", lambda peer: False)
+        for headers in ({}, {"Authorization": "Bearer wrong"},
+                        {"Authorization": "Basic s3cret-tok"}):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/debugz/ping", headers=headers)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 401, headers
+        good = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/debugz/ping",
+            headers={"Authorization": "Bearer s3cret-tok"})
+        with urllib.request.urlopen(good, timeout=5) as resp:
+            assert resp.status == 200
+        # The exposition never needs the token.
+        assert _get(srv.port, "/metrics")[0] == 200
+    finally:
+        srv.close()
+
+
+def test_debugz_token_overrides_bind_opt_out(monkeypatch):
+    """A non-loopback --debugz-bind normally opens the gate; with a
+    token configured the token still gates remote peers — exposing
+    /debugz off-loopback WITH auth is the feature."""
+    import urllib.error
+
+    srv = DebugzServer(0, own_metrics.REGISTRY,
+                       {"ping": lambda q: {"ok": True}},
+                       bind="127.0.0.1", debugz_bind="0.0.0.0",
+                       debugz_token="tok")
+    try:
+        monkeypatch.setattr(srv, "_peer_is_loopback", lambda peer: False)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.port, "/debugz/ping")
+        assert ei.value.code == 401
+    finally:
+        srv.close()
+
+
+def test_tenant_sample_rate_overrides_fleet_rate():
+    """--obs-tenant-sample (ISSUE 11): a mapped tenant's rate replaces
+    the fleet head-sampling decision — 1.0 always keeps, 0.0 always
+    drops — deterministically per trace ID, while unmapped tenants keep
+    the fleet verdict."""
+    tracer = Tracer(0.0, seed=5,
+                    tenant_rates={"noisy": 1.0, "spammy": 0.0})
+    ids = [f"{i:032x}" for i in range(50)]
+
+    def begin(tid, tenant=None):
+        headers = {"traceparent": [f"00-{tid}-" + "cd" * 8 + "-01"]}
+        if tenant is not None:
+            headers[mdkeys.FLOW_FAIRNESS_ID_KEY] = [tenant]
+        return tracer.begin(headers)
+
+    # Fleet rate 0: unmapped traffic never head-samples.
+    assert not any(begin(t).sampled for t in ids)
+    assert not any(begin(t, "unmapped").sampled for t in ids)
+    # The noisy tenant samples at 1.0; the spammy one never.
+    assert all(begin(t, "noisy").sampled for t in ids)
+    assert not any(begin(t, "spammy").sampled for t in ids)
+    # Fractional override is deterministic per trace ID.
+    frac_tracer = Tracer(0.0, seed=5, tenant_rates={"some": 0.5})
+    v1 = [frac_tracer.begin({
+        "traceparent": [f"00-{t}-" + "cd" * 8 + "-01"],
+        mdkeys.FLOW_FAIRNESS_ID_KEY: ["some"]}).sampled for t in ids]
+    v2 = [frac_tracer.begin({
+        "traceparent": [f"00-{t}-" + "cd" * 8 + "-01"],
+        mdkeys.FLOW_FAIRNESS_ID_KEY: ["some"]}).sampled for t in ids]
+    assert v1 == v2 and any(v1) and not all(v1)
+    with pytest.raises(ValueError, match="tenant sample rate"):
+        Tracer(0.0, tenant_rates={"bad": 1.5})
+
+
+def test_serve_latency_exemplar_links_bucket_to_trace():
+    """ISSUE 11 satellite: the serve-outcome hop attaches a trace-ID
+    exemplar to gie_serve_latency_seconds for head-sampled requests,
+    mirroring the admission/pick exemplar wiring."""
+    from gie_tpu.obs.trace import TraceCtx
+
+    sched, ds, ms, picker = _stack(n_pods=2)
+    try:
+        tr = TraceCtx("fe" * 16, "", sampled=True, started=time.monotonic())
+        picker._note_serve_outcome("10.9.0.1:8000", ok=True, cls="2xx",
+                                   latency_s=0.033, trace=tr)
+        # Unsampled and trace-less observations stay exemplar-free.
+        un = TraceCtx("ad" * 16, "", sampled=False, started=time.monotonic())
+        picker._note_serve_outcome("10.9.0.1:8000", ok=True, cls="2xx",
+                                   latency_s=0.040, trace=un)
+        picker._note_serve_outcome("10.9.0.1:8000", ok=True, cls="2xx",
+                                   latency_s=0.050)
+    finally:
+        picker.close()
+    from prometheus_client.openmetrics.exposition import generate_latest
+
+    text = generate_latest(own_metrics.REGISTRY).decode()
+    line = next(
+        (ln for ln in text.splitlines()
+         if ln.startswith("gie_serve_latency_seconds_bucket")
+         and f'trace_id="{"fe" * 16}"' in ln), None)
+    assert line is not None, "serve bucket carries no trace exemplar"
+    assert f'trace_id="{"ad" * 16}"' not in text
+
+
 def test_admission_exemplar_links_bucket_to_trace():
     tracer = Tracer(1.0, slow_s=10.0)
     obs.install(tracer=tracer)

@@ -186,6 +186,181 @@ def test_program_from_drive_rejects_unknown_traffic_fields():
 
 
 # ==========================================================================
+# Tenant shapes (gie-fair, ISSUE 11): Zipf mix, pinned VIP, abuser algebra
+# ==========================================================================
+
+
+def test_tenant_mix_zipf_head_heavy_and_bounded():
+    mix = S.TenantMix(tenants=5, zipf_a=1.2)
+    sched = S.Program(
+        S.TrafficConfig(base_qps=60.0, duration_s=5.0), [mix],
+        seed=6).compile()
+    assert all(a.tenant is not None for a in sched.arrivals)
+    counts = {}
+    for a in sched.arrivals:
+        counts[a.tenant] = counts.get(a.tenant, 0) + 1
+    assert set(counts) <= {f"t{k}" for k in range(5)}
+    assert counts["t0"] > counts.get("t4", 0), counts  # head-heavy
+
+
+def test_pinned_tenant_owns_share_and_band():
+    sched = S.Program(
+        S.TrafficConfig(base_qps=60.0, duration_s=5.0, critical_fraction=0.0),
+        [S.TenantMix(tenants=4), S.PinnedTenant("vip", share=0.2,
+                                                band="critical")],
+        seed=3).compile()
+    vip = [a for a in sched.arrivals if a.tenant == "vip"]
+    frac = len(vip) / len(sched.arrivals)
+    assert 0.12 < frac < 0.3, frac
+    assert all(a.band == "critical" for a in vip)
+    # Nobody else inherited the pinned band.
+    assert not [a for a in sched.arrivals
+                if a.tenant != "vip" and a.band == "critical"]
+
+
+def test_abusive_tenant_rate_algebra_preserves_victims():
+    """The noisy-neighbor contract: inside the abuse window the abuser's
+    own rate is ~rate_x times its base share while every OTHER tenant's
+    absolute arrival rate stays unchanged — and stolen arrivals re-draw
+    the abuser's band mix, never keeping a victim's CRITICAL band."""
+    abuse = S.AbusiveTenant("abuser", share=0.2, rate_x=10.0, at_s=0.0,
+                            ramp_s=0.0, hold_s=100.0,
+                            sheddable_fraction=1.0)
+    assert abuse.rate(1.0) == pytest.approx(1.0 + 0.2 * 9.0)
+    tc = S.TrafficConfig(base_qps=60.0, duration_s=6.0,
+                         critical_fraction=0.0, sheddable_fraction=0.0)
+    base = S.Program(tc, [S.TenantMix(tenants=3)], seed=12).compile()
+    stormy = S.Program(
+        tc, [S.TenantMix(tenants=3),
+             S.PinnedTenant("vip", share=0.1, band="critical"),
+             abuse],
+        seed=12).compile()
+    n_abuse = sum(1 for a in stormy.arrivals if a.tenant == "abuser")
+    others = len(stormy.arrivals) - n_abuse
+    # Victims' absolute volume ~= the no-abuse compile's volume (same
+    # seed; the Poisson draws differ, so bounds are loose).
+    assert 0.75 < others / len(base.arrivals) < 1.25
+    # The abuser carries ~share*rate_x/(1+share*(x-1)) of the total.
+    frac = n_abuse / len(stormy.arrivals)
+    assert 0.55 < frac < 0.85, frac
+    # Stolen arrivals re-drew the abuser band mix: no critical abuser.
+    assert all(a.band == "sheddable"
+               for a in stormy.arrivals if a.tenant == "abuser")
+
+
+def test_tenant_shapes_in_registry():
+    built = S.shapes_from_specs([
+        {"kind": "tenant_mix", "tenants": 4},
+        {"kind": "pinned_tenant", "tenant": "vip", "share": 0.1},
+        {"kind": "abusive_tenant", "tenant": "x", "share": 0.1,
+         "rate_x": 5.0},
+    ])
+    assert isinstance(built[0], S.TenantMix)
+    assert isinstance(built[1], S.PinnedTenant)
+    assert isinstance(built[2], S.AbusiveTenant)
+
+
+# ==========================================================================
+# Engine: the noisy-neighbor isolation storm (ISSUE 11 acceptance)
+# ==========================================================================
+
+
+def _solo_baseline_path(tmp_path) -> str:
+    """storm-noisy-neighbor minus the abusive_tenant shape, same seed:
+    the victim's solo world."""
+    from gie_tpu.resilience import scenarios
+
+    scn = scenarios.load("storm-noisy-neighbor")
+    with open(scn.path, "r", encoding="utf-8") as fh:
+        raw = json.load(fh)
+    raw["name"] = "storm-noisy-neighbor-solo"
+    raw["drive"]["storm"]["shapes"] = [
+        s for s in raw["drive"]["storm"]["shapes"]
+        if s["kind"] != "abusive_tenant"]
+    path = str(tmp_path / "storm-noisy-neighbor-solo.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(raw, fh)
+    return path
+
+
+def test_storm_noisy_neighbor_isolates_victim(tmp_path):
+    """The ROADMAP item-5 pinned property: one tenant flooding at 20x
+    its base rate saturates the pool, the weighted-DRR queue + the
+    over-fair-share preemptive shed land the 429s on the ABUSER's
+    SHEDDABLE traffic, zero CRITICAL-band sheds happen while lower
+    bands hold queued work, and the latency-sensitive CRITICAL victim's
+    p99/SLO attainment stay within tolerance of its same-seed solo
+    baseline."""
+    from gie_tpu.storm.engine import run_scenario
+
+    result = run_scenario("storm-noisy-neighbor", dump_dir=str(tmp_path))
+    card = result.scorecard
+    if card["shed"] < 10:
+        # Real-time engine on a loaded box: the submitter can fall
+        # behind its own flood. One seeded retry keeps the claim strict
+        # (same pattern as storm-capacity).
+        result = run_scenario("storm-noisy-neighbor", seed=747474,
+                              dump_dir=str(tmp_path))
+        card = result.scorecard
+    assert card["client_5xx"] == 0, card["client_5xx_detail"]
+    assert card["resets"] == 0 and card["timeouts"] == 0
+    assert card["shed"] >= 10, (
+        "the 20x flood never saturated — not a noisy-neighbor storm")
+    # (a) The abuser absorbs the sheds; its own SHEDDABLE band eats them.
+    per = card["per_tenant"]
+    abuser = per["abuser"]
+    assert abuser["shed"] / card["shed"] >= 0.6, per
+    assert card["shed_by_band"].get("critical", 0) == 0
+    assert card["shed_by_band"].get("sheddable", 0) >= abuser["shed"]
+    # (b) CRITICAL never sheds: the vip tenant got every answer.
+    vip = per["vip"]
+    assert vip["shed"] == 0 and vip["client_5xx"] == 0
+    assert vip["completed"] > 5
+    # (c) Victim isolation vs the same-seed solo baseline.
+    solo = run_scenario(
+        _solo_baseline_path(tmp_path),
+        dump_dir=str(tmp_path)).scorecard["per_tenant"]["vip"]
+    assert solo["completed"] > 5
+    assert vip["slo_attainment"] >= solo["slo_attainment"] - 0.2, (
+        vip, solo)
+    # p99 tolerance: small absolute baselines get an absolute floor; the
+    # flood must not push the victim's p99 past its SLO-scale budget.
+    assert vip["ttft_p99_s"] <= max(4.0 * solo["ttft_p99_s"],
+                                    solo["ttft_p99_s"] + 2.0), (vip, solo)
+
+
+def test_noisy_neighbor_tenant_zpage_explains_the_abuser():
+    """/debugz/tenants end-to-end (ISSUE 11 acceptance): after a
+    saturated tenant mix, the picker's tenants_report names the abuser
+    over-share, shows its shed rate, and carries the DRR/weight state."""
+    from gie_tpu.storm.engine import EngineConfig, PoolSpec, StormEngine
+
+    prog = S.Program(
+        S.TrafficConfig(base_qps=40.0, duration_s=4.0,
+                        sheddable_fraction=0.5, critical_fraction=0.0),
+        [S.TenantMix(tenants=3),
+         S.AbusiveTenant("abuser", share=0.15, rate_x=15.0, at_s=0.5,
+                         ramp_s=0.5, hold_s=3.0)],
+        seed=21)
+    eng = StormEngine(prog, pool=PoolSpec(n_pods=3),
+                      cfg=EngineConfig(queue_limit=3.0),
+                      name="nn-zpage")
+    try:
+        eng.run()
+        rep = eng.picker.tenants_report()
+    finally:
+        eng.close()
+    assert "abuser" in rep["tenants"], rep["tenants"].keys()
+    row = rep["tenants"]["abuser"]
+    assert row["requests_total"] > 50
+    assert row["arrival_cost_w"] >= 0.0
+    assert "weights" in rep and "deficits" in rep and "queue" in rep
+    # The flood was over-share at SOME point; the report records the
+    # windowed view — assert the ledger fields exist and are sane.
+    assert 0.0 <= row["shed_rate_w"] <= 1.0
+
+
+# ==========================================================================
 # Outlier ejection: deterministic-clock hysteresis units
 # ==========================================================================
 
@@ -523,11 +698,14 @@ def test_storm_capacity_sheds_and_scales_under_overload(tmp_path):
 
     result = run_scenario("storm-capacity", dump_dir=str(tmp_path))
     card = result.scorecard
-    if card["shed"] == 0:
+    if (card["shed"] == 0
+            or max(n for _, n in card["pool_size_trace"]) <= 4):
         # The engine runs in REAL time: on a heavily loaded box the
         # submitter can fall behind its own crowd (client_skipped eats
-        # the overload before the stubs queue). One seeded retry keeps
-        # the claim strict — a genuine shed-path regression fails both
+        # the overload before the stubs queue — so either nothing sheds,
+        # or the shed rate stays under the autoscale fast-up threshold
+        # and the pool never grows). One seeded retry keeps the claims
+        # strict — a genuine shed-/autoscale-path regression fails both
         # runs — without flaking on CPU contention.
         result = run_scenario("storm-capacity", seed=515152,
                               dump_dir=str(tmp_path))
@@ -559,12 +737,21 @@ def test_storm_scenarios_ship_in_the_library():
     from gie_tpu.resilience import scenarios
 
     names = scenarios.list_scenarios()
-    assert {"storm-flash-upgrade", "storm-soak"} <= set(names)
+    assert {"storm-flash-upgrade", "storm-soak",
+            "storm-noisy-neighbor"} <= set(names)
     for name in ("storm-flash-upgrade", "storm-soak"):
         scn = scenarios.load(name)
         prog = S.program_from_drive(scn.drive["storm"], seed=scn.seed)
         sched = prog.compile()
         assert sched.arrivals and sched.events
+    # The noisy-neighbor storm is traffic-only (no control-plane shapes
+    # -> no events); its arrivals must carry the tenant decorations.
+    scn = scenarios.load("storm-noisy-neighbor")
+    sched = S.program_from_drive(scn.drive["storm"],
+                                 seed=scn.seed).compile()
+    assert sched.arrivals and not sched.events
+    tenants = {a.tenant for a in sched.arrivals}
+    assert "abuser" in tenants and "vip" in tenants
 
 
 # ==========================================================================
