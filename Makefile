@@ -38,9 +38,11 @@ fuzz-smoke: native-asan
 	native/fuzz/bin/fuzz_chunker   -max_total_time=$(FUZZ_SECS) native/fuzz/corpus/chunker
 
 # Fast chaos gate (docs/RESILIENCE.md): the recorded scenario library
-# (serve-5xx storm, reset storm, rolling upgrade) plus the fast chaos
-# scenarios, deterministic seeds only — cheap enough to sit next to
-# `make lint` in the test gate. The slow soak stays in chaos-smoke.
+# (serve-5xx storm, reset storm, rolling upgrade, and the gie-fed
+# federation scenarios fed-partition / fed-split-brain-heal —
+# docs/FEDERATION.md) plus the fast chaos scenarios, deterministic
+# seeds only — cheap enough to sit next to `make lint` in the test
+# gate. The slow soak stays in chaos-smoke.
 chaos-ci:
 	$(PY) -m pytest tests/test_scenarios.py tests/test_chaos.py -q -m 'not slow'
 
@@ -54,10 +56,13 @@ chaos-smoke: chaos-ci
 # gie-storm gate (docs/STORM.md): the fast deterministic storm suite —
 # schedule determinism/composition units plus the seeded acceptance
 # storms (storm-flash-upgrade composed run, storm-capacity overload,
-# the outlier-ejection storm) driven through the REAL stack. Arrival
-# schedules are bit-identical per seed; a failure is a degrade-and-
-# recover regression, not flake. The slow multi-phase soak lives in
-# storm-smoke.
+# the outlier-ejection storm, and the gie-fed federation storms
+# storm-fed-spill / storm-fed-drain / storm-fed-partition —
+# docs/FEDERATION.md: spillover, drain bleed, partition + split-brain
+# convergence, all zero client 5xx) driven through the REAL stack.
+# Arrival schedules are bit-identical per seed; a failure is a
+# degrade-and-recover regression, not flake. The slow multi-phase soak
+# lives in storm-smoke.
 storm-ci:
 	$(PY) -m pytest tests/test_storm.py -q -m 'not slow'
 

@@ -413,3 +413,39 @@ def pool_from_dict(d: dict) -> InferencePool:
     )
     pool.status = _status_from_dict(d.get("status", {}) or {})
     return pool
+
+
+def import_to_dict(imp: InferencePoolImport) -> dict:
+    """InferencePoolImport -> k8s-manifest-shaped dict (the multi-cluster
+    controller writes these to importing clusters; docs/FEDERATION.md)."""
+    d = dataclasses.asdict(imp)
+    d["apiVersion"] = imp.apiVersion
+    d["kind"] = imp.kind
+    # A status-only CRD: clean_manifest would prune an EMPTY controllers
+    # list, but a present-and-empty status is the valid initial shape.
+    out = _clean(d)
+    out.setdefault("status", {})
+    return out
+
+
+def import_from_dict(d: dict) -> InferencePoolImport:
+    meta = d.get("metadata", {}) or {}
+    status = d.get("status", {}) or {}
+    controllers = []
+    for c in status.get("controllers", []) or []:
+        controllers.append(ImportController(
+            name=c.get("name", ""),
+            exportingClusters=[
+                ExportingCluster(name=e.get("name", ""))
+                for e in c.get("exportingClusters", []) or []
+            ],
+        ))
+    return InferencePoolImport(
+        metadata=ObjectMeta(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            labels=dict(meta.get("labels", {})),
+            annotations=dict(meta.get("annotations", {})),
+        ),
+        status=InferencePoolImportStatus(controllers=controllers),
+    )

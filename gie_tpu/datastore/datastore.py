@@ -266,10 +266,12 @@ class Datastore:
 
     def pick_candidates(self) -> list[Endpoint]:
         """Endpoints eligible for NEW picks: the cached snapshot minus
-        DRAINING slots. Falls back to the full set when every endpoint
-        is draining — availability beats drain, the same floor rule the
-        breaker filter uses (a pool mid-upgrade must keep answering).
-        Same immutability contract as endpoints()."""
+        DRAINING slots and minus IMPORTED peer endpoints (federation's
+        spill policy adds those per pick — default candidacy is local).
+        Availability ladder when filtering empties the set: draining
+        locals beat nothing, healthy remotes beat draining locals'
+        absence (a fully-drained local cluster must keep answering from
+        its peers). Same immutability contract as endpoints()."""
         snap = self._snapshot_ready  # GIL-atomic read; None after mutation
         if snap is not None:
             return snap
@@ -277,10 +279,111 @@ class Datastore:
             snap = self._snapshot_ready
             if snap is None:
                 eps = list(self._endpoints.values())
-                ready = [e for e in eps if not e.draining]
-                snap = ready if ready else eps
+                local = [e for e in eps if not e.cluster]
+                ready = [e for e in local if not e.draining]
+                if ready:
+                    snap = ready
+                elif local:
+                    snap = local
+                else:
+                    remote_ready = [e for e in eps
+                                    if e.cluster and not e.draining]
+                    snap = remote_ready if remote_ready else eps
                 self._snapshot_ready = snap
         return snap
+
+    def local_endpoints(self) -> list[Endpoint]:
+        """Locally-reconciled endpoints only (no federation imports):
+        the view the scrape engine, autoscale signals, and the HPA pool
+        gauges consume — peer capacity must never read as local
+        replicas."""
+        with self._lock:
+            return [e for e in self._endpoints.values() if not e.cluster]
+
+    # ---- federation imports (docs/FEDERATION.md) -------------------------
+
+    @staticmethod
+    def _external_key(cluster: str, name: str) -> str:
+        # "fed:" cannot collide with pod keys ("<ns>/<pod>-rank-<i>").
+        return f"fed:{cluster}/{name}"
+
+    def external_upsert(
+        self, cluster: str, name: str, address: str, port: int
+    ) -> Optional[Endpoint]:
+        """Admit/refresh one IMPORTED peer endpoint into the shared slot
+        space (InferencePoolImport Endpoint routing mode). Returns the
+        endpoint, or None when slot capacity is exhausted — local pods
+        keep priority and the import is skipped this round (the next
+        peer digest retries). No pool sync required: imports exist
+        independently of the local InferencePool."""
+        if not cluster:
+            raise ValueError("imported endpoints need a cluster name")
+        key = self._external_key(cluster, name)
+        hostport = f"{address}:{port}"
+        with self._lock:
+            existing = self._endpoints.get(key)
+            owner = self._by_hostport.get(hostport)
+            if owner is not None and owner is not existing:
+                # Hostport collision (overlapping pod CIDRs across
+                # clusters): the current owner wins — a LOCAL pod
+                # always, and between two imports the first one —
+                # because a second claimant would hijack serve-outcome
+                # attribution and, on its removal, delete the owner's
+                # hostport mapping.
+                return None
+            if existing is None:
+                slot = self._alloc_slot()
+                if slot is None:
+                    return None
+                ep = Endpoint(
+                    name=name,
+                    namespace="",
+                    pod_name="",
+                    address=address,
+                    port=port,
+                    rank=0,
+                    slot=slot,
+                    cluster=cluster,
+                )
+                self._endpoints[key] = ep
+                self._by_hostport[ep.hostport] = ep
+                self._snapshot = None
+                self._snapshot_ready = None
+                return ep
+            if self._by_hostport.get(existing.hostport) is existing:
+                del self._by_hostport[existing.hostport]
+            existing.address = address
+            existing.port = port
+            # Never shadow another endpoint that claimed the hostport
+            # between refreshes (owner wins, symmetric with the guard
+            # above).
+            cur = self._by_hostport.get(existing.hostport)
+            if cur is None or cur is existing:
+                self._by_hostport[existing.hostport] = existing
+            self._snapshot = None
+            self._snapshot_ready = None
+            return existing
+
+    def external_remove(self, cluster: str, name: str) -> None:
+        """Drop one imported endpoint (peer summary no longer lists it,
+        or the import was deleted). Slot reclaim runs the same callback
+        path pod eviction does."""
+        key = self._external_key(cluster, name)
+        with self._lock:
+            if key in self._endpoints:
+                self._remove_endpoint(key)
+        self._drain_reclaims()
+
+    def external_clear(self, cluster: str) -> int:
+        """Drop every imported endpoint of one peer cluster (the import
+        was deleted / the peer left the ClusterSet)."""
+        prefix = f"fed:{cluster}/"
+        with self._lock:
+            keys = [k for k in self._endpoints if k.startswith(prefix)]
+            for key in keys:
+                self._remove_endpoint(key)
+        self._drain_reclaims()
+        return len(keys)
 
     # ---- graceful drain --------------------------------------------------
 
@@ -348,6 +451,7 @@ class Datastore:
                         "name": ep.name,
                         "hostport": ep.hostport,
                         "slot": ep.slot,
+                        "cluster": ep.cluster or None,
                         "draining": bool(ep.draining),
                         "drain_remaining_s": (
                             round(max(ep.drain_until - now, 0.0), 2)
@@ -454,6 +558,8 @@ class Datastore:
                 admit.append(pod)
         for key in list(self._endpoints):
             ep = self._endpoints[key]
+            if ep.cluster:
+                continue  # imports are not pod-reconciled state
             if f"{ep.namespace}/{ep.pod_name}" not in matching:
                 self._remove_endpoint(key)
         return admit

@@ -59,6 +59,13 @@ class Endpoint:
     # drain_until (monotonic) or on actual pod deletion, whichever first.
     draining: bool = False
     drain_until: float = 0.0
+    # Multi-cluster federation (docs/FEDERATION.md): non-empty names the
+    # peer cluster this endpoint was IMPORTED from (InferencePoolImport,
+    # Endpoint routing mode). Imported endpoints share the local slot
+    # space and metrics rows but are excluded from default new-pick
+    # candidacy (the spill policy adds them), from pod reconciliation,
+    # and from the scrape engine (their rows come from peer digests).
+    cluster: str = ""
 
     @property
     def hostport(self) -> str:

@@ -112,6 +112,10 @@ class PickRequest:
     # the flow queue and wave so the scheduler stages can stamp events
     # and the flight-recorder record carries the trace ID.
     trace: object = None
+    # True when the candidate set came from an upstream subset filter /
+    # test-endpoint header: a pinned set is honored verbatim — the
+    # federation spill policy must never widen it (docs/FEDERATION.md).
+    subset: bool = False
 
 
 @dataclasses.dataclass(slots=True)
@@ -301,6 +305,10 @@ class RequestContext:
     # error); "" lets teardown derive it from the stream state.
     trace: object = None
     trace_outcome: str = ""
+    # Candidate set pinned by an upstream subset filter (strict
+    # subsetting): threaded into PickRequest.subset so the federation
+    # spill policy never widens it.
+    subset: bool = False
 
     def reset(self) -> None:
         """Return to the pristine state with FRESH containers (never
@@ -328,6 +336,7 @@ class RequestContext:
         self.resp_tail = b""
         self.resp_tail_truncated = False
         self.last_frame = None
+        self.subset = False
         self.timing_is_generation = False
         self.picked_at = 0.0
         self.resp_status = 0
@@ -885,6 +894,7 @@ class StreamingServer:
                 for ep in all_eps
                 if ep.address in allow_all_ports or ep.hostport in allowed
             ]
+            ctx.subset = True
             # Strict subsetting: empty candidate set stays empty
             # (request.go:130-133) -> UNAVAILABLE at pick time. Subset
             # hints stay on the FULL list — a steering decision made
@@ -993,6 +1003,7 @@ class StreamingServer:
                 decode_tokens=_decode_tokens(ctx.headers, parsed, scan),
                 deadline_at=ctx.deadline_at,
                 trace=ctx.trace,
+                subset=ctx.subset,
             ),
             ctx.candidates,
         )

@@ -171,6 +171,11 @@ class Tracer:
         self._slow: deque = deque(maxlen=keep)
         self.started_total = 0
         self.exported_total = 0
+        # Optional export sink (obs/otlp.py OtlpSpanExporter.export):
+        # called OUTSIDE the feed lock with the finished trace dict. The
+        # contract is enqueue-only — the sink must never block (the OTLP
+        # exporter batches on its own thread).
+        self.on_export = None
 
     # -- request path ------------------------------------------------------
 
@@ -243,6 +248,12 @@ class Tracer:
             if is_slow:
                 self._slow.append(trace)
             self.exported_total += 1
+        sink = self.on_export
+        if sink is not None:
+            try:
+                sink(trace)
+            except Exception:
+                pass  # span export must never fail a request teardown
 
     # -- zpage reads -------------------------------------------------------
 

@@ -60,6 +60,16 @@ CATALOG = {
     "endpoint.reset":
         "upstream stream reset before response headers — the abort-as-"
         "reset path (extproc/server.py)",
+    "peer.poll":
+        "federation peer digest long-poll — the flaky-link point "
+        "(federation/exchange.py PeerLink.poll_once)",
+    "peer.publish":
+        "federation digest serve on the exchange listener "
+        "(federation/exchange.py FederationPublisher.serve)",
+    "peer.partition":
+        "federation link severance, both directions — sustained "
+        "partition of one peer (federation/exchange.py: PeerLink "
+        "outbound + FederationHTTPServer inbound)",
 }
 
 OK = "ok"

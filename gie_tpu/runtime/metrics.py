@@ -343,6 +343,68 @@ TENANT_SERVE_ERRORS = prom.Counter(
     ["tenant"],
     registry=REGISTRY,
 )
+# gie-fed (gie_tpu/federation, docs/FEDERATION.md): multi-cluster
+# federation. The peer label is BOUNDED by configuration (--fed-peer
+# entries), never by workload — a handful of clusters, not a
+# cardinality bomb.
+FED_PEERS = prom.Gauge(
+    "gie_federation_peers",
+    "Configured federation peer clusters",
+    registry=REGISTRY,
+)
+FED_REMOTE_ENDPOINTS = prom.Gauge(
+    "gie_federation_remote_endpoints",
+    "Imported peer endpoints currently schedulable, per peer cluster",
+    ["peer"],
+    registry=REGISTRY,
+)
+FED_STALENESS = prom.Gauge(
+    "gie_federation_staleness_seconds",
+    "Seconds since the peer digest was last confirmed (install or 304); "
+    "-1 before first contact",
+    ["peer"],
+    registry=REGISTRY,
+)
+FED_LOCAL_ONLY = prom.Gauge(
+    "gie_federation_local_only",
+    "1 while the peer is excluded from spillover (stale link past the "
+    "local-only floor), else 0",
+    ["peer"],
+    registry=REGISTRY,
+)
+FED_PENALTY = prom.Gauge(
+    "gie_federation_penalty_queue_units",
+    "Effective cross-cluster cost penalty applied to the peer's "
+    "imported endpoints, in queue-depth units (staleness-inflated)",
+    ["peer"],
+    registry=REGISTRY,
+)
+FED_SYNCS = prom.Counter(
+    "gie_federation_syncs_total",
+    "Peer digest exchange attempts by outcome (installed, not_modified, "
+    "fetch_error, corrupt, stale_epoch, era_regression, ...)",
+    ["peer", "outcome"],
+    registry=REGISTRY,
+)
+FED_SPILL = prom.Counter(
+    "gie_federation_spill_total",
+    "Picks that landed on an imported peer endpoint, by peer cluster "
+    "and criticality band",
+    ["peer", "band"],
+    registry=REGISTRY,
+)
+FED_ERA_FLIPS = prom.Counter(
+    "gie_federation_era_flips_total",
+    "Peer publisher era changes observed (peer failover / partition "
+    "heal; the split-brain convergence events)",
+    ["peer"],
+    registry=REGISTRY,
+)
+FED_DRAINING = prom.Gauge(
+    "gie_federation_cluster_draining",
+    "1 while THIS cluster is draining its traffic to peers, else 0",
+    registry=REGISTRY,
+)
 
 
 def set_build_info(fast_lane: bool, resilience: bool, obs: bool) -> None:

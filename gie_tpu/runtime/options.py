@@ -209,6 +209,40 @@ class Options:
     # Where --fault-scenario runs (and failed chaos tests) dump the
     # flight-recorder JSON artifact.
     obs_dump_dir: str = "/tmp/gie-obs"
+    # OTLP span export (gie_tpu/obs/otlp.py, docs/OBSERVABILITY.md):
+    # exported traces additionally POST as OTLP/HTTP JSON spans to
+    # <endpoint>/v1/traces, batched on a background thread — never the
+    # hot path. Empty = disabled. Needs a tracer (--obs-sample-rate > 0
+    # or --obs-tenant-sample).
+    obs_otlp_endpoint: str = ""
+    # Multi-cluster federation (gie_tpu/federation, docs/FEDERATION.md):
+    # this cluster's name in the ClusterSet, the digest-exchange
+    # listener, and the peer set ("name=http://host:port", repeatable).
+    # Federation is on when peers are configured or the listener port is
+    # set; imported peer endpoints become schedulable with a cost
+    # penalty, and the exchange runs push/long-poll digest sync.
+    fed_cluster: str = ""
+    fed_peers: list = dataclasses.field(default_factory=list)
+    fed_port: int = 0
+    fed_bind: str = "127.0.0.1"
+    # Cross-cluster cost penalty in queue-depth units (staleness
+    # inflates it; see docs/FEDERATION.md "penalty model").
+    fed_penalty: float = 4.0
+    # Staleness at which the penalty has doubled.
+    fed_stale_inflate_s: float = 5.0
+    # Staleness past which a peer is LOCAL-ONLY (excluded from
+    # spillover; lifts hysteretically at half this bound).
+    fed_local_only_after_s: float = 10.0
+    # Long-poll window peers park on the digest listener (push
+    # semantics: a state change answers a parked poll in one RTT).
+    fed_wait_s: float = 10.0
+    # Publisher refresh cadence (the epoch heartbeat).
+    fed_interval_s: float = 1.0
+    # Bound on exported/imported endpoints per fed.load summary.
+    fed_max_endpoints: int = 64
+    # Start with the whole-cluster drain flag raised: new picks bleed to
+    # healthy peers, peers stop spilling in (rollout/decommission mode).
+    fed_drain: bool = False
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -489,6 +523,57 @@ class Options:
                                  "(repeatable): trace one noisy tenant "
                                  "at 1.0 while the fleet stays at "
                                  "--obs-sample-rate")
+        parser.add_argument("--obs-otlp-endpoint",
+                            default=d.obs_otlp_endpoint,
+                            help="OTLP/HTTP collector base URL (spans "
+                                 "POST to <endpoint>/v1/traces, batched "
+                                 "off the hot path); empty = disabled")
+        parser.add_argument("--fed-cluster", default=d.fed_cluster,
+                            help="this cluster's name in the federation "
+                                 "ClusterSet (required with --fed-peer "
+                                 "or --fed-port)")
+        parser.add_argument("--fed-peer", action="append", default=[],
+                            dest="fed_peers", metavar="NAME=URL",
+                            help="peer cluster digest endpoint "
+                                 "(repeatable), e.g. "
+                                 "west=http://epp.west:9010")
+        parser.add_argument("--fed-port", type=int, default=d.fed_port,
+                            help="HTTP port serving /federation/digest "
+                                 "to peers (0 = do not serve)")
+        parser.add_argument("--fed-bind", default=d.fed_bind,
+                            help="bind address for the federation "
+                                 "listener (default loopback; set the "
+                                 "pod-network address explicitly)")
+        parser.add_argument("--fed-penalty", type=float,
+                            default=d.fed_penalty,
+                            help="cross-cluster cost penalty in queue-"
+                                 "depth units (staleness-inflated; "
+                                 "docs/FEDERATION.md)")
+        parser.add_argument("--fed-stale-inflate-s", type=float,
+                            default=d.fed_stale_inflate_s,
+                            help="link staleness at which the penalty "
+                                 "has doubled")
+        parser.add_argument("--fed-local-only-after-s", type=float,
+                            default=d.fed_local_only_after_s,
+                            help="link staleness past which the peer is "
+                                 "excluded from spillover entirely "
+                                 "(lifts hysteretically at half this)")
+        parser.add_argument("--fed-wait-s", type=float,
+                            default=d.fed_wait_s,
+                            help="long-poll window peers park on the "
+                                 "digest listener")
+        parser.add_argument("--fed-interval-s", type=float,
+                            default=d.fed_interval_s,
+                            help="federation publisher refresh cadence")
+        parser.add_argument("--fed-max-endpoints", type=int,
+                            default=d.fed_max_endpoints,
+                            help="bound on endpoints per exported load "
+                                 "summary (lowest-queue rows kept)")
+        parser.add_argument("--fed-drain", action="store_true",
+                            default=d.fed_drain,
+                            help="start with the whole-cluster drain "
+                                 "flag raised: new picks bleed to "
+                                 "healthy peers, peers stop spilling in")
         parser.add_argument("--debugz-bind", default=d.debugz_bind,
                             help="peer gate for the /debugz zpages: "
                                  "loopback-only by default; name a non-"
@@ -573,6 +658,18 @@ class Options:
             obs_slow_ms=args.obs_slow_ms,
             obs_tenant_sample=list(args.obs_tenant_sample),
             obs_dump_dir=args.obs_dump_dir,
+            obs_otlp_endpoint=args.obs_otlp_endpoint,
+            fed_cluster=args.fed_cluster,
+            fed_peers=list(args.fed_peers),
+            fed_port=args.fed_port,
+            fed_bind=args.fed_bind,
+            fed_penalty=args.fed_penalty,
+            fed_stale_inflate_s=args.fed_stale_inflate_s,
+            fed_local_only_after_s=args.fed_local_only_after_s,
+            fed_wait_s=args.fed_wait_s,
+            fed_interval_s=args.fed_interval_s,
+            fed_max_endpoints=args.fed_max_endpoints,
+            fed_drain=args.fed_drain,
         )
 
     def validate(self) -> None:
@@ -704,6 +801,33 @@ class Options:
                 raise ValueError(f"--fault-scenario: {e}") from None
         if self.drain_deadline_s <= 0:
             raise ValueError("--drain-deadline-s must be > 0")
+        if self.fed_peers or self.fed_port > 0 or self.fed_drain:
+            if not self.fed_cluster:
+                raise ValueError(
+                    "--fed-cluster is required with --fed-peer/--fed-"
+                    "port/--fed-drain (peers must know who we are)")
+            if not (0 <= self.fed_port < 65536):
+                raise ValueError("--fed-port out of range")
+            for spec in self.fed_peers:
+                name, sep, url = str(spec).partition("=")
+                if not sep or not name or "://" not in url:
+                    raise ValueError(
+                        f"--fed-peer {spec!r} must be NAME=http://host:port")
+                if name == self.fed_cluster:
+                    raise ValueError(
+                        f"--fed-peer {spec!r} names this cluster itself")
+            if self.fed_penalty < 0:
+                raise ValueError("--fed-penalty must be >= 0")
+            if self.fed_stale_inflate_s <= 0:
+                raise ValueError("--fed-stale-inflate-s must be > 0")
+            if self.fed_local_only_after_s <= 0:
+                raise ValueError("--fed-local-only-after-s must be > 0")
+            if self.fed_wait_s < 0:
+                raise ValueError("--fed-wait-s must be >= 0")
+            if self.fed_interval_s <= 0:
+                raise ValueError("--fed-interval-s must be > 0")
+            if self.fed_max_endpoints < 1:
+                raise ValueError("--fed-max-endpoints must be >= 1")
         if not (0.0 <= self.obs_sample_rate <= 1.0):
             raise ValueError("--obs-sample-rate must be in [0, 1]")
         if self.obs_ring < 1:

@@ -128,6 +128,7 @@ class ExtProcServerRunner:
         # and the request tracer (only at a sampling rate > 0; rate 0
         # leaves the admission path at one module-attr load + branch).
         self._obs_installed = False
+        self._otlp = None
         if opts.obs:
             from gie_tpu import obs
             from gie_tpu.obs.recorder import FlightRecorder
@@ -146,6 +147,18 @@ class ExtProcServerRunner:
                     opts.obs_sample_rate, seed=opts.obs_sample_seed,
                     slow_s=opts.obs_slow_ms / 1000.0,
                     tenant_rates=tenant_rates)
+            if tracer is not None and opts.obs_otlp_endpoint:
+                # OTLP span export (obs/otlp.py): exported traces also
+                # POST to the collector as OTLP/HTTP JSON, batched on a
+                # background thread — finish() only enqueues. Federation
+                # hops ride along as child spans, so a cross-cluster
+                # pick is one joined trace (docs/OBSERVABILITY.md).
+                from gie_tpu.obs.otlp import OtlpSpanExporter
+
+                self._otlp = OtlpSpanExporter(opts.obs_otlp_endpoint)
+                tracer.on_export = self._otlp.export
+                self.log.info("otlp span export armed",
+                              endpoint=opts.obs_otlp_endpoint)
             obs.install(tracer=tracer,
                         recorder=FlightRecorder(opts.obs_ring))
             self._obs_installed = True
@@ -219,6 +232,44 @@ class ExtProcServerRunner:
             on_slot_reclaimed=self._slot_reclaimed,
             drain_deadline_s=opts.drain_deadline_s)
         self._overflow_logged = 0
+        # Multi-cluster federation (gie_tpu/federation,
+        # docs/FEDERATION.md): imported peer pools become schedulable
+        # endpoints with a staleness-inflated cost penalty; the digest
+        # exchange long-polls every configured peer.
+        self.federation = None
+        self.fed_exchange = None
+        if opts.fed_peers or opts.fed_port > 0 or opts.fed_drain:
+            from gie_tpu.federation import (
+                FederationExchange,
+                FederationState,
+            )
+
+            peers = {}
+            for spec in opts.fed_peers:
+                name, _, url = str(spec).partition("=")
+                peers[name] = url
+            self.federation = FederationState(
+                self.datastore, self.metrics_store,
+                scheduler=self.scheduler,
+                cluster=opts.fed_cluster,
+                penalty=opts.fed_penalty,
+                stale_inflate_s=opts.fed_stale_inflate_s,
+                local_only_after_s=opts.fed_local_only_after_s,
+                spill_queue_limit=float(self.scheduler.cfg.queue_limit),
+            )
+            self.federation.draining = opts.fed_drain
+            self.fed_exchange = FederationExchange(
+                self.federation,
+                cluster=opts.fed_cluster,
+                peers=peers,
+                port=opts.fed_port,
+                bind=opts.fed_bind,
+                serve=opts.fed_port > 0,
+                interval_s=opts.fed_interval_s,
+                wait_s=opts.fed_wait_s,
+                max_endpoints=opts.fed_max_endpoints,
+                prefix_keys_fn=self.scheduler.prefix_hot_keys,
+            )
         self.picker = BatchingTPUPicker(
             self.scheduler,
             self.datastore,
@@ -235,6 +286,7 @@ class ExtProcServerRunner:
             background_warm=True,
             resilience=self.resilience,
             fairness=self.fairness,
+            federation=self.federation,
         )
         own_metrics.register_pool_aggregates(self._pool_snapshot)
         self._train_stop = threading.Event()
@@ -318,7 +370,10 @@ class ExtProcServerRunner:
                         per_replica=self.capacity_model.per_replica())
             collector = SignalCollector(
                 self.metrics_store,
-                self.datastore.endpoints,
+                # Local endpoints only: the autoscaler sizes THIS
+                # cluster's Deployment; counting imported peer capacity
+                # as local replicas would scale against phantom pods.
+                self.datastore.local_endpoints,
                 queue_limit=self.scheduler.cfg.queue_limit,
                 kv_limit=self.scheduler.cfg.kv_limit,
                 # Stale = several scrape periods missed, floored well above
@@ -410,7 +465,9 @@ class ExtProcServerRunner:
         controller cannot disagree on pool state."""
         from gie_tpu.sched import constants as C
 
-        endpoints = self.datastore.endpoints()
+        # Local endpoints only: the HPA gauges size THIS cluster's
+        # replica count — imported peer capacity must not read as local.
+        endpoints = self.datastore.local_endpoints()
         slots = [ep.slot for ep in endpoints if 0 <= ep.slot < C.M_MAX]
         n = len(slots)
         if n == 0:
@@ -520,6 +577,12 @@ class ExtProcServerRunner:
             if self.resilience.ejector is not None:
                 providers["outlier"] = (
                     lambda q: self.resilience.ejector.report())
+        if self.fed_exchange is not None:
+            # The federation zpage: peer links (era, staleness, breaker),
+            # the per-cluster capacity matrix, and this cluster's drain
+            # flag — the full spill-policy explanation.
+            providers["federation"] = (
+                lambda q: self.fed_exchange.report())
         return providers
 
     def _autoscale_ttft_probe(self):
@@ -536,7 +599,7 @@ class ExtProcServerRunner:
 
         if getattr(self.trainer, "last_loss", None) is None:
             return None  # untrained predictor: forecasts are noise
-        slots = [ep.slot for ep in self.datastore.endpoints()
+        slots = [ep.slot for ep in self.datastore.local_endpoints()
                  if 0 <= ep.slot < C.M_MAX]
         if not slots:
             return None
@@ -570,7 +633,10 @@ class ExtProcServerRunner:
             self.resilience.ejector.drop(slot)
 
     def _sync_scrapers(self) -> None:
-        for ep in self.datastore.endpoints():
+        # Local endpoints only: imported peer endpoints' rows come from
+        # the federation digest, and scraping a pod two clusters away
+        # would race those installs (docs/FEDERATION.md).
+        for ep in self.datastore.local_endpoints():
             self.scraper.attach(
                 ep.slot, f"http://{ep.hostport}/metrics", self.mapping
             )
@@ -631,6 +697,16 @@ class ExtProcServerRunner:
                 "replication manager started",
                 advertise=self.replication.advertise,
                 interval_s=self.opts.replication_interval_s,
+            )
+        if self.fed_exchange is not None:
+            self.fed_exchange.start()
+            self.log.info(
+                "federation exchange started",
+                cluster=self.opts.fed_cluster,
+                peers=sorted(self.fed_exchange.links),
+                port=(self.fed_exchange.server.port
+                      if self.fed_exchange.server is not None else None),
+                draining=self.federation.draining,
             )
         if self.opts.fault_specs:
             # gie-chaos (resilience/faults.py): arm the seeded injector.
@@ -774,6 +850,8 @@ class ExtProcServerRunner:
             self.autoscaler.stop()
         if self.replication is not None:
             self.replication.stop()
+        if self.fed_exchange is not None:
+            self.fed_exchange.stop()
         # Persist the capacity EWMA on LEADER shutdown (ROADMAP): the
         # next single-replica start seeds from it instead of the default.
         # Followers skip the write — their copy lags the leader's, and
@@ -816,6 +894,8 @@ class ExtProcServerRunner:
                 if path:
                     self.log.info("flight recorder dumped", path=path)
             obs.uninstall()
+        if self._otlp is not None:
+            self._otlp.close()
         if self.opts.fault_specs:
             from gie_tpu.resilience import faults
 

@@ -106,7 +106,8 @@ def _fair_order(items: list["_Pending"]) -> list["_Pending"]:
 class _Pending:
     __slots__ = ("req", "candidates", "event", "result", "error",
                  "enqueued_at", "abandoned", "band", "cand_slots",
-                 "excl_breaker", "excl_drain", "tenant", "cost")
+                 "excl_breaker", "excl_drain", "tenant", "cost",
+                 "fed_remote", "fed_base")
 
     def __init__(self, req: PickRequest, candidates: list, band: Optional[int] = None):
         self.req = req
@@ -135,6 +136,13 @@ class _Pending:
         # graceful drain. Empty tuples until a filter actually fires.
         self.excl_breaker: tuple = ()
         self.excl_drain: tuple = ()
+        # Imported peer-cluster slots the federation spill policy ADDED
+        # to this item's candidate set (docs/FEDERATION.md) — recorded
+        # for the same provenance reasons — and the pre-spill candidate
+        # list, kept so a drain CANCELLED while this item is held can
+        # restore its local set (None until federation first mutates).
+        self.fed_remote: tuple = ()
+        self.fed_base = None
         # Tenant identity + request cost, resolved ONCE at enqueue for
         # the fairness layer (gie_tpu/fairness): DRR ordering, budget
         # accounting, and the preemptive shed all read these per drain.
@@ -256,6 +264,7 @@ class BatchingTPUPicker:
         background_warm: bool = False,
         resilience: Optional[ResilienceState] = None,
         fairness: Optional["FairnessState"] = None,
+        federation=None,
     ):
         self.scheduler = scheduler
         self.datastore = datastore
@@ -377,6 +386,11 @@ class BatchingTPUPicker:
         # default = the proposal-1199 fair interleave, now cost-weighted);
         # the runner passes a weighted instance from --fairness-weights.
         self.fairness = fairness if fairness is not None else FairnessState()
+        # Multi-cluster federation (gie_tpu/federation,
+        # docs/FEDERATION.md): imported peer endpoints join candidate
+        # sets through the spill policy at wave cadence. None = single
+        # cluster (seed behavior).
+        self.federation = federation
         # Smooth-weighted-round-robin credit per slot and the static-
         # subset rotation cursor (degraded rungs; collector/completer
         # threads only — the two never pick the same wave).
@@ -1028,6 +1042,63 @@ class BatchingTPUPicker:
                         it.cand_slots = np.fromiter(
                             (getattr(ep, "slot", -1) for ep in allowed),
                             np.int64, len(allowed))
+        # Federation spillover (gie_tpu/federation, docs/FEDERATION.md),
+        # decided per wave BEFORE the hold check: a pick whose local
+        # candidates are all saturated gains the imported peer
+        # endpoints (penalized in the cost model) instead of being held
+        # to die — and under whole-cluster drain the preference inverts
+        # (new picks bleed to healthy peers). Strict subsetting is
+        # honored: an upstream-pinned candidate set never spills.
+        # CRITICAL never crosses while local candidates exist
+        # (FederationState.spill_candidates owns the band rules).
+        fed = self.federation
+        if fed is not None and (fed.has_peers() or fed.draining):
+            fed.observe()
+            queues_f = self.metrics_store.host_queue_depths()
+            for it in batch:
+                if getattr(it.req, "subset", False):
+                    continue
+                if it.fed_remote:
+                    # Already spilled on a prior cycle (a HELD item
+                    # re-enters at ~10 ms cadence): re-appending would
+                    # duplicate remotes unboundedly, so the set is kept
+                    # — EXCEPT when the drain flag flipped since the
+                    # spill, which invalidates the decision both ways:
+                    # a drain-REPLACED item whose drain was cancelled
+                    # must come home (restore the pre-spill locals and
+                    # re-evaluate), and a spill-APPENDED item caught by
+                    # a newly-raised drain must drop its locals (fall
+                    # through to the replace branch).
+                    was_replaced = all(
+                        getattr(ep, "cluster", "") for ep in it.candidates)
+                    if was_replaced == bool(fed.draining):
+                        continue  # decision still matches the flag
+                    if it.fed_base is not None:
+                        it.candidates = it.fed_base
+                    it.fed_remote = ()
+                    it.cand_slots = np.fromiter(
+                        (getattr(ep, "slot", -1) for ep in it.candidates),
+                        np.int64, len(it.candidates))
+                # cand_slots mirrors candidates here on every path, so
+                # the common no-spill case costs zero array rebuilds.
+                remote = fed.spill_candidates(
+                    it.band, it.cand_slots, queues_f)
+                if not remote:
+                    continue
+                it.fed_base = list(it.candidates)
+                it.fed_remote = tuple(
+                    int(getattr(ep, "slot", -1)) for ep in remote)
+                if fed.draining:
+                    # Drain bleed: local endpoints leave NEW-pick
+                    # candidacy entirely (in-flight completes locally;
+                    # spill_candidates returned None if no healthy peer
+                    # exists — availability beats drain).
+                    it.candidates = list(remote)
+                else:
+                    it.candidates = list(it.candidates) + list(remote)
+                it.cand_slots = np.fromiter(
+                    (getattr(ep, "slot", -1) for ep in it.candidates),
+                    np.int64, len(it.candidates))
         # Flow-control hold decision happens BEFORE any scheduling, so a
         # held request never touches device state (assumed load, prefix
         # inserts, tick) — it simply waits for capacity or its deadline.
@@ -1309,6 +1380,7 @@ class BatchingTPUPicker:
                 "candidates": [int(s) for s in item.cand_slots],
                 "excluded_breaker": list(item.excl_breaker),
                 "excluded_drain": list(item.excl_drain),
+                "fed_remote": list(item.fed_remote),
                 "draining": rec_draining,
                 "deadline_remaining_ms": (
                     round((req.deadline_at - now_mono) * 1e3, 1)
@@ -1378,6 +1450,17 @@ class BatchingTPUPicker:
                     res = PickResult(endpoint=picked[0], fallbacks=picked[1:])
                     res.assumed_cost = request_cost_host(
                         float(plen[i]), float(dlen[i]))
+                    peer = getattr(by_slot[picked_slots[0]], "cluster", "")
+                    if peer and self.federation is not None:
+                        # Cross-cluster pick: tally the spill (gie_
+                        # federation_spill_total) and stamp the trace —
+                        # the federation hop every joined OTLP trace
+                        # shows (docs/FEDERATION.md).
+                        self.federation.note_remote_pick(
+                            peer, _BAND_NAMES.get(item.band, "standard"))
+                        tr_f = item.req.trace
+                        if tr_f is not None:
+                            tr_f.event(f"federation:{peer}")
                     # The cycle charges the RAW primary (profile.py:214-218);
                     # if that slot wasn't routable, picked[0] differs and the
                     # observe_served guard will skip the release.
@@ -1443,6 +1526,10 @@ class BatchingTPUPicker:
                         rec["chosen"] = picked[0]
                         rec["chosen_slot"] = picked_slots[0]
                         rec["fallbacks"] = picked[1:]
+                        peer_rec = getattr(
+                            by_slot[picked_slots[0]], "cluster", "")
+                        if peer_rec:
+                            rec["peer_cluster"] = peer_rec
                         # Ranked blend scores straight from the cycle's
                         # materialized result — the chosen endpoint's
                         # entry may not be rank 0 when the tail filter
@@ -1516,6 +1603,15 @@ class BatchingTPUPicker:
         _degraded_lock."""
         endpoints = self.datastore.endpoints()
         by_slot = {ep.slot: ep for ep in endpoints}
+        # Degraded rungs stay LOCAL: the spill policy's saturation /
+        # drain reasoning reads live rows, and a degraded ladder means
+        # exactly that data is suspect — cross-cluster hops on stale
+        # verdicts would export the outage. Imported endpoints remain
+        # only as the availability floor (no local endpoint at all).
+        local_only = {s: ep for s, ep in by_slot.items()
+                      if not getattr(ep, "cluster", "")}
+        if local_only:
+            by_slot = local_only
         # Degraded rungs honor graceful drain exactly like the full path:
         # a terminating pod leaves new-pick candidacy even while the
         # ladder is down (a rolling upgrade DURING a degradation must

@@ -917,6 +917,20 @@ class Scheduler:
             load = jnp.copy(self.state.assumed_load)
         return np.asarray(load)
 
+    def prefix_hot_keys(self, max_keys: int = 2048) -> np.ndarray:
+        """Bounded sample of live prefix-table keys (the federation
+        digest's fed.prefix export, docs/FEDERATION.md): peers fold
+        these into their own tables against our imported slots so
+        spilled sessions stick to the cluster already holding their
+        prefix. Same lock discipline as snapshot_assumed_load: the lock
+        covers only a donation-safe device copy, the D2H sync runs
+        outside it (gie-lint GL002)."""
+        with self._lock:
+            keys = jnp.copy(self.state.prefix.keys)
+        host = np.asarray(keys).reshape(-1)
+        host = host[host != 0]
+        return host[: max(int(max_keys), 0)].astype(np.uint32)
+
     # -- optional warm-restart persistence ---------------------------------
     # The reference explicitly accepts prefix-index loss on restart
     # (0602 README:93); offering a checkpoint anyway lets a restarted EPP

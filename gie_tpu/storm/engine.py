@@ -106,6 +106,34 @@ class PoolSpec:
 
 
 @dataclasses.dataclass
+class FederationSpec:
+    """A peer cluster for federation storms (gie-fed,
+    docs/FEDERATION.md): the engine runs a SECOND stub fleet as the
+    peer's data plane, publishes its load through a REAL
+    FederationPublisher + HTTP listener (era machinery included), and
+    the local stack imports it through a real PeerLink/FederationState
+    — so spillover, drain bleed, partition degradation, and split-brain
+    convergence all exercise the production code path; only the peer's
+    own EPP scheduling is emulated (its digest IS what a peer EPP would
+    publish)."""
+
+    peer_name: str = "west"
+    n_pods: int = 3
+    ip_base: str = "10.79.0"
+    # Cross-cluster penalty in queue-depth units (storm-scale default:
+    # small enough that a saturated local pool actually spills).
+    penalty: float = 2.0
+    # Link cadence — CI-scale fast-recovery variants of the production
+    # defaults (a storm must see degrade AND readmit in seconds).
+    interval_s: float = 0.1
+    wait_s: float = 0.5
+    stale_inflate_s: float = 0.5
+    local_only_after_s: float = 1.5
+    link_open_after: int = 3
+    link_open_s: float = 0.4
+
+
+@dataclasses.dataclass
 class EngineConfig:
     ttft_slo_s: float = 2.5
     scrape_interval_s: float = 0.025
@@ -138,6 +166,9 @@ class EngineConfig:
     force_rung: Optional[int] = None
     # Per-request data-plane resolution timeout (wall seconds).
     serve_timeout_s: float = 30.0
+    # Multi-cluster federation storms (gie-fed): a peer cluster spec,
+    # or None for the classic single-cluster engine.
+    federation: Optional[FederationSpec] = None
 
     def fast_ladder(self) -> LadderConfig:
         return LadderConfig(
@@ -145,6 +176,19 @@ class EngineConfig:
             latency_breach_s=5.0, latency_breach_streak=200,
             recover_streak=2, min_dwell_s=0.3, probe_interval_s=0.15,
             serve_min_samples=10_000)
+
+
+class _ZombieSnapshot:
+    """Frozen pre-failover publisher lineage for split-brain storms: it
+    serves the same full frame (old era, old epoch) forever — exactly
+    what a partitioned-away leader that never learned it lost looks
+    like to an importer."""
+
+    def __init__(self, pub):
+        self.response = pub.serve()
+
+    def serve(self, **_kw):
+        return self.response
 
 
 class _StubSlot:
@@ -256,9 +300,12 @@ class StormEngine:
             + b"s" * max(self.program.traffic.system_prompt_bytes - 52, 0)
             for s in range(self.program.traffic.n_sessions)
         ]
+        # The world lock exists BEFORE the stack: the federation peer
+        # publisher's load exporter closes over it and may refresh
+        # during construction.
+        self._world_lock = threading.Lock()
         self._build_stack()
         # Run state.
-        self._world_lock = threading.Lock()
         self._pending: dict[tuple[str, int], _InFlight] = {}
         self._stop = threading.Event()
         self._t0 = 0.0
@@ -286,6 +333,16 @@ class StormEngine:
         self._autoscale_events: list[dict] = []
         self._upgrades: list[dict] = []
         self._failover_checks: list[dict] = []
+        # Federation tallies (gie-fed): per-cluster pick/serve counts,
+        # CRITICAL crossings, the local-only timeline, and the control-
+        # event log the scorecard's per-cluster section is built from.
+        from collections import defaultdict as _dd
+
+        self._fed_picks: dict = _dd(int)        # (cluster, band) -> n
+        self._fed_serves: dict = _dd(int)       # cluster -> 2xx serves
+        self._fed_pick_times: list[tuple] = []  # (t, cluster)
+        self._fed_local_only_trace: list[tuple] = []
+        self._fed_events: list[dict] = []
 
     # -- stack construction ------------------------------------------------
 
@@ -322,6 +379,78 @@ class StormEngine:
         self._pod_names: list[str] = []
         for i, scfg in enumerate(pool.stub_cfgs()):
             self._add_pod(f"p{i}", f"{pool.ip_base}.{i + 1}", scfg)
+        # -- federation peer cluster (gie-fed, docs/FEDERATION.md) ---------
+        self.fed_state = self.fed_exchange = None
+        self.peer_pub = self.peer_server = None
+        self._peer_hostports: set[str] = set()
+        self._fed_partitioned = False
+        self._zombie_pub = None
+        self._zombie_alternator = 0
+        fed = cfg.federation
+        if fed is not None:
+            from gie_tpu.federation import (
+                FederationExchange,
+                FederationPublisher,
+                FederationState,
+            )
+            from gie_tpu.federation import summary as fed_summary
+
+            # Peer fleet: same stub dict (the data plane routes by
+            # hostport), never the local datastore — the peer's pods
+            # become schedulable only through the digest import.
+            stub_cfg = pool.stub_cfgs()[0]
+            for i in range(fed.n_pods):
+                hostport = f"{fed.ip_base}.{i + 1}:8000"
+                self._stubs[hostport] = _StubSlot(
+                    VLLMStub(stub_cfg, name=f"{fed.peer_name}-p{i}"))
+                self._stubs[hostport].stub.hostport = hostport
+                self._peer_hostports.add(hostport)
+
+            def _peer_meta():
+                return fed_summary.encode_meta(
+                    self.peer_pub.era, False, fed.peer_name)
+
+            def _peer_load():
+                rows = []
+                with self._world_lock:
+                    for hostport in sorted(self._peer_hostports):
+                        slot = self._stubs.get(hostport)
+                        if slot is None or not slot.alive:
+                            continue
+                        rows.append((hostport,
+                                     float(len(slot.stub.queue)),
+                                     float(slot.stub.kv_utilization()),
+                                     False))
+                return fed_summary.encode_load(
+                    rows, max_endpoints=64)
+
+            self.peer_pub = FederationPublisher(
+                {fed_summary.META_SECTION: _peer_meta,
+                 fed_summary.LOAD_SECTION: _peer_load},
+                era_seq=1)
+            self.peer_pub.refresh()
+            self.fed_state = FederationState(
+                self.datastore, self.metrics_store,
+                scheduler=self.scheduler,
+                cluster="local",
+                penalty=fed.penalty,
+                stale_inflate_s=fed.stale_inflate_s,
+                local_only_after_s=fed.local_only_after_s,
+                spill_queue_limit=cfg.queue_limit)
+            self.fed_exchange = FederationExchange(
+                self.fed_state,
+                cluster="local",
+                # The transport is the injected in-process fetch (the
+                # same serve() surface the HTTP handler fronts; real-
+                # wire long-poll is pinned by tests/test_federation.py)
+                # — the partition/zombie machinery needs the seam.
+                peers={fed.peer_name: "storm://peer"},
+                serve=False,
+                interval_s=fed.interval_s,
+                wait_s=fed.wait_s,
+                link_open_after=fed.link_open_after,
+                link_open_s=fed.link_open_s,
+                fetch=self._fed_fetch)
         self.picker = BatchingTPUPicker(
             self.scheduler, self.datastore, self.metrics_store,
             max_wait_s=cfg.batch_window_s,
@@ -330,7 +459,8 @@ class StormEngine:
             # on a first-use jit of a bigger bucket.
             max_batch=48,
             lora_registry=self.lora_registry,
-            resilience=self.resilience)
+            resilience=self.resilience,
+            federation=self.fed_state)
         self.server = StreamingServer(
             self.datastore, self.picker,
             on_served=self.picker.observe_served,
@@ -355,7 +485,7 @@ class StormEngine:
             from gie_tpu.autoscale.signals import SignalCollector
 
             self.collector = SignalCollector(
-                self.metrics_store, self.datastore.endpoints,
+                self.metrics_store, self.datastore.local_endpoints,
                 queue_limit=cfg.queue_limit, staleness_s=2.0,
                 scrape_engine=self.scrape)
             self.recommender = AutoscaleRecommender(RecommenderConfig(
@@ -390,9 +520,36 @@ class StormEngine:
         self._pod_names.append(name)
 
     def _sync_scrapers(self) -> None:
-        for ep in self.datastore.endpoints():
+        # Local endpoints only: imported peer endpoints' rows come from
+        # the federation digest (scraping them would race the installs).
+        for ep in self.datastore.local_endpoints():
             self.scrape.attach(
                 ep.slot, f"http://{ep.hostport}/metrics", VLLM)
+
+    def _cluster_of(self, hostport: str) -> str:
+        return (self.cfg.federation.peer_name
+                if hostport in self._peer_hostports else "local")
+
+    def _fed_fetch(self, url, since, era, etag, wait_s):
+        """PeerLink transport for federation storms: the real peer
+        publisher over an in-process call, with the partition flag
+        severing it and — after a split-brain heal — the ZOMBIE old-era
+        publisher answering alternate polls (the deterministic
+        interleave whose convergence the scorecard pins)."""
+        if self._fed_partitioned:
+            raise ConnectionError("storm: peer link partitioned")
+        if self._zombie_pub is not None:
+            self._zombie_alternator += 1
+            if self._zombie_alternator % 2 == 0:
+                # The zombie lineage: pre-failover era, still publishing.
+                # No etag/delta: a zombie serves its own full frames.
+                return self._zombie_pub.serve(wait_s=0.0)
+        return self._fed_exchange_fetch(url, since, era, etag, wait_s)
+
+    def _fed_exchange_fetch(self, url, since, era, etag, wait_s):
+        return self.peer_pub.serve(
+            since=since, era=era, if_none_match=etag,
+            wait_s=min(wait_s, 0.2))
 
     def _fetch_metrics(self, url: str) -> str:
         hostport = url.split("//", 1)[-1].split("/", 1)[0]
@@ -468,6 +625,10 @@ class StormEngine:
         the response-headers hop then attributes it to the primary."""
         a = stream.arrival
         now = time.monotonic()
+        if self.fed_state is not None:
+            cluster = self._cluster_of(stream.dest)
+            self._fed_picks[(cluster, a.band)] += 1
+            self._fed_pick_times.append((self._now(), cluster))
         with self._world_lock:
             slot = self._stubs.get(stream.dest)
             if slot is None or not slot.alive:
@@ -530,6 +691,8 @@ class StormEngine:
         else:
             self._ok += 1
             self._tenant_ok[tenant] += 1
+            if self.fed_state is not None:
+                self._fed_serves[self._cluster_of(_served)] += 1
 
     def _now(self) -> float:
         return time.monotonic() - self._t0
@@ -577,7 +740,7 @@ class StormEngine:
 
     def _autoscale_tick(self) -> None:
         sig = self.collector.sample()
-        current = len(self.datastore.endpoints())
+        current = len(self.datastore.local_endpoints())
         rec = self.recommender.observe(sig, current=current)
         if rec.desired > current:
             base = len(self._pod_names)
@@ -621,6 +784,30 @@ class StormEngine:
                 "step": "replace", "hostport": hostport})
         elif ev.kind == "failover_check" and self.publisher is not None:
             self._failover_probe()
+        elif ev.kind == "cluster_drain" and self.fed_exchange is not None:
+            # Whole-cluster drain: new picks bleed to the peer, the flag
+            # publishes so peers stop spilling in (docs/FEDERATION.md).
+            self.fed_exchange.set_draining(True)
+            self._fed_events.append(
+                {"t": round(self._now(), 3), "event": "cluster_drain"})
+        elif ev.kind == "peer_partition" and self.fed_exchange is not None:
+            self._fed_partitioned = True
+            self._fed_events.append(
+                {"t": round(self._now(), 3), "event": "partition"})
+        elif ev.kind == "peer_heal" and self.fed_exchange is not None:
+            flip_era = bool(ev.args and ev.args[0])
+            if flip_era:
+                # The far side failed over during the partition: its NEW
+                # publisher carries a greater era, while the OLD lineage
+                # (the zombie) keeps answering alternate polls after the
+                # heal — the split-brain interleave.
+                self._zombie_pub = _ZombieSnapshot(self.peer_pub)
+                self.peer_pub.bump_era()
+                self.peer_pub.refresh()
+            self._fed_partitioned = False
+            self._fed_events.append(
+                {"t": round(self._now(), 3), "event": "heal",
+                 "flip_era": flip_era})
 
     def _failover_probe(self) -> None:
         """Warm-standby readiness: publish the live digest, fetch and
@@ -661,6 +848,11 @@ class StormEngine:
         from gie_tpu.sched.types import chunk_bucket_for
         from gie_tpu.storm.shapes import Arrival
 
+        # Federation first: the peer's endpoints must be IMPORTED before
+        # the warm picks run, so the M bucket covering the remote slots
+        # compiles here — a first-spill lattice compile mid-crowd would
+        # stall every pick behind it (the warmup lesson, generalized).
+        self._start_federation()
         tc = self.program.traffic
         sizes = {tc.system_prompt_bytes + tc.user_suffix_bytes}
         if schedule is not None:
@@ -691,11 +883,25 @@ class StormEngine:
                 [t.start() for t in ts]
                 [t.join() for t in ts]
 
+    def _start_federation(self) -> None:
+        """Start the exchange (idempotent) and block briefly until the
+        first peer digest installs — remote slots must exist before
+        warmup sizes the M bucket."""
+        if self.fed_exchange is None or getattr(self, "_fed_started", False):
+            return
+        self._fed_started = True
+        self.fed_exchange.start()
+        deadline = time.monotonic() + 5.0
+        link = next(iter(self.fed_exchange.links.values()))
+        while time.monotonic() < deadline and link.installs == 0:
+            time.sleep(0.02)
+
     def run(self, schedule: Optional[Schedule] = None,
             warmup: bool = True) -> StormResult:
         cfg = self.cfg
         if schedule is None:
             schedule = self.program.compile()
+        self._start_federation()
         if warmup:
             self.warmup(schedule)
         if cfg.force_rung is not None:
@@ -764,6 +970,8 @@ class StormEngine:
                            self.scheduler, self.datastore)
 
     def close(self) -> None:
+        if self.fed_exchange is not None:
+            self.fed_exchange.stop()
         self.scrape.close()
         self.picker.close()
 
@@ -791,7 +999,22 @@ class StormEngine:
                 self._rung_trace.append(
                     (round(t, 2), int(self.resilience.ladder.rung())))
                 self._pool_trace.append(
-                    (round(t, 2), len(self.datastore.endpoints())))
+                    (round(t, 2), len(self.datastore.local_endpoints())))
+                if self.fed_exchange is not None:
+                    # Keep cross-cluster state flowing (the long-poll
+                    # push needs fresh epochs) and record the local-only
+                    # verdict timeline the partition property is
+                    # asserted on.
+                    try:
+                        self.peer_pub.refresh()
+                        self.fed_state.observe()
+                    except Exception:
+                        pass
+                    link = next(iter(self.fed_exchange.links.values()))
+                    view = self.fed_state._peers.get(link.name)
+                    self._fed_local_only_trace.append(
+                        (round(t, 2),
+                         1 if (view is None or view.local_only) else 0))
             if self.recommender is not None and t >= next_autoscale:
                 next_autoscale = t + cfg.autoscale_interval_s
                 try:
@@ -888,6 +1111,38 @@ class StormEngine:
             "long_context_arrivals": sum(
                 1 for a in schedule.arrivals if a.kind == "long_context"),
         }
+        if self.fed_state is not None:
+            # Per-cluster federation section (gie-fed): the four pinned
+            # properties — spill with CRITICAL locality, drain bleed,
+            # partition -> local-only within the staleness window, and
+            # deterministic era convergence on heal — are all asserted
+            # on these fields (tests/test_storm.py).
+            fed = self.cfg.federation
+            link = next(iter(self.fed_exchange.links.values()))
+            picks_by_cluster: dict = {}
+            crit_remote = 0
+            for (cluster, band), n in self._fed_picks.items():
+                per = picks_by_cluster.setdefault(
+                    cluster, {"total": 0, "bands": {}})
+                per["total"] += n
+                per["bands"][band] = per["bands"].get(band, 0) + n
+                if cluster != "local" and band == "critical":
+                    crit_remote += n
+            card["federation"] = {
+                "peer": fed.peer_name,
+                "local_only_after_s": fed.local_only_after_s,
+                "picks": picks_by_cluster,
+                "serves": dict(self._fed_serves),
+                "critical_remote_picks": crit_remote,
+                "pick_times": [
+                    (round(t, 3), c) for t, c in self._fed_pick_times],
+                "local_only_trace": self._fed_local_only_trace,
+                "events": self._fed_events,
+                "link": link.report(),
+                "peer_era": list(self.peer_pub.era),
+                "matrix": self.fed_state.capacity_matrix(),
+                "draining": self.fed_state.draining,
+            }
         return card
 
 
@@ -898,7 +1153,7 @@ class StormEngine:
 _STORM_DRIVE_KEYS = frozenset({
     "base_qps", "duration_s", "traffic", "shapes", "pool",
     "ttft_slo_s", "autoscale_max_extra", "queue_limit",
-    "max_concurrency",
+    "max_concurrency", "federation",
 })
 
 
@@ -946,6 +1201,14 @@ def run_scenario(name_or_path: str, *, seed: Optional[int] = None,
                       ("queue_limit", float), ("max_concurrency", int)):
         if key in storm:
             cfg = dataclasses.replace(cfg, **{key: cast(storm[key])})
+    if "federation" in storm:
+        fed_kw = dict(storm["federation"] or {})
+        unknown = set(fed_kw) - {
+            f.name for f in dataclasses.fields(FederationSpec)}
+        if unknown:
+            raise ValueError(
+                f"unknown storm federation fields {sorted(unknown)}")
+        cfg = dataclasses.replace(cfg, federation=FederationSpec(**fed_kw))
     if any(s.get("kind") == "standby_failover"
            for s in storm.get("shapes") or []):
         # failover_check events need the replication publisher armed.

@@ -372,6 +372,51 @@ class RollingUpgrade(Shape):
             + self.settle_s
 
 
+class ClusterDrain(Shape):
+    """Whole-cluster graceful drain (gie-fed, docs/FEDERATION.md): at
+    ``at_s`` the engine raises the federation drain flag — new picks
+    bleed to healthy peer clusters, in-flight streams complete locally,
+    and the flag publishes to peers so they stop spilling in. Pure
+    control-plane shape — rate 1.0."""
+
+    def __init__(self, at_s: float = 3.0):
+        if at_s < 0:
+            raise ValueError("at_s must be >= 0")
+        self.at_s = at_s
+
+    def control_events(self, duration_s: float) -> list[ControlEvent]:
+        if self.at_s >= duration_s:
+            return []
+        return [ControlEvent(self.at_s, "cluster_drain", ())]
+
+
+class PeerPartition(Shape):
+    """Sever the federation exchange link to the peer cluster at
+    ``at_s`` and heal it at ``heal_s`` (gie-fed). With ``flip_era`` the
+    peer's publisher re-mints a GREATER era during the partition (the
+    far side failed over its EPP) and the OLD lineage keeps answering
+    interleaved after the heal — the split-brain storm whose
+    deterministic convergence (installed era ratchets to max, zombie
+    frames reject as era regressions) the scorecard pins."""
+
+    def __init__(self, at_s: float = 2.0, heal_s: float = 6.0,
+                 flip_era: bool = True):
+        if not (0 <= at_s < heal_s):
+            raise ValueError("need 0 <= at_s < heal_s")
+        self.at_s = at_s
+        self.heal_s = heal_s
+        self.flip_era = flip_era
+
+    def control_events(self, duration_s: float) -> list[ControlEvent]:
+        out = []
+        if self.at_s < duration_s:
+            out.append(ControlEvent(self.at_s, "peer_partition", ()))
+        if self.heal_s < duration_s:
+            out.append(ControlEvent(
+                self.heal_s, "peer_heal", (1 if self.flip_era else 0,)))
+        return out
+
+
 class StandbyFailover(Shape):
     """Warm-standby sync checkpoints: at each event the engine publishes
     the live scheduler's replication digest and has a follower fetch +
@@ -487,6 +532,8 @@ SHAPE_KINDS = {
     "tenant_mix": TenantMix,
     "pinned_tenant": PinnedTenant,
     "abusive_tenant": AbusiveTenant,
+    "cluster_drain": ClusterDrain,
+    "peer_partition": PeerPartition,
 }
 
 

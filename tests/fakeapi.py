@@ -77,6 +77,9 @@ class FakeKubeApiServer:
             def do_PUT(self):
                 outer._handle_put_post(self, create=False)
 
+            def do_DELETE(self):
+                outer._handle_delete(self)
+
         self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
                                                       Handler)
         threading.Thread(target=self._httpd.serve_forever,
@@ -148,7 +151,11 @@ class FakeKubeApiServer:
             return None
         resource = {"pods": "pods", "services": "services",
                     "inferencepools": "pools", "leases": "leases",
-                    "deployments": "deployments"}.get(kind)
+                    "deployments": "deployments",
+                    # Multi-cluster federation (gie-fed): the
+                    # InferencePoolImport CRD the ClusterSet controller
+                    # materializes in importing member clusters.
+                    "inferencepoolimports": "imports"}.get(kind)
         if resource is None:
             return None
         name = rest[0] if rest else None
@@ -278,6 +285,23 @@ class FakeKubeApiServer:
             self._bump(resource, "MODIFIED", obj)
             out = copy.deepcopy(obj)
         self._send_json(handler, 200, out)
+
+    # -- DELETE ------------------------------------------------------------
+
+    def _handle_delete(self, handler) -> None:
+        route = self._route(handler.path)
+        if route is None:
+            return self._send_404(handler)
+        resource, ns, name, _sub = route
+        if name is None:
+            return self._send_404(handler)
+        with self._lock:
+            obj = self._objects.pop((resource, ns, name), None)
+            if obj is None:
+                return self._send_404(handler)
+            self._bump(resource, "DELETED", obj)
+        self._send_json(handler, 200, {
+            "kind": "Status", "status": "Success", "code": 200})
 
     # -- POST/PUT: Lease create/update with optimistic concurrency ---------
 

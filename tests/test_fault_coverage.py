@@ -99,3 +99,13 @@ def test_no_stale_point_names_in_tests():
     # attributes etc.) are excluded by the isidentifier/prefix filter;
     # anything left is a typo'd fault point waiting to silently no-op.
     assert not stale, f"dotted literals that look like fault points: {stale}"
+
+
+def test_federation_points_woven_into_the_exchange():
+    """ISSUE 12: the three peer.* points must be woven into the
+    federation exchange specifically (the generic weave test above only
+    proves SOME gie_tpu file names them)."""
+    path = os.path.join(PKG, "federation", "exchange.py")
+    lits = _string_literals(path)
+    for point in ("peer.poll", "peer.publish", "peer.partition"):
+        assert point in lits, f"{point} not woven in federation/exchange.py"

@@ -1,0 +1,799 @@
+"""gie-fed federation tests (ISSUE 12, docs/FEDERATION.md): digest
+sections, the long-poll exchange protocol, era-ordered split-brain
+convergence, link robustness (breaker/backoff/staleness), imported
+endpoints in the datastore, the spill policy, fault points, and the
+live-watch ClusterSet controller over fakeapi."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gie_tpu.datastore import Datastore
+from gie_tpu.datastore.objects import EndpointPool, Pod
+from gie_tpu.federation import summary
+from gie_tpu.federation.exchange import (
+    BREAKER_OPEN,
+    CORRUPT,
+    DELTA_MISMATCH,
+    ERA_REGRESSION,
+    FETCH_ERROR,
+    INSTALLED,
+    NOT_MODIFIED,
+    STALE_EPOCH,
+    FederationHTTPServer,
+    FederationPublisher,
+    PeerLink,
+    era_str,
+)
+from gie_tpu.federation.state import FederationState
+from gie_tpu.metricsio import MetricsStore
+from gie_tpu.replication import codec
+from gie_tpu.resilience import faults
+from gie_tpu.sched import constants as C
+
+CRIT = int(C.Criticality.CRITICAL)
+STD = int(C.Criticality.STANDARD)
+
+
+def make_datastore(local_pods=1):
+    ds = Datastore()
+    ds.pool_set(EndpointPool(selector={"app": "x"}, target_ports=[8000],
+                             namespace="default"))
+    for i in range(local_pods):
+        ds.pod_update_or_add(
+            Pod(name=f"l{i}", labels={"app": "x"}, ip=f"10.1.0.{i + 1}"))
+    return ds
+
+
+def make_peer_pub(endpoints=None, era=(1, 42), draining=False,
+                  cluster="west"):
+    eps = endpoints if endpoints is not None else [
+        ("10.9.0.1:8000", 1.0, 0.1, False),
+        ("10.9.0.2:8000", 2.0, 0.2, False),
+    ]
+    pub = FederationPublisher({
+        summary.META_SECTION: lambda: summary.encode_meta(
+            pub.era, draining, cluster),
+        summary.LOAD_SECTION: lambda: summary.encode_load(
+            list(eps), max_endpoints=64),
+    }, era_seq=era[0], era_token=era[1])
+    pub.refresh()
+    return pub, eps
+
+
+def make_state(ds=None, **kw):
+    ds = ds if ds is not None else make_datastore()
+    store = MetricsStore()
+    kw.setdefault("cluster", "east")
+    kw.setdefault("penalty", 4.0)
+    kw.setdefault("spill_queue_limit", 8.0)
+    return FederationState(ds, store, **kw), ds, store
+
+
+def make_link(pub, state, name="west", **kw):
+    def fetch(url, since, era, etag, wait_s):
+        return pub.serve(since=since, era=era, if_none_match=etag)
+
+    kw.setdefault("wait_s", 0.0)
+    kw.setdefault("interval_s", 0.0)
+    link = PeerLink(name, "mem://" + name, state.install_peer,
+                    fetch=kw.pop("fetch", fetch), **kw)
+    state.register_peer(name, link)
+    return link
+
+
+# -- summary sections ------------------------------------------------------
+
+
+def test_meta_roundtrip_and_malformed():
+    arrays = summary.encode_meta((3, 0xDEAD), True, "east-1")
+    meta = summary.decode_meta(arrays)
+    assert meta.era == (3, 0xDEAD)
+    assert meta.draining is True
+    assert meta.cluster == "east-1"
+    assert summary.decode_meta(None) is None
+    assert summary.decode_meta({}) is None
+    assert summary.decode_meta(
+        {"era": np.zeros(3, np.uint64), "draining": np.uint8(0)}) is None
+    # Unknown extra arrays are ignored (forward compat).
+    arrays["future_flag"] = np.uint8(1)
+    assert summary.decode_meta(arrays) is not None
+
+
+def test_load_roundtrip_bounds_and_hygiene():
+    rows = [(f"10.0.0.{i}:8000", float(i), 0.1 * i, i % 2 == 0)
+            for i in range(10)]
+    arrays = summary.encode_load(rows, max_endpoints=4)
+    assert int(arrays["truncated"]) == 1
+    out = summary.decode_load(arrays)
+    # Lowest-queue rows kept (the useful spill capacity).
+    assert [e.queue_depth for e in out] == [0.0, 1.0, 2.0, 3.0]
+    assert out[0].draining is True and out[1].draining is False
+    # Hostport hygiene: empty / portless / NaN rows never install.
+    bad = summary.encode_load(
+        [("not-a-hostport", 1.0, 0.0, False),
+         ("10.0.0.1:8000", float("nan"), 0.0, False),
+         ("10.0.0.2:8000", 1.0, 0.5, False)], max_endpoints=8)
+    out = summary.decode_load(bad)
+    assert [e.hostport for e in out] == ["10.0.0.2:8000"]
+    assert summary.decode_load({"hostports": np.zeros((2, 8), np.uint8),
+                                "queue": np.zeros(1, np.float32),
+                                "kv": np.zeros(2, np.float32),
+                                "draining": np.zeros(2, np.uint8)}) is None
+
+
+def test_prefix_roundtrip_drops_zero_keys():
+    arrays = summary.encode_prefix(
+        np.asarray([0, 7, 9, 0, 11], np.uint32), max_keys=2)
+    keys = summary.decode_prefix(arrays)
+    assert keys.tolist() == [7, 9]
+
+
+# -- exchange protocol -----------------------------------------------------
+
+
+def test_link_install_then_not_modified_then_delta():
+    frames = []
+    pub, eps = make_peer_pub()
+    state, ds, store = make_state()
+
+    def fetch(url, since, era, etag, wait_s):
+        status, headers, body = pub.serve(
+            since=since, era=era, if_none_match=etag)
+        if status == 200:
+            frames.append(codec.decode_digest(body))
+        return status, headers, body
+
+    link = make_link(pub, state, fetch=fetch)
+    assert link.poll_once() == INSTALLED
+    assert not frames[0].delta
+    assert link.poll_once() == NOT_MODIFIED
+    assert link.staleness_s() < 1.0
+    # One section changes -> the next frame is a DELTA carrying only it.
+    eps.append(("10.9.0.3:8000", 0.0, 0.0, False))
+    pub.refresh()
+    assert link.poll_once() == INSTALLED
+    assert frames[1].delta
+    assert set(frames[1].sections) == {summary.LOAD_SECTION}
+    assert "10.9.0.3:8000" in [
+        e.hostport for e in ds.endpoints() if e.cluster]
+
+
+def test_long_poll_parks_until_refresh():
+    pub, eps = make_peer_pub()
+    status0, headers0, _ = pub.serve()
+    etag = headers0["ETag"]
+    result = {}
+
+    def park():
+        t0 = time.monotonic()
+        status, _, body = pub.serve(if_none_match=etag, wait_s=5.0)
+        result.update(status=status, dt=time.monotonic() - t0,
+                      n=len(body))
+
+    t = threading.Thread(target=park)
+    t.start()
+    time.sleep(0.1)
+    eps.append(("10.9.0.9:8000", 0.0, 0.0, False))
+    pub.refresh()
+    t.join(3)
+    assert result["status"] == 200 and result["n"] > 0
+    # Woke on the refresh, not the 5 s window.
+    assert result["dt"] < 2.0
+    # An empty window expires back to 304.
+    status, _, _ = pub.serve(if_none_match=pub.serve()[1]["ETag"],
+                             wait_s=0.05)
+    assert status == 304
+
+
+def test_link_over_real_http_long_poll():
+    pub, eps = make_peer_pub()
+    srv = FederationHTTPServer(pub, 0)
+    try:
+        state, ds, _ = make_state()
+        link = PeerLink("west", f"http://127.0.0.1:{srv.port}",
+                        state.install_peer, wait_s=0.5, interval_s=0.0)
+        state.register_peer("west", link)
+        assert link.poll_once() == INSTALLED
+        # The long poll parks server-side and wakes on the epoch bump.
+        eps.append(("10.9.0.4:8000", 0.0, 0.0, False))
+
+        def bump():
+            time.sleep(0.1)
+            pub.refresh()
+
+        t = threading.Thread(target=bump)
+        t.start()
+        t0 = time.monotonic()
+        assert link.poll_once() == INSTALLED
+        assert time.monotonic() - t0 < 0.45  # woke before the window
+        t.join()
+    finally:
+        srv.close()
+
+
+# -- era ordering / split brain --------------------------------------------
+
+
+def test_era_regression_rejected_and_state_kept():
+    pub_new, _ = make_peer_pub(era=(2, 50))
+    pub_old, _ = make_peer_pub(
+        endpoints=[("10.9.9.9:8000", 0.0, 0.0, False)], era=(1, 99))
+    state, ds, _ = make_state()
+    link = make_link(pub_new, state)
+    assert link.poll_once() == INSTALLED
+    before = sorted(e.hostport for e in ds.endpoints() if e.cluster)
+
+    def fetch_old(url, since, era, etag, wait_s):
+        return pub_old.serve()
+
+    link._fetch = fetch_old
+    assert link.poll_once() == ERA_REGRESSION
+    assert link.era_regressions == 1
+    # Installed lineage untouched: no zombie endpoint appeared.
+    assert sorted(e.hostport for e in ds.endpoints() if e.cluster) == before
+    assert link.installed_era == (2, 50)
+
+
+@pytest.mark.parametrize("zombie_first", [True, False])
+def test_split_brain_interleave_converges_on_max_era(zombie_first):
+    """Frames from both lineages of a healed partition, in either
+    interleaving order: the installed era ratchets to max(era) and the
+    loser's frames all reject — deterministic convergence."""
+    pub_a, _ = make_peer_pub(
+        endpoints=[("10.9.1.1:8000", 0.0, 0.0, False)], era=(1, 10))
+    pub_b, _ = make_peer_pub(
+        endpoints=[("10.9.2.1:8000", 0.0, 0.0, False)], era=(2, 7))
+    state, ds, _ = make_state()
+    order = [pub_a, pub_b] if zombie_first else [pub_b, pub_a]
+    calls = {"n": 0}
+
+    def fetch(url, since, era, etag, wait_s):
+        pub = order[calls["n"] % 2]
+        calls["n"] += 1
+        return pub.serve()
+
+    link = make_link(pub_a, state, fetch=fetch)
+    outcomes = [link.poll_once() for _ in range(6)]
+    assert link.installed_era == (2, 7)
+    assert ERA_REGRESSION in outcomes or STALE_EPOCH in outcomes
+    # Only the winning lineage's endpoints are installed.
+    remote = sorted(e.hostport for e in ds.endpoints() if e.cluster)
+    assert remote == ["10.9.2.1:8000"]
+
+
+def test_era_flip_mid_delta_forces_full_snapshot():
+    pub, eps = make_peer_pub(era=(1, 5))
+    state, ds, _ = make_state()
+    link = make_link(pub, state)
+    assert link.poll_once() == INSTALLED
+    # The peer fails over: greater era. The link's next request still
+    # asks for a delta against the OLD era; the publisher serves a full
+    # snapshot (era mismatch), which must install with the new era.
+    pub.bump_era()
+    eps.append(("10.9.0.7:8000", 0.0, 0.0, False))
+    pub.refresh()
+    assert link.poll_once() == INSTALLED
+    assert link.installed_era == pub.era
+    assert link.era_flips == 1
+
+
+def test_stale_epoch_replay_rejected():
+    pub, _ = make_peer_pub()
+    state, _, _ = make_state()
+    replay = {}
+
+    def fetch(url, since, era, etag, wait_s):
+        if "frame" not in replay:
+            replay["frame"] = pub.serve()
+        return replay["frame"]  # the same frame forever
+
+    link = make_link(pub, state, fetch=fetch)
+    assert link.poll_once() == INSTALLED
+    assert link.poll_once() == STALE_EPOCH
+    assert link.rejects == 1
+
+
+def test_full_snapshot_without_meta_rejected():
+    state, _, _ = make_state()
+
+    def fetch(url, since, era, etag, wait_s):
+        blob = codec.encode_digest(1, {
+            summary.LOAD_SECTION: {"hostports": np.zeros((0, 8), np.uint8),
+                                   "queue": np.zeros(0, np.float32),
+                                   "kv": np.zeros(0, np.float32),
+                                   "draining": np.zeros(0, np.uint8)}})
+        return 200, {}, blob
+
+    link = PeerLink("west", "mem://x", state.install_peer, fetch=fetch,
+                    wait_s=0.0, interval_s=0.0)
+    assert link.poll_once() == "rejected"
+
+
+# -- cross-version forward compat / corruption fuzz ------------------------
+
+
+def test_unknown_sections_and_arrays_skip_unknown():
+    """A NEWER peer ships sections and arrays this build has no home
+    for: the frame installs, unknowns are ignored."""
+    state, ds, _ = make_state()
+    meta = summary.encode_meta((1, 1), False, "west")
+    load = summary.encode_load(
+        [("10.9.0.1:8000", 1.0, 0.1, False)], max_endpoints=8)
+    load["future_column"] = np.ones(1, np.float32)  # unknown array
+    blob = codec.encode_digest(1, {
+        summary.META_SECTION: meta,
+        summary.LOAD_SECTION: load,
+        "fed.future-section": {"x": np.arange(4, dtype=np.uint32)},
+    })
+
+    def fetch(url, since, era, etag, wait_s):
+        return 200, {}, blob
+
+    link = PeerLink("west", "mem://x", state.install_peer, fetch=fetch,
+                    wait_s=0.0, interval_s=0.0)
+    state.register_peer("west", link)
+    assert link.poll_once() == INSTALLED
+    assert [e.hostport for e in ds.endpoints() if e.cluster] == [
+        "10.9.0.1:8000"]
+
+
+def test_corrupted_frames_reject_and_keep_state():
+    """Byte-flip fuzz across a valid frame through the LINK path: every
+    mutation either rejects whole (corrupt/stale/regression) or decodes
+    to the identical install — never a partial/garbled install."""
+    pub, _ = make_peer_pub()
+    state, ds, _ = make_state()
+    link = make_link(pub, state)
+    assert link.poll_once() == INSTALLED
+    baseline = sorted(e.hostport for e in ds.endpoints() if e.cluster)
+    status, headers, body = pub.serve()
+    rng = np.random.default_rng(7)
+    outcomes = set()
+    for _ in range(64):
+        i = int(rng.integers(len(body)))
+        flipped = bytearray(body)
+        flipped[i] ^= 1 << int(rng.integers(8))
+
+        def fetch(url, since, era, etag, wait_s, b=bytes(flipped)):
+            return 200, dict(headers), b
+
+        link._fetch = fetch
+        link._next_poll = 0.0
+        link._fail_streak = 0  # keep the breaker out of the fuzz loop
+        link._open_until = 0.0
+        out = link.poll_once()
+        outcomes.add(out)
+        assert out in (CORRUPT, STALE_EPOCH, ERA_REGRESSION, "rejected",
+                       DELTA_MISMATCH)
+        assert sorted(
+            e.hostport for e in ds.endpoints() if e.cluster) == baseline
+    assert CORRUPT in outcomes  # the CRC guard actually fired
+
+
+# -- link robustness -------------------------------------------------------
+
+
+def test_link_breaker_opens_and_half_open_probe_recovers():
+    pub, _ = make_peer_pub()
+    state, _, _ = make_state()
+    broken = {"on": True}
+
+    def fetch(url, since, era, etag, wait_s):
+        if broken["on"]:
+            raise ConnectionError("severed")
+        return pub.serve(since=since, era=era, if_none_match=etag)
+
+    link = make_link(pub, state, fetch=fetch, open_after=3, open_s=0.2)
+    now = time.monotonic()
+    assert link.poll_once(now) == FETCH_ERROR
+    link._next_poll = 0.0
+    assert link.poll_once(now) == FETCH_ERROR
+    link._next_poll = 0.0
+    assert link.poll_once(now) == FETCH_ERROR
+    assert link.breaker_open()
+    link._next_poll = 0.0
+    # One observable breaker_open outcome per dwell, then silence.
+    assert link.poll_once() == BREAKER_OPEN
+    link._next_poll = 0.0
+    assert link.poll_once() is None  # open: no fetch at all
+    # Dwell passes; the half-open probe fails -> re-opens.
+    link._open_until = 0.0
+    link._next_poll = 0.0
+    assert link.poll_once() == FETCH_ERROR
+    assert link.breaker_open()
+    # Peer comes back: the next probe closes the breaker and installs.
+    broken["on"] = False
+    link._open_until = 0.0
+    link._next_poll = 0.0
+    assert link.poll_once() == INSTALLED
+    assert not link.breaker_open()
+
+
+def test_staleness_drives_local_only_and_penalty_inflation():
+    pub, _ = make_peer_pub()
+    clock = {"t": 1000.0}
+    state, ds, store = make_state(
+        stale_inflate_s=1.0, local_only_after_s=2.0,
+        clock=lambda: clock["t"])
+    link = make_link(pub, state)
+    assert link.poll_once() == INSTALLED
+    slots = [e.slot for e in ds.endpoints() if e.cluster]
+    fresh_q = store.pool_rows(slots)[0][:, C.Metric.QUEUE_DEPTH].copy()
+    # Sever the link; staleness inflates the penalty rows.
+    link.last_contact_at = time.monotonic() - 1.5
+    clock["t"] += 10.0
+    state.observe()
+    stale_q = store.pool_rows(slots)[0][:, C.Metric.QUEUE_DEPTH]
+    assert np.all(stale_q > fresh_q)
+    view = state._peers["west"]
+    assert not view.local_only
+    # Past the floor: LOCAL-ONLY — rows saturate, spillover excludes.
+    link.last_contact_at = time.monotonic() - 5.0
+    clock["t"] += 10.0
+    state.observe()
+    assert view.local_only and view.local_only_spells == 1
+    sat_q = store.pool_rows(slots)[0][:, C.Metric.QUEUE_DEPTH]
+    assert np.all(sat_q >= state.spill_queue_limit)
+    assert state.spill_candidates(
+        STD, np.asarray([0]), np.full(64, 99.0)) is None
+    # A fresh confirm readmits: the 304 resets the staleness clock and
+    # the next observe tick applies the blackout-lift rule.
+    link._next_poll = 0.0
+    assert link.poll_once() == NOT_MODIFIED
+    clock["t"] += 1.0
+    state.observe()
+    assert not view.local_only
+
+
+# -- spill policy ----------------------------------------------------------
+
+
+def install_simple_peer(state, pub=None):
+    pub = pub if pub is not None else make_peer_pub()[0]
+    link = make_link(pub, state)
+    assert link.poll_once() == INSTALLED
+    return link
+
+
+def test_spill_rules_band_and_saturation():
+    state, ds, _ = make_state()
+    install_simple_peer(state)
+    sat = np.full(64, 99.0)
+    idle = np.zeros(64)
+    local = np.asarray([0])
+    # Unsaturated local: nobody spills.
+    assert state.spill_candidates(STD, local, idle) is None
+    # Saturated local: STANDARD spills, CRITICAL stays home.
+    assert state.spill_candidates(STD, local, sat)
+    assert state.spill_candidates(CRIT, local, sat) is None
+    # No local candidate at all: CRITICAL may cross (availability).
+    assert state.spill_candidates(CRIT, np.asarray([], np.int64), sat)
+
+
+def test_peer_draining_and_drain_mode():
+    # A peer that flags DRAINING is excluded from spillover.
+    pub_d, _ = make_peer_pub(draining=True)
+    state, ds, _ = make_state()
+    install_simple_peer(state, pub_d)
+    assert state.spill_candidates(STD, np.asarray([0]),
+                                  np.full(64, 99.0)) is None
+    # Our own drain: remote-first for every band, regardless of load.
+    state2, ds2, _ = make_state(ds=make_datastore())
+    install_simple_peer(state2)
+    state2.draining = True
+    out = state2.spill_candidates(CRIT, np.asarray([0]), np.zeros(64))
+    assert out and all(e.cluster == "west" for e in out)
+
+
+def test_capacity_matrix_rows():
+    state, ds, _ = make_state()
+    install_simple_peer(state)
+    matrix = state.capacity_matrix()
+    assert matrix["east"]["local"] is True
+    assert matrix["east"]["endpoints"] == 1
+    west = matrix["west"]
+    assert west["endpoints"] == 2 and west["local"] is False
+    assert west["era"] == [1, 42]
+    assert west["penalty"] >= 0.0 and "staleness_s" in west
+
+
+def test_prefix_fold_diffs_into_scheduler():
+    calls = []
+
+    class FakeScheduler:
+        def apply_prefix_events(self, slot, stored, removed):
+            calls.append((slot, stored.tolist(), removed.tolist()))
+
+    state, ds, _ = make_state(scheduler=FakeScheduler())
+    pub, _ = make_peer_pub()
+    link = make_link(pub, state)
+    link.poll_once()
+    state.install_peer("west", {
+        summary.PREFIX_SECTION: summary.encode_prefix(
+            np.asarray([5, 6], np.uint32), max_keys=16)}, delta=True)
+    slots = sorted(e.slot for e in ds.endpoints() if e.cluster)
+    assert sorted(c[0] for c in calls) == slots
+    assert all(c[1] == [5, 6] and c[2] == [] for c in calls)
+    calls.clear()
+    # The next summary drops 5 and adds 7: only the DIFF folds.
+    state.install_peer("west", {
+        summary.PREFIX_SECTION: summary.encode_prefix(
+            np.asarray([6, 7], np.uint32), max_keys=16)}, delta=True)
+    assert all(c[1] == [7] and c[2] == [5] for c in calls)
+
+
+# -- datastore imports -----------------------------------------------------
+
+
+def test_external_endpoints_lifecycle():
+    ds = make_datastore(local_pods=2)
+    reclaimed = []
+    ds._on_slot_reclaimed = reclaimed.append
+    ep = ds.external_upsert("west", "10.9.0.1:8000", "10.9.0.1", 8000)
+    assert ep.cluster == "west" and ep.slot >= 0
+    assert ds.endpoint_by_hostport("10.9.0.1:8000") is ep
+    # Default candidacy excludes imports; endpoints() includes them.
+    assert ep not in ds.pick_candidates()
+    assert ep in ds.endpoints()
+    assert ep not in ds.local_endpoints()
+    # Refresh in place keeps the slot sticky.
+    ep2 = ds.external_upsert("west", "10.9.0.1:8000", "10.9.0.9", 8000)
+    assert ep2.slot == ep.slot and ep2.address == "10.9.0.9"
+    ds.external_remove("west", "10.9.0.1:8000")
+    assert reclaimed == [ep.slot]
+    assert ds.endpoint_by_hostport("10.9.0.9:8000") is None
+
+
+def test_external_clear_and_resync_skips_imports():
+    ds = make_datastore(local_pods=1)
+    ds.external_upsert("west", "a", "10.9.0.1", 8000)
+    ds.external_upsert("west", "b", "10.9.0.2", 8000)
+    ds.external_upsert("north", "c", "10.9.1.1", 8000)
+    # A pool resync (selector change) must not evict imports.
+    ds.pool_set(EndpointPool(selector={"app": "y"}, target_ports=[8000],
+                             namespace="default"), pod_lister=lambda: [])
+    assert len([e for e in ds.endpoints() if e.cluster]) == 3
+    assert ds.external_clear("west") == 2
+    assert sorted(e.cluster for e in ds.endpoints() if e.cluster) == [
+        "north"]
+
+
+def test_pick_candidates_availability_ladder():
+    ds = make_datastore(local_pods=1)
+    remote = ds.external_upsert("west", "r", "10.9.0.1", 8000)
+    local = [e for e in ds.endpoints() if not e.cluster][0]
+    # Healthy local wins.
+    assert ds.pick_candidates() == [local]
+    # Draining local still beats remote (in-flight locality).
+    ds.pod_mark_draining("default", "l0")
+    assert ds.pick_candidates() == [local]
+    # No local at all: healthy remote is the availability floor.
+    ds.pod_delete("default", "l0")
+    assert ds.pick_candidates() == [remote]
+
+
+# -- fault points ----------------------------------------------------------
+
+
+def test_fault_peer_publish_error_and_corrupt():
+    pub, _ = make_peer_pub()
+    state, _, _ = make_state()
+    link = make_link(pub, state)
+    faults.install(faults.FaultInjector(
+        3, {"peer.publish": faults.FaultRule(p_error=1.0, max_fires=1)}))
+    try:
+        assert link.poll_once() == FETCH_ERROR  # 503 from the serve side
+        link._next_poll = 0.0
+        assert link.poll_once() == INSTALLED    # rule exhausted
+        faults.install(faults.FaultInjector(
+            4, {"peer.publish": faults.FaultRule(
+                p_corrupt=1.0, max_fires=1)}))
+        link.last_etag = None  # force a body (304 carries none)
+        link._want_full = True
+        link._next_poll = 0.0
+        assert link.poll_once() == CORRUPT      # CRC guard absorbed it
+    finally:
+        faults.uninstall()
+
+
+def test_fault_peer_poll_and_partition_scoped_by_key():
+    pub, _ = make_peer_pub()
+    state, _, _ = make_state()
+    link_w = make_link(pub, state, name="west")
+    pub_n, _ = make_peer_pub(cluster="north")
+    state2, _, _ = make_state(ds=make_datastore())
+    link_n = make_link(pub_n, state2, name="north")
+    faults.install(faults.FaultInjector(5, {
+        "peer.partition": faults.FaultRule(p_error=1.0, keys=("west",)),
+        "peer.poll": faults.FaultRule(p_error=0.0),
+    }))
+    try:
+        assert link_w.poll_once() == FETCH_ERROR  # severed
+        assert link_n.poll_once() == INSTALLED    # other peer unaffected
+        faults.install(faults.FaultInjector(6, {
+            "peer.poll": faults.FaultRule(p_error=1.0, max_fires=1)}))
+        link_w._fail_streak = 0
+        link_w._open_until = 0.0
+        link_w._next_poll = 0.0
+        assert link_w.poll_once() == FETCH_ERROR  # flaky link point
+    finally:
+        faults.uninstall()
+
+
+def test_new_fault_points_registered():
+    for point in ("peer.poll", "peer.publish", "peer.partition"):
+        assert point in faults.CATALOG
+
+
+# -- breaker-open pacing ---------------------------------------------------
+
+
+def test_era_str_wire_form():
+    assert era_str((2, 0xAB)) == "2.00000000000000ab"
+
+
+# -- ClusterSet over live watches (fakeapi) --------------------------------
+
+
+def _export_pool_manifest(name="pool", export=True):
+    from gie_tpu.api import types as api
+
+    annotations = (
+        {api.EXPORT_ANNOTATION: api.EXPORT_SCOPE_CLUSTERSET}
+        if export else {})
+    return {
+        "apiVersion": f"{api.GROUP}/{api.VERSION}",
+        "kind": "InferencePool",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": annotations},
+        "spec": {
+            "selector": {"matchLabels": {"app": "vllm"}},
+            "targetPorts": [{"number": 8000}],
+            "endpointPickerRef": {"name": "epp",
+                                  "port": {"number": 9002}},
+        },
+    }
+
+
+def _wait(pred, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def test_clusterset_reconciles_over_live_watches():
+    """The ISSUE-12 satellite: InferencePoolImport support in fakeapi +
+    the MultiClusterController driving ClusterSet reconciliation
+    end-to-end over real watch streams — exported pool in east
+    materializes an import in west, carries the Exported condition back
+    onto the pool, and prunes the import when the export stops."""
+    from fakeapi import FakeKubeApiServer
+    from gie_tpu.api import types as api
+    from gie_tpu.controller.kube import KubeClusterClient
+    from gie_tpu.controller.multicluster import (
+        CONTROLLER_NAME,
+        MultiClusterController,
+    )
+
+    east, west = FakeKubeApiServer(), FakeKubeApiServer()
+    ctl = MultiClusterController({
+        "east": KubeClusterClient("default", "pool", server=east.url),
+        "west": KubeClusterClient("default", "pool", server=west.url),
+    })
+    ctl.start()
+    try:
+        east.apply("pools", _export_pool_manifest())
+        key = ("imports", "default", "pool")
+        assert _wait(lambda: key in west._objects)
+        imp = api.import_from_dict(west._objects[key])
+        ctrl = imp.status.controllers[0]
+        assert ctrl.name == CONTROLLER_NAME
+        assert [c.name for c in ctrl.exportingClusters] == ["east"]
+        # Never an import in the exporting cluster itself.
+        assert key not in east._objects
+        # Exported condition patched onto the pool's status.
+        assert _wait(lambda: any(
+            n == "pool" for _ns, n, _p in east.status_patches))
+        # The loop settles: no self-chasing status-patch churn.
+        n1 = ctl.reconciles
+        time.sleep(0.6)
+        assert ctl.reconciles - n1 <= 1
+        # Export withdrawn -> the import is pruned.
+        east.apply("pools", _export_pool_manifest(export=False))
+        assert _wait(lambda: key not in west._objects)
+    finally:
+        ctl.stop()
+        east.close()
+        west.close()
+
+
+def test_import_serializers_roundtrip():
+    from gie_tpu.api import types as api
+
+    imp = api.InferencePoolImport(
+        metadata=api.ObjectMeta(name="pool", namespace="ns"),
+        status=api.InferencePoolImportStatus(controllers=[
+            api.ImportController(
+                name="c", exportingClusters=[api.ExportingCluster("e")]),
+        ]))
+    d = api.import_to_dict(imp)
+    assert d["kind"] == "InferencePoolImport"
+    back = api.import_from_dict(d)
+    assert back.metadata.name == "pool"
+    assert back.status.controllers[0].exportingClusters[0].name == "e"
+    # A status-only object keeps a present (empty) status.
+    assert "status" in api.import_to_dict(api.InferencePoolImport(
+        metadata=api.ObjectMeta(name="x")))
+
+
+def test_external_upsert_refuses_local_hostport_collision():
+    """Overlapping pod CIDRs across clusters: a peer advertising a
+    hostport a LOCAL pod owns is refused — local wins (importing would
+    hijack serve-outcome attribution and, on removal, delete the local
+    pod's hostport mapping)."""
+    ds = make_datastore(local_pods=1)  # local owns 10.1.0.1:8000
+    assert ds.external_upsert("west", "clash", "10.1.0.1", 8000) is None
+    local = ds.endpoint_by_hostport("10.1.0.1:8000")
+    assert local is not None and not local.cluster
+    # Non-colliding imports still admit.
+    first = ds.external_upsert("west", "ok", "10.9.0.1", 8000)
+    assert first is not None
+    # Remote-remote collisions refuse too (first owner wins — a second
+    # claimant would hijack attribution and delete the mapping on its
+    # removal).
+    assert ds.external_upsert("north", "dup", "10.9.0.1", 8000) is None
+    assert ds.endpoint_by_hostport("10.9.0.1:8000") is first
+
+
+def test_install_rejects_mismatched_cluster_name():
+    """A digest whose fed.meta names a different cluster than the link
+    is configured for (typo'd --fed-peer URL) must reject whole."""
+    pub, _ = make_peer_pub(cluster="east-actually")
+    state, ds, _ = make_state()
+    link = make_link(pub, state)  # configured as "west"
+    assert link.poll_once() == "rejected"
+    assert not [e for e in ds.endpoints() if e.cluster]
+
+
+def test_clusterset_repairs_out_of_band_import_deletion():
+    """Level-triggered imports: an import deleted out-of-band is
+    re-created on the next reconcile, and a 404 on DELETE (already
+    gone) is treated as success, not retried forever."""
+    from fakeapi import FakeKubeApiServer
+    from gie_tpu.controller.kube import KubeClusterClient
+    from gie_tpu.controller.multicluster import MultiClusterController
+
+    east, west = FakeKubeApiServer(), FakeKubeApiServer()
+    ctl = MultiClusterController({
+        "east": KubeClusterClient("default", "pool", server=east.url),
+        "west": KubeClusterClient("default", "pool", server=west.url),
+    })
+    ctl.start()
+    try:
+        east.apply("pools", _export_pool_manifest())
+        key = ("imports", "default", "pool")
+        assert _wait(lambda: key in west._objects)
+        # Out-of-band deletion, then any pool event: repaired.
+        west.delete("imports", "default", "pool")
+        manifest = _export_pool_manifest()
+        manifest["metadata"]["labels"] = {"touched": "1"}
+        east.apply("pools", manifest)
+        assert _wait(lambda: key in west._objects)
+        # Out-of-band deletion + export withdrawn: the DELETE 404 must
+        # settle (key forgotten), not error-loop.
+        west.delete("imports", "default", "pool")
+        east.apply("pools", _export_pool_manifest(export=False))
+        assert _wait(lambda: not ctl._written)
+        assert key not in west._objects
+    finally:
+        ctl.stop()
+        east.close()
+        west.close()

@@ -737,3 +737,155 @@ def test_pick_result_record_updates_on_abort():
         assert rec["outcome"] == "reset"
     finally:
         picker.close()
+
+
+# --------------------------------------------------------------------------
+# OTLP span export (ISSUE 12 satellite, docs/OBSERVABILITY.md "OTLP
+# export"): trace dicts -> OTLP/HTTP JSON spans, batched off the hot
+# path, federation hops as child spans — one joined trace per
+# cross-cluster pick.
+# --------------------------------------------------------------------------
+
+
+def _trace_dict(trace_id="ab" * 16, outcome="ok", events=None):
+    return {
+        "trace_id": trace_id,
+        "request_id": "rid-1",
+        "sampled": True,
+        "outcome": outcome,
+        "latency_ms": 12.5,
+        "finished_at": 1700000000.0,
+        "events": events if events is not None else [
+            {"stage": "admission", "at_ms": 0.0},
+            {"stage": "picked", "at_ms": 3.0},
+        ],
+        "pick": {"chosen": "10.0.0.1:8000", "rung": "full",
+                 "outcome": "picked"},
+    }
+
+
+def test_otlp_span_mapping_root_and_events():
+    from gie_tpu.obs.otlp import trace_to_spans
+
+    spans = trace_to_spans(_trace_dict())
+    assert len(spans) == 1
+    root = spans[0]
+    assert root["traceId"] == "ab" * 16
+    assert len(root["spanId"]) == 16
+    assert root["name"] == "gie.request"
+    assert [e["name"] for e in root["events"]] == ["admission", "picked"]
+    assert int(root["endTimeUnixNano"]) > int(root["startTimeUnixNano"])
+    assert root["status"]["code"] == 1
+    # Error-class outcomes map to STATUS_CODE_ERROR.
+    bad = trace_to_spans(_trace_dict(outcome="serve_5xx"))[0]
+    assert bad["status"]["code"] == 2
+    # Deterministic span IDs: replays and replicas agree.
+    again = trace_to_spans(_trace_dict())[0]
+    assert again["spanId"] == root["spanId"]
+
+
+def test_otlp_federation_hop_is_a_child_span():
+    from gie_tpu.obs.otlp import trace_to_spans
+
+    spans = trace_to_spans(_trace_dict(events=[
+        {"stage": "admission", "at_ms": 0.0},
+        {"stage": "federation:west", "at_ms": 2.0},
+        {"stage": "picked", "at_ms": 3.0},
+    ]))
+    assert len(spans) == 2
+    root, hop = spans
+    assert hop["name"] == "gie.federation"
+    assert hop["parentSpanId"] == root["spanId"]
+    assert hop["traceId"] == root["traceId"]
+    assert {"key": "gie.peer_cluster",
+            "value": {"stringValue": "west"}} in hop["attributes"]
+
+
+def test_otlp_exporter_batches_to_http_sink():
+    """The wired path: Tracer.on_export -> exporter queue -> background
+    batch POST to a real local HTTP collector sink."""
+    import http.server
+
+    from gie_tpu.obs.otlp import OtlpSpanExporter
+    from gie_tpu.obs.trace import Tracer
+
+    bodies = []
+    got = threading.Event()
+
+    class Sink(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            bodies.append(json.loads(self.rfile.read(n)))
+            got.set()
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    exporter = OtlpSpanExporter(
+        f"http://127.0.0.1:{httpd.server_address[1]}",
+        flush_interval_s=0.05)
+    tracer = Tracer(1.0)
+    tracer.on_export = exporter.export
+    try:
+        ctx = tracer.begin({})
+        ctx.event("federation:west")
+        tracer.finish(ctx, "ok")
+        assert got.wait(5.0), "sink never received a batch"
+        payload = bodies[0]
+        spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        names = {s["name"] for s in spans}
+        assert names == {"gie.request", "gie.federation"}
+        res_attrs = payload["resourceSpans"][0]["resource"]["attributes"]
+        assert {"key": "service.name",
+                "value": {"stringValue": "gie-tpu-epp"}} in res_attrs
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and exporter.exported < 2:
+            time.sleep(0.02)  # the POST finishes after the sink flags
+        assert exporter.exported == 2 and exporter.post_errors == 0
+    finally:
+        exporter.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_otlp_exporter_never_blocks_or_dies_on_dead_collector():
+    from gie_tpu.obs.otlp import OtlpSpanExporter
+
+    # Nothing listens on this port: posts fail, exports drop, the sink
+    # call stays instant.
+    exporter = OtlpSpanExporter("http://127.0.0.1:1", timeout_s=0.2,
+                                flush_interval_s=0.05, queue_max=4)
+    try:
+        t0 = time.monotonic()
+        for i in range(32):  # overflow the bounded queue too
+            exporter.export(_trace_dict())
+        assert time.monotonic() - t0 < 0.5, "export blocked the caller"
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and exporter.post_errors == 0:
+            time.sleep(0.05)
+        assert exporter.post_errors > 0
+        assert exporter.dropped > 0
+        assert exporter.exported == 0
+    finally:
+        exporter.close()
+    report = exporter.report()
+    assert report["url"].endswith("/v1/traces")
+
+
+def test_tracer_on_export_failures_never_fail_teardown():
+    from gie_tpu.obs.trace import Tracer
+
+    tracer = Tracer(1.0)
+
+    def boom(trace):
+        raise RuntimeError("sink bug")
+
+    tracer.on_export = boom
+    ctx = tracer.begin({})
+    tracer.finish(ctx, "ok")  # must not raise
+    assert tracer.exported_total == 1

@@ -647,3 +647,75 @@ def test_install_kv_merge_respects_ttl_and_eviction():
     f2.evict_endpoint(5)
     assert f2.install_state(digest)
     assert not _has_presence_bit(f2, 0xBEEFCAFE, 5)
+
+
+# --------------------------------------------------------------------------
+# Cross-version digest forward compat between PEERS (ISSUE 12 satellite):
+# a newer build's digest — unknown sections, unknown arrays inside known
+# sections — must install cleanly on an older follower (skip-unknown),
+# while corrupted frames and era regressions reject whole.
+# --------------------------------------------------------------------------
+
+
+def test_follower_skips_unknown_sections_from_newer_peer():
+    sched = _warm_scheduler()
+    blob = codec.encode_digest(5, {
+        "sched": sched.export_state(),
+        "fed.meta": {"era": np.asarray([1, 2], np.uint64)},
+        "totally.future": {"x": np.arange(8, dtype=np.float32)},
+    })
+    digest = codec.decode_digest(blob)
+    assert digest is not None
+    assert set(digest.sections) == {"sched", "fed.meta", "totally.future"}
+    # The manager's installer routes known sections and SKIPS unknowns.
+    from gie_tpu.replication.manager import ReplicationManager
+
+    follower_sched = _warm_scheduler()
+    mgr = ReplicationManager(scheduler=follower_sched, port=0)
+    try:
+        assert mgr._install(digest.sections, delta=False)
+    finally:
+        mgr.stop()
+
+
+def test_peer_frames_fuzz_corruption_rejects_whole(seeded_rng=None):
+    """Every byte-flip of a federation-shaped digest must decode to
+    None (CRC guard) or decode to an identical-content frame — never a
+    silently different install (the same every-byte property the PR-3
+    codec pinned, re-asserted over the federation sections)."""
+    from gie_tpu.federation import summary as fed_summary
+
+    sections = {
+        fed_summary.META_SECTION: fed_summary.encode_meta(
+            (3, 77), False, "west"),
+        fed_summary.LOAD_SECTION: fed_summary.encode_load(
+            [("10.9.0.1:8000", 1.5, 0.25, False)], max_endpoints=4),
+    }
+    blob = codec.encode_digest(9, sections)
+    baseline = codec.decode_digest(blob)
+    assert baseline is not None
+    rng = np.random.default_rng(11)
+    for _ in range(256):
+        i = int(rng.integers(len(blob)))
+        flipped = bytearray(blob)
+        flipped[i] ^= 1 << int(rng.integers(8))
+        digest = codec.decode_digest(bytes(flipped))
+        if digest is None:
+            continue  # rejected whole: the contract
+        meta = fed_summary.decode_meta(
+            digest.sections.get(fed_summary.META_SECTION))
+        if meta is None:
+            continue  # malformed KNOWN section: the installer rejects
+        # Anything that decodes as meta must carry an ordered era pair
+        # — a flipped era would be caught by the CRC, so it is intact.
+        assert meta.era == (3, 77)
+
+
+def test_era_pair_ordering_is_total():
+    """The split-brain convergence rule rests on tuple ordering: seq
+    dominates, token breaks ties — total and deterministic."""
+    assert (2, 0) > (1, 2**62)
+    assert (1, 5) > (1, 4)
+    eras = [(2, 1), (1, 9), (2, 0), (1, 2)]
+    assert max(eras) == (2, 1)
+    assert sorted(eras) == sorted(eras, key=lambda e: (e[0], e[1]))

@@ -378,3 +378,161 @@ def test_rolling_upgrade_zero_client_visible_5xx(flight_recorder):
     finally:
         stop.set()
         picker.close()
+
+
+# --------------------------------------------------------------------------
+# gie-fed federation chaos scenarios (ISSUE 12, docs/FEDERATION.md):
+# replayed against an in-memory exchange — partition degradation with
+# state kept, split-brain convergence under a flaky link, and the
+# bit-identical same-seed fault log (chaos-ci gates these).
+# --------------------------------------------------------------------------
+
+
+def _fed_fixture(local_only_after_s=0.25):
+    from gie_tpu.federation import FederationState
+    from gie_tpu.federation import summary as fed_summary
+    from gie_tpu.federation.exchange import FederationPublisher, PeerLink
+
+    ds = Datastore()
+    ds.pool_set(EndpointPool(selector={"app": "x"}, target_ports=[8000],
+                             namespace="default"))
+    ds.pod_update_or_add(Pod(name="l0", labels={"app": "x"},
+                             ip="10.1.0.1"))
+    store = MetricsStore()
+    state = FederationState(
+        ds, store, cluster="east", penalty=2.0,
+        stale_inflate_s=0.1, local_only_after_s=local_only_after_s,
+        spill_queue_limit=8.0)
+    pub = FederationPublisher({
+        fed_summary.META_SECTION: lambda: fed_summary.encode_meta(
+            pub.era, False, "west"),
+        fed_summary.LOAD_SECTION: lambda: fed_summary.encode_load(
+            [("10.9.0.1:8000", 1.0, 0.1, False)], max_endpoints=8),
+    }, era_seq=1, era_token=9)
+    pub.refresh()
+
+    def fetch(url, since, era, etag, wait_s):
+        return pub.serve(since=since, era=era, if_none_match=etag)
+
+    link = PeerLink("west", "mem://west", state.install_peer,
+                    fetch=fetch, wait_s=0.0, interval_s=0.0,
+                    open_after=3, open_s=0.05)
+    state.register_peer("west", link)
+    return state, ds, store, pub, link
+
+
+def _drive_fed_partition(scn):
+    """Replay fed-partition: poll the link through the scenario's fault
+    schedule, recording the local-only timeline."""
+    drive = scn.drive["federation"]
+    state, ds, store, pub, link = _fed_fixture(
+        local_only_after_s=float(drive["local_only_after_s"]))
+    inj = scn.arm()
+    timeline = []
+    try:
+        assert link.poll_once() == "installed"  # healthy first contact
+        for _ in range(int(drive["poll_rounds"])):
+            link._next_poll = 0.0
+            link._open_until = min(link._open_until, time.monotonic())
+            link.poll_once()
+            state._last_refresh = 0.0  # bypass the 4 Hz rate limit
+            state.observe()
+            view = state._peers["west"]
+            timeline.append((link.fetch_errors, view.local_only))
+            time.sleep(float(drive["round_sleep_s"]))
+    finally:
+        faults.uninstall()
+    return timeline, state, ds, link, inj
+
+
+def test_fed_partition_scenario_degrades_and_recovers():
+    scn = scenarios.load("fed-partition")
+    timeline, state, ds, link, inj = _drive_fed_partition(scn)
+    view = state._peers["west"]
+    # The partition fired, drove fetch errors, and the peer degraded to
+    # LOCAL-ONLY — with the imported endpoint KEPT (frozen, saturated),
+    # never evicted.
+    assert link.fetch_errors > 0
+    assert any(lo for _e, lo in timeline), "never degraded to local-only"
+    assert [e.hostport for e in ds.endpoints() if e.cluster] == [
+        "10.9.0.1:8000"]
+    # The schedule exhausts (max_fires) and the link recovers: the
+    # final verdict is readmitted.
+    assert not view.local_only, "never readmitted after the heal"
+    assert inj.fired.get("peer.partition", 0) == 40
+
+
+def test_fed_partition_scenario_fault_log_is_deterministic():
+    scn = scenarios.load("fed-partition")
+    _, _, _, _, inj_a = _drive_fed_partition(scn)
+    _, _, _, _, inj_b = _drive_fed_partition(scn)
+    assert inj_a.log == inj_b.log
+    assert inj_a.fired == inj_b.fired
+
+
+def test_fed_split_brain_heal_scenario_converges():
+    """fed-split-brain-heal: both lineages of a healed partition publish
+    through a flaky link (peer.poll 20%); the importer converges on the
+    greater era with zero mixed-lineage installs."""
+    from gie_tpu.federation import summary as fed_summary
+    from gie_tpu.federation.exchange import FederationPublisher
+
+    scn = scenarios.load("fed-split-brain-heal")
+    drive = scn.drive["federation"]
+    assert drive["zombie_interleave"] is True
+    state, ds, store, pub_old, link = _fed_fixture()
+    # The new lineage: greater era, DIFFERENT endpoint set — a mixed
+    # install would be visible as a union of the two sets.
+    pub_new = FederationPublisher({
+        fed_summary.META_SECTION: lambda: fed_summary.encode_meta(
+            pub_new.era, False, "west"),
+        fed_summary.LOAD_SECTION: lambda: fed_summary.encode_load(
+            [("10.9.2.1:8000", 0.5, 0.0, False)], max_endpoints=8),
+    }, era_seq=2, era_token=3)
+    pub_new.refresh()
+    flip = {"n": 0}
+
+    def fetch(url, since, era, etag, wait_s):
+        flip["n"] += 1
+        pub = pub_old if flip["n"] % 2 == 0 else pub_new
+        return pub.serve()  # full frames from whichever side answers
+
+    link._fetch = fetch
+    inj = scn.arm()
+    try:
+        for _ in range(int(drive["poll_rounds"])):
+            link._next_poll = 0.0
+            link._fail_streak = 0  # the flaky link must keep polling
+            link._open_until = 0.0
+            link.poll_once()
+            # Lineage purity at EVERY step: the installed endpoint set
+            # is exactly one side's, never a union.
+            remote = sorted(
+                e.hostport for e in ds.endpoints() if e.cluster)
+            assert remote in ([], ["10.9.0.1:8000"], ["10.9.2.1:8000"]), (
+                remote)
+    finally:
+        faults.uninstall()
+    assert link.installed_era == (2, 3), "did not converge on max era"
+    assert link.era_regressions > 0, "the zombie was never rejected"
+    assert sorted(e.hostport for e in ds.endpoints() if e.cluster) == [
+        "10.9.2.1:8000"]
+    assert inj.fired.get("peer.poll", 0) > 0, "the flaky link never fired"
+
+
+def test_fed_split_brain_fault_log_is_deterministic():
+    scn = scenarios.load("fed-split-brain-heal")
+    logs = []
+    for _ in range(2):
+        state, ds, store, pub, link = _fed_fixture()
+        inj = scn.arm()
+        try:
+            for _ in range(20):
+                link._next_poll = 0.0
+                link._fail_streak = 0
+                link._open_until = 0.0
+                link.poll_once()
+        finally:
+            faults.uninstall()
+        logs.append(list(inj.log))
+    assert logs[0] == logs[1]

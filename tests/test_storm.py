@@ -783,3 +783,163 @@ def test_storm_soak_full_stack_degrades_and_recovers(tmp_path):
     epochs = [c["epoch"] for c in checks]
     assert epochs == sorted(epochs) and epochs[-1] > epochs[0]
     SC.validate(card)
+
+
+# ==========================================================================
+# gie-fed federation storms (ISSUE 12, docs/FEDERATION.md): the four
+# scorecard-pinned properties — regional spillover with CRITICAL
+# locality, whole-cluster drain bleed, partition -> local-only within
+# one staleness window, split-brain era convergence on heal.
+# ==========================================================================
+
+
+@pytest.fixture(scope="module")
+def fed_spill(tmp_path_factory):
+    """ONE storm-fed-spill replay (3 local pods + a 3-pod imported peer
+    cluster under a 4x regional flash crowd), shared by the spill
+    assertions below."""
+    from gie_tpu.storm.engine import run_scenario
+
+    faults.uninstall()
+    dump_dir = str(tmp_path_factory.mktemp("fedstorm"))
+    return run_scenario("storm-fed-spill", dump_dir=dump_dir)
+
+
+def test_fed_spill_crowd_spills_with_zero_5xx(fed_spill):
+    """The regional flash crowd exceeds local capacity and SPILLS onto
+    the imported peer endpoints — with not one client-visible 5xx,
+    reset, or timeout. One cluster stops being the capacity ceiling."""
+    card = fed_spill.scorecard
+    fed = card["federation"]
+    assert card["client_5xx"] == 0, card["client_5xx_detail"]
+    assert card["resets"] == 0 and card["timeouts"] == 0
+    assert fed["picks"].get("west", {}).get("total", 0) > 10, fed["picks"]
+    assert fed["serves"].get("west", 0) > 10
+    assert fed["picks"]["local"]["total"] > fed["picks"]["west"]["total"], (
+        "the peer is penalized spill capacity, not the default route")
+    SC.validate(card)
+
+
+def test_fed_spill_critical_never_crosses(fed_spill):
+    """Local capacity sufficed for CRITICAL throughout (local candidates
+    always existed), so no CRITICAL pick crossed the cluster boundary —
+    the band-locality half of the spill policy."""
+    fed = fed_spill.scorecard["federation"]
+    assert fed["critical_remote_picks"] == 0
+    assert fed["picks"]["local"]["bands"].get("critical", 0) > 0, (
+        "the storm never offered CRITICAL traffic — vacuous")
+
+
+def test_fed_spill_link_stayed_fresh(fed_spill):
+    fed = fed_spill.scorecard["federation"]
+    assert fed["link"]["installs"] > 5
+    assert fed["link"]["era_regressions"] == 0
+    # The peer never went local-only during a healthy-link storm.
+    assert all(v == 0 for _t, v in fed["local_only_trace"][3:]), (
+        fed["local_only_trace"])
+
+
+def test_fed_drain_bleeds_to_peer_with_zero_5xx(tmp_path):
+    """Whole-cluster drain: after the flag is raised, NEW picks bleed to
+    the peer cluster (every band — locality yields to the drain), local
+    in-flight completes, and the client never sees a 5xx."""
+    from gie_tpu.storm.engine import run_scenario
+
+    result = run_scenario("storm-fed-drain", dump_dir=str(tmp_path))
+    card = result.scorecard
+    fed = card["federation"]
+    assert card["client_5xx"] == 0, card["client_5xx_detail"]
+    assert card["resets"] == 0 and card["timeouts"] == 0
+    assert fed["draining"] is True
+    drain_t = [e["t"] for e in fed["events"]
+               if e["event"] == "cluster_drain"]
+    assert len(drain_t) == 1
+    # New picks after the drain settles are ALL remote (the settle
+    # window covers waves already dispatched at the flag flip).
+    late_local = [t for t, c in fed["pick_times"]
+                  if c == "local" and t > drain_t[0] + 0.5]
+    assert late_local == [], late_local
+    assert [t for t, c in fed["pick_times"]
+            if c == "west" and t > drain_t[0]], "nothing bled to the peer"
+    # Traffic before the drain stayed local (no saturation, no spill).
+    assert fed["picks"]["local"]["total"] > 0
+    SC.validate(card)
+
+
+def test_fed_partition_local_only_and_split_brain_convergence(tmp_path):
+    """Partition: the peer degrades to LOCAL-ONLY within one staleness
+    window (plus observe-tick slack) while local traffic serves with
+    zero 5xx; the heal arrives with an era flip and a zombie lineage
+    interleaved — the importer converges deterministically on the
+    greater era, rejects every zombie frame as an era regression, and
+    readmits the peer. One seeded retry guards real-time CPU-contention
+    flake (the storm-capacity pattern)."""
+    from gie_tpu.storm.engine import run_scenario
+
+    result = run_scenario("storm-fed-partition", dump_dir=str(tmp_path))
+    card = result.scorecard
+    fed = card["federation"]
+    part_t = [e["t"] for e in fed["events"] if e["event"] == "partition"]
+    first_lo = next(
+        (t for t, v in fed["local_only_trace"] if t >= part_t[0] and v),
+        None)
+    window = fed["local_only_after_s"]
+    if first_lo is None or first_lo - part_t[0] > window + 1.0:
+        result = run_scenario("storm-fed-partition", seed=656565,
+                              dump_dir=str(tmp_path))
+        card = result.scorecard
+        fed = card["federation"]
+        part_t = [e["t"] for e in fed["events"]
+                  if e["event"] == "partition"]
+        first_lo = next(
+            (t for t, v in fed["local_only_trace"]
+             if t >= part_t[0] and v), None)
+    # Zero client-visible errors: the partition cost cross-cluster
+    # capacity, never availability.
+    assert card["client_5xx"] == 0, card["client_5xx_detail"]
+    assert card["resets"] == 0 and card["timeouts"] == 0
+    # Fresh before the partition...
+    assert any(v == 0 for t, v in fed["local_only_trace"]
+               if t < part_t[0])
+    # ...local-only within one staleness window (+ observe-tick slack)...
+    assert first_lo is not None, fed["local_only_trace"]
+    assert first_lo - part_t[0] <= window + 1.0, (first_lo, part_t)
+    # ...and readmitted after the heal.
+    heal_t = [e["t"] for e in fed["events"] if e["event"] == "heal"][0]
+    assert fed["local_only_trace"][-1][1] == 0
+    # Split-brain convergence: the installed era ratcheted to the peer's
+    # NEW (greater) era, and the zombie's frames all rejected.
+    assert fed["link"]["installed_era"] == fed["peer_era"]
+    assert fed["link"]["era_flips"] >= 1
+    assert fed["link"]["era_regressions"] >= 1
+    assert heal_t > part_t[0]
+    SC.validate(card)
+
+
+def test_fed_scenarios_ship_and_compile_deterministically():
+    from gie_tpu.resilience import scenarios
+    from gie_tpu.storm.engine import FederationSpec
+
+    names = scenarios.list_scenarios()
+    assert {"storm-fed-spill", "storm-fed-drain",
+            "storm-fed-partition"} <= set(names)
+    scn = scenarios.load("storm-fed-partition")
+    prog = S.program_from_drive(scn.drive["storm"], seed=scn.seed)
+    a, b = prog.compile(), prog.compile()
+    assert a.fingerprint() == b.fingerprint()
+    kinds = {e.kind for e in a.events}
+    assert kinds == {"peer_partition", "peer_heal"}
+    # The drive's federation block maps onto FederationSpec exactly.
+    FederationSpec(**scn.drive["storm"]["federation"])
+
+
+def test_cluster_drain_and_partition_shapes():
+    drain = S.ClusterDrain(at_s=2.0)
+    assert [e.kind for e in drain.control_events(5.0)] == ["cluster_drain"]
+    assert drain.control_events(1.0) == []
+    part = S.PeerPartition(at_s=1.0, heal_s=3.0, flip_era=False)
+    evs = part.control_events(10.0)
+    assert [(e.kind, e.args) for e in evs] == [
+        ("peer_partition", ()), ("peer_heal", (0,))]
+    with pytest.raises(ValueError):
+        S.PeerPartition(at_s=3.0, heal_s=1.0)
