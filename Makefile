@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc bench-cpu cost release clean
+.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke storm-search-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc bench-cpu cost release clean
 
 all: native generate
 
@@ -61,15 +61,28 @@ chaos-smoke: chaos-ci
 # docs/FEDERATION.md: spillover, drain bleed, partition + split-brain
 # convergence, all zero client 5xx) driven through the REAL stack.
 # Arrival schedules are bit-identical per seed; a failure is a
-# degrade-and-recover regression, not flake. The slow multi-phase soak
-# lives in storm-smoke.
+# degrade-and-recover regression, not flake. gie-twin (ISSUE 14) rides
+# here too: the virtual-clock hour storm + same-seed decision
+# determinism, the real-vs-virtual equivalence scenario, the 2-hour
+# storm-longhorizon composition (<60 s wall), trace replay, and the
+# policy-search unit tier. The slow multi-phase soak lives in
+# storm-smoke; the 8-config search smoke in storm-search-smoke.
 storm-ci:
-	$(PY) -m pytest tests/test_storm.py -q -m 'not slow'
+	$(PY) -m pytest tests/test_storm.py tests/test_storm_search.py -q -m 'not slow'
 
 # The storm-soak replay (diurnal + flash crowd + LoRA churn + rolling
 # upgrade + autoscale + standby failover probes over mixed chaos).
 storm-smoke: storm-ci
 	$(PY) -m pytest tests/test_storm.py -q -m slow
+
+# gie-twin policy search smoke (docs/STORM.md "policy search"): the
+# bounded 8-config grid + successive-halving search over the
+# storm-search-smoke flash-crowd scenario, on the virtual clock —
+# asserts the leaderboard JSON validates and the hand-swept ladder
+# calibration (cached_kv_weight=8, wrr_alpha=1; docs/RESILIENCE.md)
+# re-derives into the top half.
+storm-search-smoke:
+	$(PY) -m pytest tests/test_storm_search.py -q
 
 # CRD manifests (reference `make generate`).
 generate:
@@ -83,7 +96,7 @@ generate:
 # main sweep — chaos-ci/storm-ci already ran them (the slow soaks live
 # in chaos-smoke/storm-smoke, not here).
 test: lint obs-check chaos-ci storm-ci
-	$(PY) -m pytest tests/ -q --ignore=tests/test_scenarios.py --ignore=tests/test_chaos.py --ignore=tests/test_storm.py
+	$(PY) -m pytest tests/ -q --ignore=tests/test_scenarios.py --ignore=tests/test_chaos.py --ignore=tests/test_storm.py --ignore=tests/test_storm_search.py
 
 test-unit: lint obs-check
 	$(PY) -m pytest tests/ -q --ignore=tests/test_conformance.py

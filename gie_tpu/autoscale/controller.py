@@ -9,9 +9,9 @@ background thread with a wall-clock interval.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
+from gie_tpu.runtime.clock import REALTIME
 from gie_tpu.autoscale.actuator import ReplicaActuator
 from gie_tpu.autoscale.recommender import AutoscaleRecommender, Recommendation
 from gie_tpu.autoscale.signals import SignalCollector
@@ -53,7 +53,7 @@ class AutoscaleController:
     def step(self, now: Optional[float] = None) -> Optional[Recommendation]:
         """One control cycle; returns the recommendation (None while the
         collector is still establishing its first rate window)."""
-        now = time.time() if now is None else now
+        now = REALTIME() if now is None else now
         signals = self.collector.sample(now)
         if signals is None:
             return None

@@ -22,9 +22,9 @@ the stale hold, which pins to the observed current state by definition.
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Optional
 
+from gie_tpu.runtime.clock import REALTIME
 from gie_tpu.autoscale.model import CapacityModel
 from gie_tpu.autoscale.signals import PoolSignals
 
@@ -102,7 +102,7 @@ class AutoscaleRecommender:
         """One control decision. `current` is the workload's current
         replica count (the actuator's observed spec, or ready_replicas in
         recommend-only mode)."""
-        now = time.time() if now is None else now
+        now = REALTIME() if now is None else now
         cfg = self.cfg
         if signals is None or signals.stale:
             # NEVER scale on stale data — not even to clamp into bounds:

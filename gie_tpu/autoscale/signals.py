@@ -18,11 +18,11 @@ all — and the recommender holds on stale samples.
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from gie_tpu.runtime.clock import REALTIME
 from gie_tpu.sched import constants as C
 
 # Counter/gauge sample names read from the runtime registry (the names
@@ -131,7 +131,7 @@ class SignalCollector:
         self._prev_at = 0.0
 
     def sample(self, now: Optional[float] = None) -> Optional[PoolSignals]:
-        now = time.time() if now is None else now
+        now = REALTIME() if now is None else now
         totals = _counter_totals(self.registry)
         prev, prev_at = self._prev, self._prev_at
         if prev is not None and now - prev_at <= 0:

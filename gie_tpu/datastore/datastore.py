@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import heapq
 import threading
-import time
 from typing import Callable, Iterable, Optional
 
 from gie_tpu.datastore.objects import Endpoint, EndpointPool, Pod
@@ -72,7 +71,17 @@ class Datastore:
         on_slot_reclaimed: Optional[SlotReclaimedFn] = None,
         max_slots: int = C.M_MAX,
         drain_deadline_s: float = 30.0,
+        clock=None,
     ):
+        # Clock seam (runtime/clock.py): drain deadlines are behavior —
+        # a virtual-time storm's rolling upgrade must reap on the
+        # simulated timeline. A callable returning seconds; defaults to
+        # the monotonic clock.
+        if clock is None:
+            from gie_tpu.runtime.clock import MONOTONIC
+
+            clock = MONOTONIC.now
+        self._clock = clock
         self._lock = threading.RLock()
         self._pool: Optional[EndpointPool] = None
         self._endpoints: dict[str, Endpoint] = {}  # key: "<ns>/<pod>-rank-<i>"
@@ -399,7 +408,7 @@ class Datastore:
         deadline is set once, at first mark). Returns False when the pod
         has no serving endpoints — nothing to drain, the caller should
         plain-delete."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         marked = False
         with self._lock:
             prefix = f"{namespace}/{pod_name}-rank-"
@@ -421,7 +430,7 @@ class Datastore:
         drains — callers may invoke it at wave cadence."""
         if not self._draining:  # GIL-atomic read on the common path
             return 0
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         with self._lock:
             expired = [k for k, until in self._draining.items()
                        if now >= until]
@@ -441,7 +450,7 @@ class Datastore:
         endpoint table with drain deadlines — the exact inputs the pick
         path's cached snapshots were built from. Lock held only for the
         dict build; no callbacks, no I/O."""
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             return {
                 "pool_synced": self._pool is not None,

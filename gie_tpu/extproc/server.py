@@ -501,9 +501,19 @@ class StreamingServer:
     def __init__(self, datastore, picker: EndpointPicker, on_served=None,
                  bbr_chain=None, transcode_h2c: bool = True,
                  on_response_complete=None, fast_lane: bool = True,
-                 needed_headers=None, on_stream_aborted=None):
+                 needed_headers=None, on_stream_aborted=None,
+                 clock=None):
         self.datastore = datastore
         self.picker = picker
+        # Clock seam (runtime/clock.py): deadline resolution/expiry and
+        # the picked_at/serve-latency stamps the resilience layer
+        # consumes are BEHAVIOR, so a virtual-time storm must serve them
+        # from its own clock. Defaults to the picker's clock (the two
+        # compare timestamps against each other), else real time.
+        from gie_tpu.runtime.clock import MONOTONIC
+
+        self._clock = (clock if clock is not None
+                       else getattr(picker, "_clock", MONOTONIC))
         # Admission fast lane (docs/EXTPROC.md): zero-parse field scan
         # instead of json.loads when the BBR chain can run from the scan,
         # needed-keys header copy, and pooled response templates. Off =
@@ -731,7 +741,7 @@ class StreamingServer:
             elif which == "response_headers":
                 stream.send(self._handle_response_headers(ctx, req))
             elif which == "response_body":
-                now = time.monotonic()
+                now = self._clock.now()
                 if req.response_body.body:
                     if ctx.resp_first_at == 0.0:
                         ctx.resp_first_at = now
@@ -829,7 +839,8 @@ class StreamingServer:
         # case costs two dict lookups.
         if (deadline_mod.GATEWAY_DEADLINE_HEADER in ctx.headers
                 or deadline_mod.ENVOY_TIMEOUT_HEADER in ctx.headers):
-            ctx.deadline_at = deadline_mod.deadline_from_headers(ctx.headers)
+            ctx.deadline_at = deadline_mod.deadline_from_headers(
+                ctx.headers, now=self._clock.now())
 
         # Subset hint from filter metadata: string ("ip1,ip2") or array forms
         # (reference request.go:51-77 — both Envoy pathways supported).
@@ -932,7 +943,8 @@ class StreamingServer:
                which previously re-parsed the same bytes
                (bbr/chain.py:78 + codec.py:108).
         """
-        if ctx.deadline_at and deadline_mod.expired(ctx.deadline_at):
+        if ctx.deadline_at and deadline_mod.expired(
+                ctx.deadline_at, now=self._clock.now()):
             # Budget already exhausted at admission (it queued behind
             # flow control / a slow hop upstream): shed with 503 before
             # the scheduler charges a TPU cycle for an answer nobody is
@@ -986,14 +998,29 @@ class StreamingServer:
         # transcode-forced full parse under the flag still reports fast.)
         ctx.lane = "fast" if self.fast_lane else "legacy"
         # Model precedence: an explicit rewrite (from BBR's rewrite plugin,
-        # else the upstream rewrite header) beats the raw extracted body
-        # model (proposal 1816 rewrite > 1964 extraction).
+        # else the upstream rewrite header) beats the chain-extracted
+        # model header, which beats the raw BODY model (proposal 1816
+        # rewrite > 1964 extraction). The body fallback matters when no
+        # BBR chain runs (demo/storm deployments): without it the pick
+        # request carried model="" — LoRA-affinity scheduling went blind
+        # to adapter identity and the flight-recorder records (the
+        # TraceReplay/trainer substrate) recorded no model at all. Both
+        # lanes read the same value: the zero-parse scan when it is
+        # valid, else the shared parse (scan/parse model equality is
+        # pinned by tests/test_fieldscan.py).
         rewrite = ctx.headers.get(metadata.MODEL_NAME_REWRITE_KEY)
+        body_model = ""
+        if scan is not None and scan.valid and isinstance(scan.model, str):
+            body_model = scan.model
+        elif parsed:
+            pm = parsed.get("model")
+            if isinstance(pm, str):
+                body_model = pm
         model = (
             bbr_headers.get(metadata.MODEL_NAME_REWRITE_KEY)
             or (rewrite[0] if rewrite else "")
             or bbr_headers.get(metadata.MODEL_NAME_HEADER)
-            or ""
+            or body_model
         )
         result = self.picker.pick(
             PickRequest(
@@ -1041,7 +1068,7 @@ class StreamingServer:
                 }
         ctx.target_endpoint = result.destination_value
         ctx.selected_pod_ip = result.endpoint.rsplit(":", 1)[0]
-        ctx.picked_at = time.monotonic()
+        ctx.picked_at = self._clock.now()
         ctx.pick_result = result
         return result
 
@@ -1067,7 +1094,8 @@ class StreamingServer:
             # Surface the remaining budget so downstream hops (the model
             # server, a nested gateway) can inherit it.
             rem_ms = max(
-                deadline_mod.remaining_s(ctx.deadline_at), 0.0) * 1000.0
+                deadline_mod.remaining_s(
+                    ctx.deadline_at, now=self._clock.now()), 0.0) * 1000.0
             set_headers[deadline_mod.REMAINING_HEADER] = str(int(rem_ms))
         if self.fast_lane:
             return self._headers_templates.build(

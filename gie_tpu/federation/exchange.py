@@ -30,7 +30,6 @@ from __future__ import annotations
 import json
 import random
 import threading
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -45,6 +44,7 @@ from gie_tpu.replication.publisher import (
 )
 from gie_tpu.resilience import faults
 from gie_tpu.resilience.policy import Backoff, BackoffPolicy
+from gie_tpu.runtime.clock import MONOTONIC, Clock
 from gie_tpu.runtime.logging import get_logger
 
 DIGEST_PATH = "/federation/digest"
@@ -79,11 +79,16 @@ class FederationPublisher:
     a GREATER era, carried in both the HTTP era header and fed.meta)."""
 
     def __init__(self, exporters: dict, *, era_seq: int = 1,
-                 era_token: Optional[int] = None):
+                 era_token: Optional[int] = None,
+                 clock: Clock = MONOTONIC):
         token = (int(era_token) if era_token is not None
                  else random.getrandbits(63))
         self.era = (int(era_seq), token)
         self._pub = StatePublisher(dict(exporters), era=era_str(self.era))
+        # Clock seam (runtime/clock.py): the long-poll park window is
+        # clock-governed — a virtual-time storm parks and wakes it on
+        # the simulated timeline.
+        self._clock = clock
         # Long-poll park/wake. Declared rank 52 (lockorder.toml): held
         # only around epoch compares + waits, never across the
         # publisher's own lock (rank 55) or any I/O.
@@ -96,7 +101,7 @@ class FederationPublisher:
     def refresh(self) -> int:
         epoch = self._pub.refresh()
         with self._cv:
-            self._cv.notify_all()
+            self._clock.notify_all(self._cv)
         return epoch
 
     def bump_era(self, seq: Optional[int] = None) -> tuple:
@@ -110,7 +115,7 @@ class FederationPublisher:
         self.era = (new_seq, random.getrandbits(63))
         self._pub.era = era_str(self.era)
         with self._cv:
-            self._cv.notify_all()
+            self._clock.notify_all(self._cv)
         return self.era
 
     def serve(self, *, since: Optional[int] = None,
@@ -134,18 +139,18 @@ class FederationPublisher:
         status, headers, body = self._pub.serve(
             since=since, era=era, if_none_match=if_none_match)
         if status == 304 and wait_s > 0.0:
-            deadline = time.monotonic() + min(wait_s, 60.0)
+            deadline = self._clock.now() + min(wait_s, 60.0)
             etag = if_none_match
             with self._cv:
                 while True:
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - self._clock.now()
                     if remaining <= 0:
                         break
                     # Cheap staleness probe: the ETag is era:epoch, so a
                     # refresh OR an era bump changes it.
                     if self._pub._etag() != etag:
                         break
-                    self._cv.wait(remaining)
+                    self._clock.wait(self._cv, remaining)
             status, headers, body = self._pub.serve(
                 since=since, era=era, if_none_match=if_none_match)
         if (verdict is not None and verdict.kind == faults.CORRUPT
@@ -269,10 +274,15 @@ class PeerLink:
         fetch: Optional[Callable] = None,
         seed: Optional[int] = None,
         stop_check: Optional[Callable[[], bool]] = None,
+        clock: Clock = MONOTONIC,
     ):
         self.name = name
         self.url = url.rstrip("/")
         self.install = install
+        # Clock seam: pacing, backoff, breaker dwell, and the staleness
+        # clock the state layer's penalty inflation reads all live on
+        # this clock (virtual in a time-compressed storm).
+        self._clock = clock
         # Shutdown seam: a long-poll fetch can park for wait_s past the
         # owner's stop() (urllib cannot be interrupted); checking this
         # before install keeps a late-returning poll from mutating
@@ -320,11 +330,11 @@ class PeerLink:
         penalty inflation and local-only verdicts key off this."""
         if self.last_contact_at == 0.0:
             return float("inf")
-        now = time.monotonic() if now is None else now
+        now = self._clock.now() if now is None else now
         return max(now - self.last_contact_at, 0.0)
 
     def breaker_open(self, now: Optional[float] = None) -> bool:
-        now = time.monotonic() if now is None else now
+        now = self._clock.now() if now is None else now
         return now < self._open_until
 
     def report(self) -> dict:
@@ -391,7 +401,7 @@ class PeerLink:
         """One breaker/backoff-gated exchange attempt; returns the
         outcome label, or None when the pacing window has not elapsed.
         Blocks up to wait_s + margin inside the long-poll fetch."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.now() if now is None else now
         if now < self._next_poll:
             return None
         if self.breaker_open(now):
@@ -423,8 +433,8 @@ class PeerLink:
                                peer=self.name, err=str(e))
             # A failed half-open probe re-opens too: _fail's streak is
             # already >= open_after there, so one path covers both.
-            return self._fail(time.monotonic(), FETCH_ERROR)
-        now = time.monotonic()  # the long poll may have parked for seconds
+            return self._fail(self._clock.now(), FETCH_ERROR)
+        now = self._clock.now()  # the long poll may have parked for seconds
         if status == 304:
             self.last_contact_at = now
             epoch = headers.get(EPOCH_HEADER) or _header(
@@ -547,6 +557,7 @@ class FederationExchange:
         link_open_after: int = 3,
         link_open_s: float = 5.0,
         seed: Optional[int] = None,
+        clock: Clock = MONOTONIC,
     ):
         self.state = state
         self.cluster = cluster
@@ -554,6 +565,7 @@ class FederationExchange:
         self.max_endpoints = max_endpoints
         self.max_prefix_keys = max_prefix_keys
         self.prefix_keys_fn = prefix_keys_fn
+        self._clock = clock
         self.log = get_logger("federation")
         exporters = {
             summary.META_SECTION: self._export_meta,
@@ -562,7 +574,7 @@ class FederationExchange:
         if prefix_keys_fn is not None:
             exporters[summary.PREFIX_SECTION] = self._export_prefix
         self.publisher = FederationPublisher(
-            exporters, era_seq=era_seq, era_token=era_token)
+            exporters, era_seq=era_seq, era_token=era_token, clock=clock)
         self.server = (FederationHTTPServer(self.publisher, port, bind=bind)
                        if serve else None)
         self._stop = threading.Event()  # before the links: they hold is_set
@@ -574,7 +586,8 @@ class FederationExchange:
                 open_after=link_open_after, open_s=link_open_s,
                 fetch=fetch,
                 seed=None if seed is None else seed + i,
-                stop_check=self._stop.is_set)
+                stop_check=self._stop.is_set,
+                clock=clock)
             self.state.register_peer(name, self.links[name])
         self._threads: list[threading.Thread] = []
 
@@ -611,30 +624,40 @@ class FederationExchange:
                 for name, link in self.links.items()}
 
     def _refresh_loop(self) -> None:
-        while not self._stop.wait(max(self.interval_s, 0.05)):
-            try:
-                self.refresh()
-                # Gauge refresh at publish cadence (not wave cadence):
-                # the staleness/local-only/penalty series must move even
-                # while the cluster is idle — a partition during a lull
-                # is exactly when an operator reads them.
-                self.state.export_metrics()
-            except Exception as e:  # the exchange must never die
-                self.log.error("federation refresh failed", err=e)
+        tok = self._clock.actor_begin("federation-refresh")
+        try:
+            while not self._clock.wait_event(
+                    self._stop, max(self.interval_s, 0.05)):
+                try:
+                    self.refresh()
+                    # Gauge refresh at publish cadence (not wave
+                    # cadence): the staleness/local-only/penalty series
+                    # must move even while the cluster is idle — a
+                    # partition during a lull is exactly when an
+                    # operator reads them.
+                    self.state.export_metrics()
+                except Exception as e:  # the exchange must never die
+                    self.log.error("federation refresh failed", err=e)
+        finally:
+            self._clock.actor_end(tok)
 
     def _link_loop(self, link: PeerLink) -> None:
         from gie_tpu.runtime import metrics as own_metrics
 
-        while not self._stop.wait(0.05):
-            try:
-                outcome = link.poll_once()
-            except Exception as e:
-                self.log.error("peer link loop failed",
-                               peer=link.name, err=e)
-                continue
-            if outcome is not None:
-                own_metrics.FED_SYNCS.labels(
-                    peer=link.name, outcome=outcome).inc()
+        tok = self._clock.actor_begin(f"federation-{link.name}")
+        try:
+            while not self._clock.wait_event(self._stop, 0.05):
+                try:
+                    outcome = link.poll_once()
+                except Exception as e:
+                    self.log.error("peer link loop failed",
+                                   peer=link.name, err=e)
+                    continue
+                if outcome is not None:
+                    own_metrics.FED_SYNCS.labels(
+                        peer=link.name, outcome=outcome).inc()
+        finally:
+            self._clock.actor_end(tok)
 
     def start(self) -> None:
         self._stop.clear()
@@ -650,7 +673,7 @@ class FederationExchange:
             self._threads.append(lt)
 
     def stop(self) -> None:
-        self._stop.set()
+        self._clock.set_event(self._stop)
         for t in self._threads:
             t.join(timeout=5)
         if self.server is not None:

@@ -35,12 +35,12 @@ to the scheduler:
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
 import numpy as np
 
 from gie_tpu.federation import summary
+from gie_tpu.runtime.clock import MONOTONIC
 from gie_tpu.runtime.logging import get_logger
 from gie_tpu.sched import constants as C
 
@@ -78,7 +78,7 @@ class FederationState:
         local_only_after_s: float = 10.0,
         spill_queue_limit: float = 8.0,
         max_prefix_fold: int = 2048,
-        clock=time.monotonic,
+        clock=MONOTONIC.now,
     ):
         self.datastore = datastore
         self.metrics_store = metrics_store

@@ -6,7 +6,8 @@ import os
 from typing import Optional
 
 from gie_tpu.lint import (
-    asynclint, baseline, daemonloop, locks, tomlmini, tracesafe)
+    asynclint, baseline, clockcalls, daemonloop, locks, tomlmini,
+    tracesafe)
 from gie_tpu.lint.model import RepoIndex, Violation
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -47,6 +48,7 @@ def run_paths(
     violations += tracesafe.run(index, cfg)
     violations += asynclint.run(index, cfg)
     violations += daemonloop.run(index, cfg)
+    violations += clockcalls.run(index, cfg)
     if rules is not None:
         violations = [
             v for v in violations

@@ -49,7 +49,6 @@ import http.client
 import itertools
 import random
 import threading
-import time
 import urllib.parse
 from typing import Optional
 
@@ -57,6 +56,7 @@ from gie_tpu.metricsio.mappings import ServerMapping
 from gie_tpu.metricsio.store import MetricsStore
 from gie_tpu.resilience import faults
 from gie_tpu.resilience.policy import JITTER_SYMMETRIC, Backoff, BackoffPolicy
+from gie_tpu.runtime.clock import MONOTONIC, Clock
 from gie_tpu.utils.lora import LoraRegistry
 
 
@@ -77,7 +77,7 @@ class _Endpoint:
     )
 
     def __init__(self, slot: int, url: str, mapping: ServerMapping,
-                 backoff: Backoff):
+                 backoff: Backoff, attached_at: float):
         self.slot = slot
         self.url = url
         self.mapping = mapping
@@ -87,13 +87,13 @@ class _Endpoint:
         self.path = (parts.path or "/") + (
             f"?{parts.query}" if parts.query else "")
         self.conn: Optional[http.client.HTTPConnection] = None
-        self.due = 0.0             # monotonic deadline for the next scrape
+        self.due = 0.0             # clock deadline for the next scrape
         # Shared resilience policy (gie_tpu/resilience/policy.py): the
         # per-endpoint failure-streak state machine that used to be a bare
         # counter plus inline 2**min(streak, 20) arithmetic here.
         self.backoff = backoff
-        self.last_success = 0.0    # monotonic; 0 = never scraped
-        self.attached_at = time.monotonic()
+        self.last_success = 0.0    # clock time; 0 = never scraped
+        self.attached_at = attached_at
         self.dead = False          # set under the engine lock on detach
 
     @property
@@ -136,9 +136,20 @@ class ScrapeEngine:
         timeout_s: Optional[float] = None,
         jitter: float = 0.1,
         breaker_board=None,
+        clock: Clock = MONOTONIC,
+        rng=None,
     ):
         if interval_s <= 0:
             raise ValueError("interval_s must be > 0")
+        # Clock seam (gie_tpu/runtime/clock.py): shard deadline heaps,
+        # backoff pacing, and the staleness clocks all read this — a
+        # virtual-time storm drives the whole scrape plane off the
+        # simulated timeline. ``rng`` (default: the module-level random
+        # the engine always used) seeds the attach phase-stagger AND the
+        # per-endpoint backoff jitter, so a seeded engine schedules
+        # deterministically.
+        self._clock = clock
+        self._rng = rng if rng is not None else random
         self.store = store
         self.lora = lora or LoraRegistry()
         self.interval_s = interval_s
@@ -208,16 +219,18 @@ class ScrapeEngine:
                 # old state is dropped by its shard; the row survives
                 # (same pod identity, new address).
                 prev.dead = True
+            now = self._clock.now()
             ep = _Endpoint(slot, url, mapping,
-                           Backoff(self._backoff_policy))
+                           Backoff(self._backoff_policy, rng=self._rng),
+                           attached_at=now)
             # Phase-stagger the first scrape so a pool attached in one
             # reconcile sweep spreads over the interval instead of
             # thundering every tick in lockstep.
-            ep.due = time.monotonic() + random.uniform(0, self.interval_s)
+            ep.due = now + self._rng.uniform(0, self.interval_s)
             self._live[slot] = ep
         shard = self._shard_for(slot)
         shard.inbox.append(ep)
-        shard.wake.set()
+        self._clock.set_event(shard.wake)
 
     def detach(self, slot: int) -> None:
         """Stop scraping ``slot`` and clear its row. Returns immediately:
@@ -236,7 +249,7 @@ class ScrapeEngine:
             # slot starts CLOSED.
             self.breaker_board.drop(slot)
         if ep is not None:
-            self._shard_for(slot).wake.set()
+            self._clock.set_event(self._shard_for(slot).wake)
 
     def close(self) -> None:
         with self._lock:
@@ -248,7 +261,7 @@ class ScrapeEngine:
             for slot in slots:
                 self.store.remove(slot)
         for s in self._shards:
-            s.wake.set()
+            self._clock.set_event(s.wake)
         for s in self._shards:
             # Bounded: a shard hung inside a fetch is a daemon thread and
             # holds no locks anyone waits on — close must not inherit the
@@ -264,7 +277,7 @@ class ScrapeEngine:
         the store's row ages: it covers the ingestion-side outage modes
         the row ages cannot (every endpoint unreachable and backing off,
         or a wedged shard), straight from the engine's own clocks."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.now() if now is None else now
         with self._lock:
             if not self._live:
                 return 0.0
@@ -334,7 +347,7 @@ class ScrapeEngine:
         from gie_tpu.metricsio.scrape import parse_scrape
         from gie_tpu.runtime import metrics as own_metrics
 
-        t0 = time.monotonic()
+        t0 = self._clock.now()
         try:
             payload = self._fetch(ep)
             metrics, active, waiting = parse_scrape(
@@ -346,11 +359,11 @@ class ScrapeEngine:
             # taxing the shard budget its live peers need. The delay
             # shape (exponent capped at 20, symmetric jitter, max_s
             # ceiling) lives in the shared policy module now.
-            ep.due = time.monotonic() + ep.backoff.fail()
+            ep.due = self._clock.now() + ep.backoff.fail()
             if self.breaker_board is not None:
                 self.breaker_board.record(ep.slot, False)
             return None
-        done = time.monotonic()
+        done = self._clock.now()
         own_metrics.SCRAPE_FETCH.observe(done - t0)
         own_metrics.SCRAPE_STALENESS.observe(
             done - (ep.last_success or ep.attached_at))
@@ -407,6 +420,17 @@ class _Shard:
 
     def _run(self) -> None:
         eng = self.engine
+        # Virtual-time actor registration (runtime/clock.py): the shard
+        # is one of the simulation's parked/active participants; on the
+        # real clock this is a no-op.
+        tok = eng._clock.actor_begin(self.thread.name)
+        try:
+            self._run_inner()
+        finally:
+            eng._clock.actor_end(tok)
+
+    def _run_inner(self) -> None:
+        eng = self.engine
         heap: list[tuple[float, int, _Endpoint]] = []
         seq = itertools.count()  # heap tiebreak: _Endpoint is unordered
         pending: list = []
@@ -419,7 +443,7 @@ class _Shard:
                 return
             if not heap:
                 eng._flush(pending)
-                self.wake.wait(0.2)
+                eng._clock.wait_event(self.wake, 0.2)
                 self.wake.clear()
                 continue
             due, _, ep = heap[0]
@@ -427,7 +451,7 @@ class _Shard:
                 heapq.heappop(heap)
                 ep.close_conn()
                 continue
-            now = time.monotonic()
+            now = eng._clock.now()
             if due > now + eng._early_s:
                 # Idle until the earliest deadline: the sweep is complete,
                 # so write it out, then sleep interruptibly (attach of an
@@ -435,7 +459,7 @@ class _Shard:
                 # Deadlines inside the early window are taken immediately
                 # instead — see ScrapeEngine._early_s.
                 eng._flush(pending)
-                self.wake.wait(min(due - now, 0.2))
+                eng._clock.wait_event(self.wake, min(due - now, 0.2))
                 self.wake.clear()
                 continue
             heapq.heappop(heap)

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import re
 import threading
-import time
 import urllib.request
 from typing import Callable, Optional, Union
 
@@ -22,6 +21,7 @@ from prometheus_client.parser import text_string_to_metric_families
 
 from gie_tpu.metricsio.mappings import LabeledGauge, ServerMapping
 from gie_tpu.metricsio.store import MetricsStore
+from gie_tpu.runtime.clock import MONOTONIC
 from gie_tpu.sched.constants import Metric
 from gie_tpu.utils.lora import LoraRegistry
 
@@ -322,7 +322,7 @@ class ThreadPerEndpointScraper:
         self, slot: int, url: str, mapping: ServerMapping, stop: threading.Event
     ) -> None:
         while not stop.is_set():
-            started = time.monotonic()
+            started = MONOTONIC.now()
             try:
                 text = self.fetcher(url)
                 metrics, active, waiting = parse_scrape(text, mapping, self.lora)
@@ -335,5 +335,5 @@ class ThreadPerEndpointScraper:
                 # up via METRICS_AGE_S and the endpoint stays routable
                 # (reference keeps stale metrics rather than evicting).
                 pass
-            elapsed = time.monotonic() - started
+            elapsed = MONOTONIC.now() - started
             stop.wait(max(self.interval_s - elapsed, 0.001))

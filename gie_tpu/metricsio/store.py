@@ -10,14 +10,14 @@ into an EndpointBatch for the scheduler in O(1) copies.
 from __future__ import annotations
 
 import threading
-import time
-from typing import Iterable, Optional, Sequence
+from typing import Callable, Iterable, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
 
 from gie_tpu.api.types import ROLE_LABEL
 from gie_tpu.datastore.objects import Endpoint
+from gie_tpu.runtime.clock import REALTIME
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.types import EndpointBatch
 
@@ -30,7 +30,13 @@ _ROLE_BY_LABEL = {
 
 
 class MetricsStore:
-    def __init__(self) -> None:
+    def __init__(self, clock: Callable[[], float] = REALTIME) -> None:
+        # Clock seam (gie_tpu/runtime/clock.py): row freshness stamps.
+        # Default is wall time (the store's historical convention); a
+        # virtual-time storm passes its own clock so row ages and the
+        # staleness verdicts derived from them live on the simulated
+        # timeline.
+        self._clock = clock
         self._lock = threading.Lock()
         self._metrics = np.zeros((C.M_MAX, C.NUM_METRICS), np.float32)
         self._lora_active = np.full((C.M_MAX, C.LORA_SLOTS), -1, np.int32)
@@ -52,7 +58,7 @@ class MetricsStore:
         now: Optional[float] = None,
     ) -> None:
         """Record one endpoint's scrape result (metric-column -> value)."""
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         with self._lock:
             self._apply_locked(slot, metrics, lora_active, lora_waiting, now)
 
@@ -68,7 +74,7 @@ class MetricsStore:
         50 ms tick the per-row lock traffic of the thread-per-endpoint
         path measurably contended the scheduler's snapshot reads; the
         batched form costs the readers one acquisition per sweep."""
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         with self._lock:
             for slot, metrics, lora_active, lora_waiting in rows:
                 self._apply_locked(slot, metrics, lora_active, lora_waiting,
@@ -110,7 +116,7 @@ class MetricsStore:
         ROUTING (cold-start admission), but a capacity decision must not
         read 'no data yet' as 'idle'."""
         idx = list(slots)
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         with self._lock:
             rows = self._metrics[idx].copy()
             ages = np.where(
@@ -185,7 +191,7 @@ class MetricsStore:
         the batching layer sizes it to the live high-water slot so the
         compiled cycle scores only the lanes that can exist); every
         endpoint's slot must be < m_slots."""
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         with self._lock:
             metrics = self._metrics[:m_slots].copy()
             active = self._lora_active[:m_slots].copy()

@@ -39,8 +39,9 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from typing import Callable, Optional
+
+from gie_tpu.runtime.clock import MONOTONIC
 
 
 class BreakerState:
@@ -141,7 +142,7 @@ class CircuitBreaker:
                  "opened_at", "opened_by", "transitions", "serve_window")
 
     def __init__(self, cfg: BreakerConfig,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = MONOTONIC.now):
         self.cfg = cfg
         self.clock = clock
         self.state = BreakerState.CLOSED
@@ -259,17 +260,28 @@ class BreakerBoard:
     attribute check while the whole pool is healthy."""
 
     def __init__(self, cfg: Optional[BreakerConfig] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = MONOTONIC.now):
         self.cfg = cfg if cfg is not None else BreakerConfig()
         self.clock = clock
         self._lock = threading.Lock()
         self._breakers: dict[int, CircuitBreaker] = {}
         self.has_open = False
+        # Ordered state-transition log: (key, state, owning_plane) per
+        # observed transition, times deliberately omitted — the real-vs-
+        # virtual equivalence contract (docs/STORM.md) compares EVENT
+        # ORDER across clock modes, and wall timestamps would never
+        # match. Bounded; storms record hundreds, not millions.
+        self.events: list[tuple[int, str, str]] = []
+        self._events_cap = 4096
 
     def _refresh_has_open(self) -> None:
         self.has_open = any(
             b.state != BreakerState.CLOSED
             for b in self._breakers.values())
+
+    def _log_event_locked(self, key: int, b: CircuitBreaker) -> None:
+        if len(self.events) < self._events_cap:
+            self.events.append((key, b.state, b.opened_by))
 
     def _record_with(self, key: int, ok: bool, apply) -> bool:
         """Shared get-or-create + transition bookkeeping for both outcome
@@ -286,6 +298,7 @@ class BreakerBoard:
             changed = b.state != before
             if changed:
                 self._refresh_has_open()
+                self._log_event_locked(key, b)
             return changed
 
     def record(self, key: int, ok: bool) -> None:
@@ -322,6 +335,7 @@ class BreakerBoard:
             b.ok_streak = 0
             b._to(BreakerState.OPEN, plane)
             self._refresh_has_open()
+            self._log_event_locked(key, b)
             return True
 
     def allow(self, key: int) -> bool:
@@ -335,6 +349,7 @@ class BreakerBoard:
             verdict = b.allow()
             if b.state != before:
                 self._refresh_has_open()
+                self._log_event_locked(key, b)
             return verdict
 
     def quarantined(self, key: int) -> bool:
@@ -362,7 +377,11 @@ class BreakerBoard:
             if b is None or b.state == BreakerState.CLOSED:
                 return False
             if b.opened_by == SERVE:
-                return not b.allow()
+                before = b.state
+                verdict = not b.allow()
+                if b.state != before:
+                    self._log_event_locked(key, b)
+                return verdict
             return True
 
     def state(self, key: int) -> str:

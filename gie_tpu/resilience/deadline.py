@@ -17,8 +17,9 @@ the fast-lane histogram guards that.
 
 from __future__ import annotations
 
-import time
 from typing import Optional
+
+from gie_tpu.runtime.clock import MONOTONIC
 
 # Caller-pinned deadline (takes precedence) and Envoy's route timeout.
 GATEWAY_DEADLINE_HEADER = "x-gateway-request-deadline-ms"
@@ -70,18 +71,18 @@ def deadline_from_headers(
         budget = _budget_from(headers.get(ENVOY_TIMEOUT_HEADER))
     if budget is None or budget < _MIN_BUDGET_S:
         return 0.0
-    return (time.monotonic() if now is None else now) + budget
+    return (MONOTONIC.now() if now is None else now) + budget
 
 
 def remaining_s(deadline_at: float, now: Optional[float] = None) -> float:
     """Seconds of budget left; +inf when no deadline is set."""
     if deadline_at <= 0.0:
         return float("inf")
-    now = time.monotonic() if now is None else now
+    now = MONOTONIC.now() if now is None else now
     return deadline_at - now
 
 
 def expired(deadline_at: float, now: Optional[float] = None) -> bool:
     if deadline_at <= 0.0:
         return False
-    return (time.monotonic() if now is None else now) >= deadline_at
+    return (MONOTONIC.now() if now is None else now) >= deadline_at

@@ -37,8 +37,9 @@ from __future__ import annotations
 import dataclasses
 import random
 import threading
-import time
 from typing import Optional
+
+from gie_tpu.runtime import clock as clock_mod
 
 # Fault-point catalog: name -> where it is woven. The injector refuses
 # unknown names, and the coverage meta-test requires each entry to be
@@ -225,6 +226,19 @@ def installed() -> Optional[FaultInjector]:
     return _active
 
 
+# Clock seam for the latency/hang sleeps (gie_tpu/runtime/clock.py):
+# chaos delays are CLOCK-GOVERNED behavior, so a virtual-time storm
+# (docs/STORM.md) must serve them from the virtual clock — the sleep is
+# the injected fault. set_clock installs the engine's clock; uninstall
+# of the engine restores the monotonic default.
+_clock: clock_mod.Clock = clock_mod.MONOTONIC
+
+
+def set_clock(clock: Optional[clock_mod.Clock]) -> None:
+    global _clock
+    _clock = clock if clock is not None else clock_mod.MONOTONIC
+
+
 def fire(point: str, key: str = "") -> Verdict:
     """Draw a verdict, serving latency/hang sleeps here; ERROR and
     CORRUPT come back to the call site (sites that cannot corrupt treat
@@ -234,7 +248,7 @@ def fire(point: str, key: str = "") -> Verdict:
         return _OK
     v = inj.verdict(point, key)
     if v.kind in (LATENCY, HANG):
-        time.sleep(v.sleep_s)
+        _clock.sleep(v.sleep_s)
     return v
 
 

@@ -29,10 +29,10 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
-import time
 from typing import Callable, Optional
 
 from gie_tpu.resilience.breaker import BreakerBoard, WindowedRate
+from gie_tpu.runtime.clock import MONOTONIC
 
 
 class Rung(enum.IntEnum):
@@ -109,7 +109,7 @@ class DegradationLadder:
     def __init__(
         self,
         cfg: Optional[LadderConfig] = None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = MONOTONIC.now,
         on_change: Optional[Callable[[int], None]] = None,
     ):
         self.cfg = cfg if cfg is not None else LadderConfig()

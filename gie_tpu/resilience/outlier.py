@@ -48,12 +48,12 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from typing import Callable, Optional
 
 import numpy as np
 
 from gie_tpu.resilience.breaker import BreakerBoard, BreakerState
+from gie_tpu.runtime.clock import MONOTONIC
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,7 +139,7 @@ class OutlierEjector:
     leaf lock); ``evaluate`` from the wave-cadence resilience tick."""
 
     def __init__(self, cfg: Optional[OutlierConfig] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = MONOTONIC.now):
         self.cfg = cfg if cfg is not None else OutlierConfig()
         self.clock = clock
         self._lock = threading.Lock()

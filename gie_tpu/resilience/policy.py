@@ -14,8 +14,9 @@ from __future__ import annotations
 
 import dataclasses
 import random
-import time
 from typing import Callable, Optional
+
+from gie_tpu.runtime.clock import MONOTONIC
 
 JITTER_UP = "up"               # delay * (1 + jitter * rng.random())
 JITTER_SYMMETRIC = "symmetric"  # delay * (1 + uniform(-jitter, +jitter))
@@ -99,7 +100,7 @@ def retry_call(
     *,
     attempts: int = 3,
     retry_on: tuple = (Exception,),
-    sleep: Callable[[float], None] = time.sleep,
+    sleep: Callable[[float], None] = MONOTONIC.sleep,
     seed: Optional[int] = None,
 ):
     """Call ``fn`` up to ``attempts`` times with policy-shaped sleeps

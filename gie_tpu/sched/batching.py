@@ -41,6 +41,7 @@ from gie_tpu.extproc.server import (
 from gie_tpu.extproc import metadata as mdkeys
 from gie_tpu.resilience import deadline as deadline_mod
 from gie_tpu.resilience import faults
+from gie_tpu.runtime.clock import MONOTONIC, Clock
 from gie_tpu.resilience.ladder import ResilienceState, Rung
 from gie_tpu.fairness import FairnessState
 from gie_tpu.sched import constants as C
@@ -109,13 +110,19 @@ class _Pending:
                  "excl_breaker", "excl_drain", "tenant", "cost",
                  "fed_remote", "fed_base")
 
-    def __init__(self, req: PickRequest, candidates: list, band: Optional[int] = None):
+    def __init__(self, req: PickRequest, candidates: list,
+                 band: Optional[int] = None,
+                 now: Optional[float] = None):
         self.req = req
         self.candidates = candidates
         self.event = threading.Event()
         self.result: Optional[PickResult] = None
         self.error: Optional[Exception] = None
-        self.enqueued_at = time.monotonic()
+        # Clock-seam timestamp (runtime/clock.py): age sheds and queue-
+        # wait metrics compare this against the picker's clock, so both
+        # must come from the same source (virtual in a time-compressed
+        # storm).
+        self.enqueued_at = MONOTONIC.now() if now is None else now
         # Set when the caller's pick() wait expired: the collector must DROP
         # the item rather than schedule it — a scheduled pick charges assumed
         # load that no served feedback will ever release.
@@ -240,6 +247,48 @@ class _Wave:
 _CLOSE = object()
 
 
+class _WaveQueue:
+    """Unbounded FIFO between dispatcher and completer, built on a
+    Condition threaded through the Clock seam (runtime/clock.py):
+    ``queue.Queue``'s internal waits are invisible to a virtual clock,
+    so a time-compressed storm could never park/wake the completer on
+    the simulated timeline. API mirrors the ``queue.Queue`` subset the
+    picker used (``get`` raises ``queue.Empty``; unbounded ``put`` never
+    blocks, matching the maxsize-0 queue this replaces)."""
+
+    def __init__(self, clock: Clock):
+        self._clock = clock
+        self._items: list = []
+        self._cond = threading.Condition()
+
+    def put(self, item, timeout: Optional[float] = None) -> None:
+        del timeout  # unbounded: put never blocks (queue.Queue parity)
+        with self._cond:
+            self._items.append(item)
+            self._clock.notify(self._cond)
+
+    def get(self, timeout: Optional[float] = None):
+        """One bounded receive: an empty queue waits at most ``timeout``
+        (a wake with nothing to take raises ``queue.Empty`` early — the
+        completer loop re-checks shutdown state and retries, so the
+        short wait is indistinguishable from the full one)."""
+        with self._cond:
+            if not self._items:
+                self._clock.wait(self._cond, timeout)
+                if not self._items:
+                    raise queue.Empty
+            return self._items.pop(0)
+
+    def get_nowait(self):
+        with self._cond:
+            if not self._items:
+                raise queue.Empty
+            return self._items.pop(0)
+
+    def empty(self) -> bool:
+        return not self._items
+
+
 class BatchingTPUPicker:
     """EndpointPicker backed by the batched Scheduler."""
 
@@ -265,7 +314,16 @@ class BatchingTPUPicker:
         resilience: Optional[ResilienceState] = None,
         fairness: Optional["FairnessState"] = None,
         federation=None,
+        clock: Clock = MONOTONIC,
     ):
+        # Clock seam (runtime/clock.py): every BEHAVIORAL read of time in
+        # the pick path — enqueue ages, deadline checks, hold pacing, the
+        # batching window, pick() waits, wave handoff — goes through this
+        # clock, so StormEngine(virtual_time=True) drives the whole flow
+        # queue on the simulated timeline. Pipeline stage EWMAs and
+        # flight-record ``ts`` fields deliberately stay on the real clock
+        # (they are observability, not behavior).
+        self._clock = clock
         self.scheduler = scheduler
         self.datastore = datastore
         self.metrics_store = metrics_store
@@ -363,7 +421,7 @@ class BatchingTPUPicker:
         self._cycle_ewma = 0.0
         self._depth_waves = 0
         self._depth_want_prev = pipeline_depth
-        self._waves: queue.Queue = queue.Queue()
+        self._waves = _WaveQueue(clock)
         # Background N-bucket lattice warming (ROADMAP follow-up): with
         # background_warm=True the dispatcher's first contact with a new
         # (m, chunk_lanes) lattice kicks Scheduler.warm_lattice_async for
@@ -385,7 +443,8 @@ class BatchingTPUPicker:
         # over-fair-share preemptive shed. Always on (uniform weights by
         # default = the proposal-1199 fair interleave, now cost-weighted);
         # the runner passes a weighted instance from --fairness-weights.
-        self.fairness = fairness if fairness is not None else FairnessState()
+        self.fairness = (fairness if fairness is not None
+                         else FairnessState(clock=clock.now))
         # Multi-cluster federation (gie_tpu/federation,
         # docs/FEDERATION.md): imported peer endpoints join candidate
         # sets through the spill policy at wave cadence. None = single
@@ -431,7 +490,8 @@ class BatchingTPUPicker:
             raise ExtProcError(
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"malformed objective header: {type(e).__name__}: {e}")
-        item = _Pending(req, candidates, band=band)
+        item = _Pending(req, candidates, band=band,
+                        now=self._clock.now())
         # Fairness ledger (gie_tpu/fairness): offered-cost accounting +
         # gie_tenant_requests_total — one leaf-lock note per enqueue.
         self.fairness.note_arrival(item.tenant, item.cost)
@@ -445,12 +505,13 @@ class BatchingTPUPicker:
                 self._admit_into_full_queue(band, tenant=item.tenant)
             self._pending.append(item)
             own_metrics.QUEUE_DEPTH.set(len(self._pending))
-            self._cond.notify()
+            self._clock.notify(self._cond)
         # Bounded wait: if the collector ever wedges (device hang, bug), fail
         # the stream instead of hanging the ext-proc thread forever. Budget =
         # flow-control hold window + a generous scheduling allowance (first
         # jit compile of a new batch bucket can take tens of seconds).
-        if not item.event.wait(self.hold_max_s + self.pick_timeout_s):
+        if not self._clock.wait_event(
+                item.event, self.hold_max_s + self.pick_timeout_s):
             item.abandoned = True
             raise ExtProcError(
                 grpc.StatusCode.UNAVAILABLE, "scheduler did not respond in time"
@@ -538,7 +599,7 @@ class BatchingTPUPicker:
                 it.error = ShedError(
                     "tenant over fair share under saturation",
                     band=it.band, tenant=it.tenant)
-                it.event.set()
+                self._clock.set_event(it.event)
                 own_metrics.QUEUE_SHED.labels(
                     reason="tenant", band="sheddable").inc()
                 self.fairness.note_shed(it.tenant, "sheddable")
@@ -577,7 +638,7 @@ class BatchingTPUPicker:
                 picked_at = float(getattr(ctx, "picked_at", 0.0) or 0.0)
                 if picked_at:
                     rec["serve_latency_ms"] = round(max(
-                        time.monotonic() - picked_at, 0.0) * 1e3, 1)
+                        self._clock.now() - picked_at, 0.0) * 1e3, 1)
         if (primary and served_hostport
                 and served_hostport != primary):
             # Envoy walked the fallback list: an earlier entry — the
@@ -591,7 +652,7 @@ class BatchingTPUPicker:
         if status > 0:
             picked_at = float(getattr(ctx, "picked_at", 0.0) or 0.0)
             latency_s = (
-                max(time.monotonic() - picked_at, 0.0) if picked_at else 0.0)
+                max(self._clock.now() - picked_at, 0.0) if picked_at else 0.0)
             self._note_serve_outcome(
                 served_hostport, ok=status < 500,
                 cls=f"{status // 100}xx", latency_s=latency_s,
@@ -611,7 +672,7 @@ class BatchingTPUPicker:
                 # features describe the PRIMARY endpoint, so training on
                 # this latency would mislabel the pair. Skip.
                 return
-            elapsed = max(time.monotonic() - picked_at, 1e-4)
+            elapsed = max(self._clock.now() - picked_at, 1e-4)
             # Response headers arrive ~ first token: elapsed approximates
             # TTFT; TPOT is unobservable at this hop (no token counts), so
             # the sample trains the TTFT head only (tpot masked). The TPOT
@@ -767,7 +828,7 @@ class BatchingTPUPicker:
         """Flow-queue zpage (/debugz/queue, gie_tpu/obs): live depth,
         per-band composition, and the oldest waiter's age. The lock is
         held only for the list copy; aggregation runs outside it."""
-        now = time.monotonic()
+        now = self._clock.now()
         with self._cond:
             items = list(self._pending)
         bands: dict[str, int] = {}
@@ -807,7 +868,7 @@ class BatchingTPUPicker:
     def close(self) -> None:
         with self._cond:
             self._closed = True
-            self._cond.notify()
+            self._clock.notify(self._cond)
         self._worker.join(timeout=5)
         # DRAIN, don't abandon: every wave the dispatcher already pushed
         # still materializes and wakes its waiters before the completer
@@ -836,14 +897,24 @@ class BatchingTPUPicker:
                     if item.result is None and item.error is None:
                         item.error = ExtProcError(
                             grpc.StatusCode.UNAVAILABLE, "picker shut down")
-                    item.event.set()
+                    self._clock.set_event(item.event)
                 with self._inflight_cv:
                     self._inflight -= 1
-                    self._inflight_cv.notify_all()
+                    self._clock.notify_all(self._inflight_cv)
 
     # -- collector ---------------------------------------------------------
 
     def _loop(self) -> None:
+        # Virtual-time actor registration (runtime/clock.py; no-op on
+        # the real clock): the collector is one of the simulation's
+        # parked/active participants.
+        tok = self._clock.actor_begin("picker-collector")
+        try:
+            self._loop_inner()
+        finally:
+            self._clock.actor_end(tok)
+
+    def _loop_inner(self) -> None:
         # The collector must NEVER die: every code path that can raise is
         # inside a try whose handler fails the affected waiters and keeps
         # looping. A dead collector would hang every in-flight and future
@@ -853,12 +924,12 @@ class BatchingTPUPicker:
             try:
                 with self._cond:
                     while not self._pending and not self._closed:
-                        self._cond.wait()
+                        self._clock.wait(self._cond)
                     if self._closed and not self._pending:
                         return
                     # Micro-batch window: collect stragglers before draining.
                     if len(self._pending) < self.max_batch:
-                        self._cond.wait(self.max_wait_s)
+                        self._clock.wait(self._cond, self.max_wait_s)
                     if len(self._pending) > self.max_batch:
                         # Flow-control fairness: when demand exceeds one
                         # cycle, weighted deficit-round-robin across
@@ -889,7 +960,7 @@ class BatchingTPUPicker:
                     item.error = ExtProcError(
                         grpc.StatusCode.INTERNAL, f"scheduler failure: {e}"
                     )
-                    item.event.set()
+                    self._clock.set_event(item.event)
                 continue
             if held:
                 with self._cond:
@@ -901,7 +972,7 @@ class BatchingTPUPicker:
                     self._pending = held + self._pending
                     own_metrics.QUEUE_DEPTH.set(len(self._pending))
                     if not new_arrivals:
-                        self._cond.wait(self.hold_retry_s)
+                        self._clock.wait(self._cond, self.hold_retry_s)
 
     _M_SHRINK_PATIENCE = 64  # consecutive smaller-bucket waves before shrink
     _DEPTH_RETUNE_WAVES = 32  # auto pipeline-depth retune cadence
@@ -930,7 +1001,7 @@ class BatchingTPUPicker:
             self._depth_limit = want
             # Raising the limit may unblock a dispatcher waiting on the
             # old one; lowering just lets in-flight waves drain past it.
-            self._inflight_cv.notify_all()
+            self._clock.notify_all(self._inflight_cv)
 
     def _pick_m_bucket(self, endpoints) -> int:
         """Endpoint-axis bucket for this wave: smallest M bucket covering
@@ -967,13 +1038,13 @@ class BatchingTPUPicker:
             # the wave charges any device work — nobody is waiting for
             # the answer. Requests without a deadline header carry 0.0
             # and cost one float compare here.
-            now = time.monotonic()
+            now = self._clock.now()
             kept: list[_Pending] = []
             for it in batch:
                 d = it.req.deadline_at
                 if d and now >= d:
                     it.error = deadline_mod.DeadlineExceeded("queue")
-                    it.event.set()
+                    self._clock.set_event(it.event)
                     own_metrics.DEADLINE_SHED.labels(stage="queue").inc()
                 else:
                     kept.append(it)
@@ -984,7 +1055,7 @@ class BatchingTPUPicker:
             # bounded queue AGE, the second half of the flow-controller's
             # overload policy. CRITICAL is exempt (its latency bound comes
             # from draining first in _fair_order).
-            now = time.monotonic()
+            now = self._clock.now()
             kept: list[_Pending] = []
             for it in batch:
                 if (
@@ -993,7 +1064,7 @@ class BatchingTPUPicker:
                 ):
                     it.error = ShedError("queued beyond flow-control age bound",
                                          band=it.band, tenant=it.tenant)
-                    it.event.set()
+                    self._clock.set_event(it.event)
                     own_metrics.QUEUE_SHED.labels(
                         reason="age",
                         band=_BAND_NAMES.get(it.band, "standard")).inc()
@@ -1108,7 +1179,7 @@ class BatchingTPUPicker:
         held: list[_Pending] = []
         if self.hold_max_s > 0:
             queues = self.metrics_store.host_queue_depths()
-            now = time.monotonic()
+            now = self._clock.now()
             runnable: list[_Pending] = []
             for it in batch:
                 slots = it.cand_slots
@@ -1229,7 +1300,7 @@ class BatchingTPUPicker:
         # limit the auto policy may move at runtime.
         with self._inflight_cv:
             while self._inflight >= self._depth_limit:
-                self._inflight_cv.wait()
+                self._clock.wait(self._inflight_cv)
             self._inflight += 1
         own_metrics.PIPELINE_DEPTH.inc()
         own_metrics.PIPELINE_WAVES.inc()
@@ -1240,6 +1311,13 @@ class BatchingTPUPicker:
     # -- completer (pipeline stage 2) --------------------------------------
 
     def _completer_loop(self) -> None:
+        tok = self._clock.actor_begin("picker-completer")
+        try:
+            self._completer_loop_inner()
+        finally:
+            self._clock.actor_end(tok)
+
+    def _completer_loop_inner(self) -> None:
         # Strictly dispatch-ordered (one thread, FIFO queue) and, like the
         # dispatcher, it must NEVER die: a failure touches only its own
         # wave's waiters, then the next wave is served regardless — device
@@ -1272,7 +1350,7 @@ class BatchingTPUPicker:
             # part of the pipeline's throughput.
             with self._inflight_cv:
                 self._inflight -= 1
-                self._inflight_cv.notify_all()
+                self._clock.notify_all(self._inflight_cv)
             try:
                 self._complete_wave(wave)
             except Exception as e:
@@ -1284,7 +1362,7 @@ class BatchingTPUPicker:
                         item.error = ExtProcError(
                             grpc.StatusCode.INTERNAL,
                             f"scheduler failure: {e}")
-                    item.event.set()
+                    self._clock.set_event(item.event)
             finally:
                 own_metrics.PIPELINE_DEPTH.dec()
 
@@ -1350,7 +1428,7 @@ class BatchingTPUPicker:
         board_open = rs is not None and rs.board.has_open
         any_draining = any(
             getattr(ep, "draining", False) for ep in wave.endpoints)
-        now_mono = time.monotonic()
+        now_mono = self._clock.now()
         # Flight recorder (gie_tpu/obs, docs/OBSERVABILITY.md): one
         # decision record per request, built HERE on the completer from
         # the wave results that are already host-side — result.scores
@@ -1376,6 +1454,15 @@ class BatchingTPUPicker:
                 "trace_id": tr.trace_id if tr is not None else "",
                 "model": req.model,
                 "band": _BAND_NAMES.get(item.band, str(item.band)),
+                # Workload identity (additive fields, schema v1 loaders
+                # keep them verbatim): what the request LOOKED like —
+                # prompt size, decode hint, tenant — so a recorder dump
+                # can be replayed as a storm trace (shapes.TraceReplay,
+                # docs/STORM.md) and the item-3 trainers see the
+                # request mix, not just the decision.
+                "prompt_bytes": int(len(req.body) if req.body else 0),
+                "decode_tokens": float(req.decode_tokens or 0.0),
+                "tenant": item.tenant,
                 "rung": "full",
                 "candidates": [int(s) for s in item.cand_slots],
                 "excluded_breaker": list(item.excl_breaker),
@@ -1388,7 +1475,7 @@ class BatchingTPUPicker:
             }
 
         for i, item in enumerate(batch):
-            lat = time.monotonic() - item.enqueued_at
+            lat = self._clock.now() - item.enqueued_at
             tr = item.req.trace
             if tr is not None:
                 tr.event("picked")
@@ -1517,7 +1604,7 @@ class BatchingTPUPicker:
                                 bool(lora[i] >= 0),
                             ),
                             slot,  # feeds the per-endpoint embedding
-                            time.monotonic(),
+                            self._clock.now(),
                             picked[0],  # primary hostport the features describe
                         )
                     if recorder is not None:
@@ -1572,7 +1659,7 @@ class BatchingTPUPicker:
         for item in batch:
             if item.result is not None:
                 own_metrics.PICKS.labels(outcome="ok").inc()
-            item.event.set()
+            self._clock.set_event(item.event)
 
     # -- degraded pick path (resilience ladder rungs 1-3) ------------------
 
@@ -1634,7 +1721,7 @@ class BatchingTPUPicker:
             for item in batch:
                 item.error = ExtProcError(
                     grpc.StatusCode.UNAVAILABLE, "no endpoints available")
-                item.event.set()
+                self._clock.set_event(item.event)
                 own_metrics.PICKS.labels(outcome="unavailable").inc()
             return
         label = self._RUNG_LABELS.get(rung, "static")
@@ -1732,7 +1819,7 @@ class BatchingTPUPicker:
                             if s in drain_set),
                         "draining": sorted(int(s) for s in drain_set),
                         "deadline_remaining_ms": (
-                            round((d - time.monotonic()) * 1e3, 1)
+                            round((d - self._clock.now()) * 1e3, 1)
                             if d else None),
                         "outcome": "picked",
                         "chosen": res.endpoint,
@@ -1750,7 +1837,7 @@ class BatchingTPUPicker:
                 # stage and the bucket->trace exemplar must not vanish
                 # exactly while the pool is degraded — that is when the
                 # traces are read.
-                lat = time.monotonic() - item.enqueued_at
+                lat = self._clock.now() - item.enqueued_at
                 tr = item.req.trace
                 if tr is not None:
                     tr.event("picked")
@@ -1761,7 +1848,7 @@ class BatchingTPUPicker:
                         own_metrics.PICK_LATENCY.observe(lat)
                 else:
                     own_metrics.PICK_LATENCY.observe(lat)
-                item.event.set()
+                self._clock.set_event(item.event)
 
     def _slo_admission(self, batch: list[_Pending]) -> None:
         """Predictive SLO shedding (006 README:27-36 SLO dimension): after

@@ -131,6 +131,14 @@ class VLLMStub:
         """Advance the clock, admitting and progressing requests. Returns
         completions finishing within this step."""
         end = self.clock + dt
+        # Idle fast path: with nothing queued or running, sub-ticking is
+        # pure clock arithmetic — skip straight to the end. A 2-hour
+        # compressed storm (docs/STORM.md "virtual clock") steps every
+        # stub through the whole night; without this the diurnal valley
+        # costs the same CPU as the peak.
+        if not self.queue and not self.running:
+            self.clock = end
+            return []
         # Fixed sub-tick for determinism.
         tick = 0.005
         while self.clock < end - 1e-12:

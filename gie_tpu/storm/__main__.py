@@ -25,6 +25,10 @@ def main() -> int:
                         help="override the scenario's seed")
     parser.add_argument("--out", default=None,
                         help="directory for the scorecard artifact")
+    parser.add_argument("--virtual", action="store_true",
+                        help="force the gie-twin virtual clock "
+                             "(docs/STORM.md) regardless of the "
+                             "scenario's own virtual_time setting")
     args = parser.parse_args()
 
     import jax
@@ -35,7 +39,8 @@ def main() -> int:
     from gie_tpu.storm.engine import run_scenario
 
     result = run_scenario(args.scenario, seed=args.seed,
-                          dump_dir=args.out)
+                          dump_dir=args.out,
+                          virtual_time=True if args.virtual else None)
     json.dump(result.scorecard, sys.stdout, indent=1, default=float)
     print()
     return 0

@@ -38,9 +38,10 @@ goodput floors) are the replayable contract, not byte-equal traces.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import random
 import threading
-import time
 from typing import Optional
 
 import numpy as np
@@ -61,6 +62,7 @@ from gie_tpu.resilience.ladder import (
     Rung,
 )
 from gie_tpu.resilience.outlier import OutlierEjector
+from gie_tpu.runtime.clock import MONOTONIC, VirtualClock
 from gie_tpu.sched import Scheduler
 from gie_tpu.sched.batching import BatchingTPUPicker
 from gie_tpu.simulator.vllm_stub import StubConfig, VLLMStub
@@ -84,6 +86,14 @@ DEFAULT_STUB = StubConfig(
     max_lora=4,
     lora_load_s=0.15,
 )
+
+# Engine-default breaker: fast-recovery variants of the production
+# defaults (a CI storm must see open AND close in seconds). Module-level
+# so the search harness (storm/search.py) can base breaker.* knobs on
+# the exact config an unconfigured storm runs with.
+DEFAULT_BREAKER = BreakerConfig(
+    open_after=4, open_s=1.0, close_after=2,
+    serve_window_s=4.0, serve_rate_open=0.6, serve_min_samples=8)
 
 
 @dataclasses.dataclass
@@ -169,6 +179,12 @@ class EngineConfig:
     # Multi-cluster federation storms (gie-fed): a peer cluster spec,
     # or None for the classic single-cluster engine.
     federation: Optional[FederationSpec] = None
+    # gie-twin (docs/STORM.md "virtual clock"): run the whole stack on a
+    # deterministic discrete-event VirtualClock — an hour-long storm
+    # executes in seconds with a pinned decision sequence. Real mode is
+    # byte-for-byte the pre-twin engine (the clock seam is a monotonic
+    # passthrough).
+    virtual_time: bool = False
 
     def fast_ladder(self) -> LadderConfig:
         return LadderConfig(
@@ -237,7 +253,7 @@ class _StormStream:
 
     def resolve(self, kind: str, served: str = "", status: int = 200) -> None:
         self.resolution = (kind, served, status)
-        self._resolved.set()
+        self.engine.clock.set_event(self._resolved)
 
     # -- Stream interface (extproc/server.py) ------------------------------
 
@@ -252,7 +268,8 @@ class _StormStream:
             self._stage = 3
             if self.dest is None:
                 return None  # shed / immediate response: clean close
-            if not self._resolved.wait(self.engine.cfg.serve_timeout_s):
+            if not self.engine.clock.wait_event(
+                    self._resolved, self.engine.cfg.serve_timeout_s):
                 self.resolution = ("timeout", "", 0)
                 raise StreamAborted()
             kind, served, status = self.resolution
@@ -290,11 +307,40 @@ class StormResult:
 
 class StormEngine:
     def __init__(self, program: Program, pool: Optional[PoolSpec] = None,
-                 cfg: Optional[EngineConfig] = None, name: str = "storm"):
+                 cfg: Optional[EngineConfig] = None, name: str = "storm",
+                 virtual_time: Optional[bool] = None):
         self.program = program
         self.pool = pool if pool is not None else PoolSpec()
         self.cfg = cfg if cfg is not None else EngineConfig()
         self.name = name
+        # Virtual clock (gie-twin): the constructor kwarg overrides the
+        # config so `StormEngine(prog, virtual_time=True)` reads the way
+        # the docs say it does.
+        self.virtual = (self.cfg.virtual_time if virtual_time is None
+                        else bool(virtual_time))
+        self.clock = VirtualClock() if self.virtual else MONOTONIC
+        # Seeded rng for the subsystems whose pacing jitter would
+        # otherwise come from the module-level `random` (scrape phase
+        # stagger + backoff jitter): virtual runs must be bit-identical
+        # per seed. Real mode keeps the historical unseeded source.
+        self._rng = (random.Random(program.seed ^ 0x51C0_C10C)
+                     if self.virtual else None)
+        if self.virtual:
+            # Chaos latency/hang sleeps are clock-governed: serve them
+            # from the virtual clock (restored by close()).
+            from gie_tpu.resilience import faults as faults_mod
+
+            faults_mod.set_clock(self.clock)
+        # Virtual mode registers the MAIN thread as an actor for the
+        # whole engine lifetime (construction -> run): while main is
+        # active the clock cannot advance, so the virtual time consumed
+        # by construction/warmup/arming is EXACTLY the time the parked
+        # subsystems were waited on — deterministic — instead of "as
+        # many heap pops as the OS scheduler let through", which skewed
+        # every scrape/backoff phase relative to _t0 differently per
+        # run. run() releases it; close() backstops.
+        self._main_tok = (self.clock.actor_begin("storm-main")
+                          if self.virtual else None)
         self._sessions = [
             (b"STORM SYSTEM PROMPT %03d | " % s) * 2
             + b"s" * max(self.program.traffic.system_prompt_bytes - 52, 0)
@@ -343,6 +389,16 @@ class StormEngine:
         self._fed_pick_times: list[tuple] = []  # (t, cluster)
         self._fed_local_only_trace: list[tuple] = []
         self._fed_events: list[dict] = []
+        # Decision log: every landed pick as (t, destination, band), the
+        # core of the scorecard's decision_fingerprint (two same-seed
+        # VIRTUAL runs must produce the identical sequence — the gie-twin
+        # determinism contract; in real mode the fingerprint exists but
+        # varies with thread scheduling, by design).
+        self._pick_log: list[tuple] = []
+        # Workers in flight, counted by the engine (not Thread.is_alive:
+        # a thread's OS-level teardown is real-world nondeterminism, and
+        # the virtual drain loop's observations must be clock-exact).
+        self._workers_live = 0
 
     # -- stack construction ------------------------------------------------
 
@@ -357,23 +413,27 @@ class StormEngine:
         prof = dataclasses.replace(
             prof, queue_limit=cfg.queue_limit, kv_limit=cfg.kv_limit)
         self.scheduler = Scheduler(prof, weights=weights)
-        self.metrics_store = MetricsStore()
+        # Virtual mode hands every subsystem the same clock; real mode
+        # keeps each subsystem's historical default (monotonic for the
+        # resilience layer, wall time for the store's row stamps).
+        self.metrics_store = (MetricsStore(clock=self.clock.now)
+                              if self.virtual else MetricsStore())
         self.lora_registry = LoraRegistry()
         self.board = BreakerBoard(
-            cfg.breaker if cfg.breaker is not None
-            else BreakerConfig(open_after=4, open_s=1.0, close_after=2,
-                               serve_window_s=4.0, serve_rate_open=0.6,
-                               serve_min_samples=8))
+            cfg.breaker if cfg.breaker is not None else DEFAULT_BREAKER,
+            clock=self.clock.now)
         ladder = DegradationLadder(
-            cfg.ladder if cfg.ladder is not None else cfg.fast_ladder())
-        ejector = (OutlierEjector(cfg.outlier)
+            cfg.ladder if cfg.ladder is not None else cfg.fast_ladder(),
+            clock=self.clock.now)
+        ejector = (OutlierEjector(cfg.outlier, clock=self.clock.now)
                    if cfg.outlier is not None else None)
         self.resilience = ResilienceState(
             board=self.board, ladder=ladder,
             static_subset=cfg.static_subset, ejector=ejector)
         self.datastore = Datastore(
             on_slot_reclaimed=self._slot_reclaimed,
-            drain_deadline_s=pool.drain_deadline_s)
+            drain_deadline_s=pool.drain_deadline_s,
+            clock=self.clock.now)
         self.datastore.pool_set(POOL)
         self._stubs: dict[str, _StubSlot] = {}
         self._pod_names: list[str] = []
@@ -427,7 +487,12 @@ class StormEngine:
             self.peer_pub = FederationPublisher(
                 {fed_summary.META_SECTION: _peer_meta,
                  fed_summary.LOAD_SECTION: _peer_load},
-                era_seq=1)
+                era_seq=1,
+                # Deterministic era token: the pair's ordering semantics
+                # never read it, but a reproducible scorecard should not
+                # carry run-unique randomness.
+                era_token=(self.program.seed & 0x7FFF_FFFF) or 1,
+                clock=self.clock)
             self.peer_pub.refresh()
             self.fed_state = FederationState(
                 self.datastore, self.metrics_store,
@@ -436,7 +501,8 @@ class StormEngine:
                 penalty=fed.penalty,
                 stale_inflate_s=fed.stale_inflate_s,
                 local_only_after_s=fed.local_only_after_s,
-                spill_queue_limit=cfg.queue_limit)
+                spill_queue_limit=cfg.queue_limit,
+                clock=self.clock.now)
             self.fed_exchange = FederationExchange(
                 self.fed_state,
                 cluster="local",
@@ -450,7 +516,9 @@ class StormEngine:
                 wait_s=fed.wait_s,
                 link_open_after=fed.link_open_after,
                 link_open_s=fed.link_open_s,
-                fetch=self._fed_fetch)
+                fetch=self._fed_fetch,
+                seed=self.program.seed,
+                clock=self.clock)
         self.picker = BatchingTPUPicker(
             self.scheduler, self.datastore, self.metrics_store,
             max_wait_s=cfg.batch_window_s,
@@ -460,7 +528,8 @@ class StormEngine:
             max_batch=48,
             lora_registry=self.lora_registry,
             resilience=self.resilience,
-            federation=self.fed_state)
+            federation=self.fed_state,
+            clock=self.clock)
         self.server = StreamingServer(
             self.datastore, self.picker,
             on_served=self.picker.observe_served,
@@ -470,7 +539,8 @@ class StormEngine:
             self.metrics_store, lora=self.lora_registry,
             interval_s=cfg.scrape_interval_s, max_backoff_s=0.2,
             fetcher=self._fetch_metrics, workers=2,
-            breaker_board=self.board)
+            breaker_board=self.board,
+            clock=self.clock, rng=self._rng)
         self.resilience.staleness_fn = self.scrape.staleness_seconds
         self._sync_scrapers()
         # Autoscale loop (optional): the real recommender over the real
@@ -624,7 +694,8 @@ class StormEngine:
         A dead destination is an Envoy local-reply 503 (client-visible);
         the response-headers hop then attributes it to the primary."""
         a = stream.arrival
-        now = time.monotonic()
+        now = self.clock.now()
+        self._pick_log.append((round(self._now(), 6), stream.dest, a.band))
         if self.fed_state is not None:
             cluster = self._cluster_of(stream.dest)
             self._fed_picks[(cluster, a.band)] += 1
@@ -646,7 +717,7 @@ class StormEngine:
         """One arrival, end to end through the real ext-proc server."""
         tenant = a.tenant or "default"
         stream = _StormStream(self, a)
-        stream.t_enqueue = time.monotonic()
+        stream.t_enqueue = self.clock.now()
         try:
             self.server.process(stream)
         except ExtProcError as e:
@@ -695,7 +766,7 @@ class StormEngine:
                 self._fed_serves[self._cluster_of(_served)] += 1
 
     def _now(self) -> float:
-        return time.monotonic() - self._t0
+        return self.clock.now() - self._t0
 
     # -- world loop --------------------------------------------------------
 
@@ -739,9 +810,13 @@ class StormEngine:
                  inf.arrival.tenant or "default"))
 
     def _autoscale_tick(self) -> None:
-        sig = self.collector.sample()
+        # The signal window and the store's row stamps must share one
+        # clock family: virtual now in virtual mode, the collector's
+        # wall-clock default otherwise (matching the store's default).
+        now = self.clock.now() if self.virtual else None
+        sig = self.collector.sample(now=now)
         current = len(self.datastore.local_endpoints())
-        rec = self.recommender.observe(sig, current=current)
+        rec = self.recommender.observe(sig, current=current, now=now)
         if rec.desired > current:
             base = len(self._pod_names)
             for k in range(rec.desired - current):
@@ -868,6 +943,8 @@ class StormEngine:
             bodies.setdefault(chunk_bucket_for(int(counts.max())), body)
         bodies = list(bodies.values())
 
+        import itertools
+
         def one(body: bytes):
             try:
                 self.picker.pick(PickRequest(headers={}, body=body),
@@ -878,10 +955,27 @@ class StormEngine:
         for body in bodies:
             one(body)
             for n in (8, 12):
-                ts = [threading.Thread(target=one, args=(body,))
+                # Concurrent burst with a CLOCK-MEDIATED join: the last
+                # finisher sets the done event through the clock, so in
+                # virtual mode the main thread parks (letting the
+                # batching window fire) instead of blocking the advance
+                # rule in a real join — and each burst consumes exactly
+                # one deterministic batching window of virtual time.
+                done = threading.Event()
+                finished = itertools.count(1)  # atomic ticket
+
+                def burst(body=body, n=n, done=done, finished=finished):
+                    try:
+                        one(body)
+                    finally:
+                        if next(finished) == n:
+                            self.clock.set_event(done)
+
+                ts = [self.clock.actor_thread(burst, name="storm-warm")
                       for _ in range(n)]
                 [t.start() for t in ts]
-                [t.join() for t in ts]
+                self.clock.wait_event(done, 600.0)
+                [t.join(timeout=60) for t in ts]
 
     def _start_federation(self) -> None:
         """Start the exchange (idempotent) and block briefly until the
@@ -891,10 +985,26 @@ class StormEngine:
             return
         self._fed_started = True
         self.fed_exchange.start()
-        deadline = time.monotonic() + 5.0
+        deadline = self.clock.now() + 5.0
         link = next(iter(self.fed_exchange.links.values()))
-        while time.monotonic() < deadline and link.installs == 0:
-            time.sleep(0.02)
+        while self.clock.now() < deadline and link.installs == 0:
+            self.clock.sleep(0.02)
+
+    def _spawn_worker(self, a) -> threading.Thread:
+        self._workers_live += 1
+
+        def serve():
+            try:
+                self._serve_one(a)
+            finally:
+                # GIL-atomic int decrement; the drain loop polls it on
+                # the engine clock (deterministic in virtual mode, where
+                # Thread.is_alive()'s OS teardown timing would not be).
+                self._workers_live -= 1
+
+        w = self.clock.actor_thread(serve, name="storm-worker")
+        w.start()
+        return w
 
     def run(self, schedule: Optional[Schedule] = None,
             warmup: bool = True) -> StormResult:
@@ -906,8 +1016,11 @@ class StormEngine:
             self.warmup(schedule)
         if cfg.force_rung is not None:
             self.resilience.ladder.force_level(Rung(cfg.force_rung))
-        self._t0 = time.monotonic()
-        world = threading.Thread(target=self._world_loop, daemon=True)
+        # The main thread has been a registered actor since __init__ in
+        # virtual mode (determinism: the clock never free-runs while it
+        # is active); real mode needs no registration.
+        self._t0 = self.clock.now()
+        world = self.clock.actor_thread(self._world_loop, name="storm-world")
         world.start()
         workers: list[threading.Thread] = []
         events = list(schedule.events)
@@ -933,26 +1046,41 @@ class StormEngine:
                     # them.
                     self._client_skipped += 1
                     continue
-                w = threading.Thread(
-                    target=self._serve_one, args=(a,), daemon=True)
-                w.start()
-                workers.append(w)
+                workers.append(self._spawn_worker(a))
+                if self.virtual:
+                    # Yield one advance cycle so the spawned worker runs
+                    # to its first park before the next arrival: same-
+                    # instant arrivals would otherwise race their flow-
+                    # queue enqueues and break the bit-identical decision
+                    # sequence.
+                    self.clock.sleep(0.0)
             while next_ev < len(events):
                 ev = events[next_ev]
                 next_ev += 1
                 self._wait_until(ev.t)
                 self._control_event(ev)
             self._wait_until(schedule.traffic.duration_s)
-            # Drain: let in-flight serves finish (bounded).
-            deadline = time.monotonic() + 20.0
-            for w in workers:
-                w.join(timeout=max(deadline - time.monotonic(), 0.0))
+            # Drain: let in-flight serves finish (bounded). Virtual mode
+            # polls the engine-owned counter on the virtual clock — the
+            # decrements are serialized by the advance rule, and
+            # Thread.is_alive/join would couple the deterministic
+            # timeline to OS thread-teardown timing. Real mode keeps the
+            # historical joins (the counter's unlocked read-modify-write
+            # is only safe under serialization).
+            deadline = self.clock.now() + 20.0
+            if self.virtual:
+                while (self._workers_live > 0
+                       and self.clock.now() < deadline):
+                    self.clock.sleep(0.05)
+            else:
+                for w in workers:
+                    w.join(timeout=max(deadline - self.clock.now(), 0.0))
             # Recovery window: keep the world (and probes) ticking until
             # the ladder climbs home or the bounded window ends.
-            recover_until = time.monotonic() + 10.0
+            recover_until = self.clock.now() + 10.0
             from gie_tpu.extproc.server import PickRequest
 
-            while (time.monotonic() < recover_until
+            while (self.clock.now() < recover_until
                    and cfg.force_rung is None
                    and self.resilience.ladder.rung() != Rung.FULL):
                 try:
@@ -961,33 +1089,51 @@ class StormEngine:
                         self.datastore.pick_candidates())
                 except Exception:
                     pass
-                time.sleep(0.05)
+                self.clock.sleep(0.05)
         finally:
             self._stop.set()
+            # Unregister BEFORE joining: a virtual clock only advances
+            # (and wakes the world loop so it can observe _stop) while
+            # no registered actor is active — and the joining submitter
+            # is exactly that. Scoring below reads only frozen tallies.
+            if self._main_tok is not None:
+                self.clock.actor_end(self._main_tok)
+                self._main_tok = None
             world.join(timeout=10)
         card = self._score(schedule)
         return StormResult(card, schedule, self.resilience, self.board,
                            self.scheduler, self.datastore)
 
     def close(self) -> None:
+        if self._main_tok is not None:
+            # run() never happened (construction-only tests/error
+            # paths): release the main actor so teardown's parked
+            # threads can be woken.
+            self.clock.actor_end(self._main_tok)
+            self._main_tok = None
         if self.fed_exchange is not None:
             self.fed_exchange.stop()
         self.scrape.close()
         self.picker.close()
+        if self.virtual:
+            from gie_tpu.resilience import faults as faults_mod
+
+            faults_mod.set_clock(None)
+            self.clock.shutdown()
 
     def _wait_until(self, t_storm: float) -> None:
-        delay = (self._t0 + t_storm) - time.monotonic()
+        delay = (self._t0 + t_storm) - self.clock.now()
         if delay > 0:
-            time.sleep(delay)
+            self.clock.sleep(delay)
 
     def _world_loop(self) -> None:
         cfg = self.cfg
         next_autoscale = cfg.autoscale_interval_s
         next_trace = 0.0
-        last = time.monotonic()
+        last = self.clock.now()
         while not self._stop.is_set():
-            time.sleep(cfg.world_dt_s)
-            now = time.monotonic()
+            self.clock.sleep(cfg.world_dt_s)
+            now = self.clock.now()
             dt, last = now - last, now
             try:
                 self._world_tick(min(dt, 0.25))
@@ -1023,6 +1169,43 @@ class StormEngine:
                     pass
 
     # -- scoring -----------------------------------------------------------
+
+    def _decision_fingerprint(self) -> str:
+        """Digest of the run's DECISION SEQUENCE — every landed pick (in
+        order, with its virtual timestamp and band), every shed/error
+        tally, the breaker transition order, the rung/pool traces, and
+        the control-plane outcomes. Under ``virtual_time`` two same-seed
+        runs must produce the identical digest (the gie-twin determinism
+        contract, docs/STORM.md); in real mode it varies with thread
+        scheduling and is recorded for forensics only."""
+        ej = (self.resilience.ejector.ejections
+              if self.resilience.ejector is not None else [])
+        decisions = {
+            "picks": self._pick_log,
+            "ok": self._ok,
+            "shed": self._shed,
+            "client_5xx": len(self._client_5xx),
+            "resets": len(self._resets),
+            "timeouts": self._timeouts,
+            "client_skipped": self._client_skipped,
+            "shed_by_band": {k: self._shed_bands[k]
+                             for k in sorted(self._shed_bands)},
+            "tenant_ok": {k: self._tenant_ok[k]
+                          for k in sorted(self._tenant_ok)},
+            "tenant_shed": {k: self._tenant_shed[k]
+                            for k in sorted(self._tenant_shed)},
+            "breaker_events": list(self.board.events),
+            "rung_trace": self._rung_trace,
+            "pool_trace": self._pool_trace,
+            "ejection_slots": [int(e[1]) for e in ej],
+            "autoscale": [(e["from"], e["to"])
+                          for e in self._autoscale_events],
+            "upgrades": [(u["step"], u["pod"]) for u in self._upgrades],
+            "fed_picks": sorted(
+                (c, b, n) for (c, b), n in self._fed_picks.items()),
+        }
+        return hashlib.sha256(json.dumps(
+            decisions, sort_keys=True, default=float).encode()).hexdigest()
 
     def _score(self, schedule: Schedule) -> dict:
         ttfts = [c[0] for c in self._completions]
@@ -1110,6 +1293,15 @@ class StormEngine:
                 1 for a in schedule.arrivals if a.lora is not None),
             "long_context_arrivals": sum(
                 1 for a in schedule.arrivals if a.kind == "long_context"),
+            # gie-twin (docs/STORM.md "virtual clock"): whether the run
+            # executed on the virtual clock, the ordered breaker
+            # transition log (compared across clock modes by the real-
+            # vs-virtual equivalence test — no timestamps on purpose),
+            # and the decision-sequence digest pinned bit-identical
+            # across same-seed virtual runs.
+            "virtual_time": self.virtual,
+            "breaker_events": [list(e) for e in self.board.events],
+            "decision_fingerprint": self._decision_fingerprint(),
         }
         if self.fed_state is not None:
             # Per-cluster federation section (gie-fed): the four pinned
@@ -1154,13 +1346,77 @@ _STORM_DRIVE_KEYS = frozenset({
     "base_qps", "duration_s", "traffic", "shapes", "pool",
     "ttft_slo_s", "autoscale_max_extra", "queue_limit",
     "max_concurrency", "federation",
+    # gie-twin: virtual-clock mode + the cadence knobs a LONG compressed
+    # storm must coarsen (a 2-hour diurnal at a 25 ms scrape tick would
+    # spend its wall-clock budget sweeping /metrics).
+    "virtual_time", "scrape_interval_s", "world_dt_s",
+    "autoscale_interval_s",
 })
+
+
+def engine_from_drive(storm: dict, *, seed: int,
+                      pool: Optional[PoolSpec] = None,
+                      cfg: Optional[EngineConfig] = None,
+                      name: str = "storm",
+                      virtual_time: Optional[bool] = None) -> StormEngine:
+    """A StormEngine from a ``drive.storm`` dict: the Program compile,
+    the pool spec, the whitelisted engine knobs, the federation block,
+    and the standby inference — shared by :func:`run_scenario` and the
+    parameter-search harness (gie_tpu/storm/search.py), which runs the
+    SAME drive at many configs/durations."""
+    unknown = set(storm) - _STORM_DRIVE_KEYS
+    if unknown:
+        # Same contract as shapes_from_specs: a typoed knob silently
+        # falling back to a default would replay a DIFFERENT storm than
+        # the file records.
+        raise ValueError(
+            f"storm drive {name!r}: unknown drive.storm keys "
+            f"{sorted(unknown)}; known: {sorted(_STORM_DRIVE_KEYS)}")
+    program = program_from_drive(storm, seed=seed)
+    pool_kw = dict(storm.get("pool") or {})
+    if pool is None and pool_kw:
+        unknown = set(pool_kw) - {
+            f.name for f in dataclasses.fields(PoolSpec)}
+        if unknown:
+            raise ValueError(f"unknown storm pool fields {sorted(unknown)}")
+        pool = PoolSpec(**pool_kw)
+    if cfg is None:
+        cfg = EngineConfig()
+    # Whitelisted engine knobs a scenario may pin (everything else in
+    # EngineConfig is harness policy, not scenario content).
+    for key, cast in (("ttft_slo_s", float), ("autoscale_max_extra", int),
+                      ("queue_limit", float), ("max_concurrency", int),
+                      ("virtual_time", bool), ("scrape_interval_s", float),
+                      ("world_dt_s", float),
+                      ("autoscale_interval_s", float)):
+        if key in storm:
+            cfg = dataclasses.replace(cfg, **{key: cast(storm[key])})
+    if "federation" in storm:
+        fed_kw = dict(storm["federation"] or {})
+        unknown = set(fed_kw) - {
+            f.name for f in dataclasses.fields(FederationSpec)}
+        if unknown:
+            raise ValueError(
+                f"unknown storm federation fields {sorted(unknown)}")
+        cfg = dataclasses.replace(cfg, federation=FederationSpec(**fed_kw))
+    if any(s.get("kind") == "standby_failover"
+           for s in storm.get("shapes") or []):
+        # failover_check events need the replication publisher armed.
+        cfg = dataclasses.replace(cfg, standby=True)
+    # An explicit caller clock-mode override beats the scenario's
+    # pinned key (the CLI's --virtual, the search harness's
+    # --real-time): the whitelist loop above applied the drive's value,
+    # so this must come last.
+    if virtual_time is not None:
+        cfg = dataclasses.replace(cfg, virtual_time=bool(virtual_time))
+    return StormEngine(program, pool=pool, cfg=cfg, name=name)
 
 
 def run_scenario(name_or_path: str, *, seed: Optional[int] = None,
                  pool: Optional[PoolSpec] = None,
                  cfg: Optional[EngineConfig] = None,
-                 dump_dir: Optional[str] = None) -> StormResult:
+                 dump_dir: Optional[str] = None,
+                 virtual_time: Optional[bool] = None) -> StormResult:
     """Replay a recorded scenario whose ``drive`` carries a ``storm``
     section: arm the scenario's chaos rules (AFTER warmup — the chaos
     suite's bounded-schedule lesson), execute the storm program against
@@ -1176,44 +1432,10 @@ def run_scenario(name_or_path: str, *, seed: Optional[int] = None,
         raise ValueError(
             f"scenario {scn.name!r} has no drive.storm section — not a "
             "storm scenario (see docs/STORM.md)")
-    unknown = set(storm) - _STORM_DRIVE_KEYS
-    if unknown:
-        # Same contract as shapes_from_specs: a typoed knob silently
-        # falling back to a default would replay a DIFFERENT storm than
-        # the file records.
-        raise ValueError(
-            f"scenario {scn.name!r}: unknown drive.storm keys "
-            f"{sorted(unknown)}; known: {sorted(_STORM_DRIVE_KEYS)}")
-    program = program_from_drive(
-        storm, seed=scn.seed if seed is None else seed)
-    pool_kw = dict(storm.get("pool") or {})
-    if pool is None and pool_kw:
-        unknown = set(pool_kw) - {
-            f.name for f in dataclasses.fields(PoolSpec)}
-        if unknown:
-            raise ValueError(f"unknown storm pool fields {sorted(unknown)}")
-        pool = PoolSpec(**pool_kw)
-    if cfg is None:
-        cfg = EngineConfig()
-    # Whitelisted engine knobs a scenario may pin (everything else in
-    # EngineConfig is harness policy, not scenario content).
-    for key, cast in (("ttft_slo_s", float), ("autoscale_max_extra", int),
-                      ("queue_limit", float), ("max_concurrency", int)):
-        if key in storm:
-            cfg = dataclasses.replace(cfg, **{key: cast(storm[key])})
-    if "federation" in storm:
-        fed_kw = dict(storm["federation"] or {})
-        unknown = set(fed_kw) - {
-            f.name for f in dataclasses.fields(FederationSpec)}
-        if unknown:
-            raise ValueError(
-                f"unknown storm federation fields {sorted(unknown)}")
-        cfg = dataclasses.replace(cfg, federation=FederationSpec(**fed_kw))
-    if any(s.get("kind") == "standby_failover"
-           for s in storm.get("shapes") or []):
-        # failover_check events need the replication publisher armed.
-        cfg = dataclasses.replace(cfg, standby=True)
-    engine = StormEngine(program, pool=pool, cfg=cfg, name=scn.name)
+    engine = engine_from_drive(
+        storm, seed=scn.seed if seed is None else seed,
+        pool=pool, cfg=cfg, name=scn.name, virtual_time=virtual_time)
+    program = engine.program
     try:
         schedule = program.compile()
         engine.warmup(schedule)

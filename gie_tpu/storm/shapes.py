@@ -30,6 +30,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import math
+import zlib
 from typing import Optional
 
 import numpy as np
@@ -417,6 +418,91 @@ class PeerPartition(Shape):
         return out
 
 
+class TraceReplay(Shape):
+    """Replay RECORDED traffic (gie-twin, docs/STORM.md "trace replay"):
+    arrival timestamps plus the prompt-length / decode-hint / band /
+    tenant / adapter mix straight from a flight-recorder dump
+    (obs/recorder.py ``load_records`` — the artifacts every chaos/storm
+    run and the ``--obs-dump-dir`` shutdown hook already write). Where
+    the synthetic shapes model a workload, this one IS the workload: a
+    production incident's decision records become a storm program, and
+    under ``virtual_time`` a day of recorded traffic replays in minutes
+    against any candidate policy (the Tesserae-style trace-driven
+    evaluation PAPERS.md points at).
+
+    Composition: a TraceReplay REPLACES the Poisson arrival draw —
+    recorded arrivals are literal, so other shapes' ``rate``/``decorate``
+    contributions do not apply to them; control-plane shapes (rolling
+    upgrade, partitions, failover probes) still compose, which is
+    exactly the "replay yesterday's traffic through tomorrow's upgrade"
+    experiment. Multiple replays union their arrivals.
+
+    Record mapping: ``ts`` (wall seconds; the dump's first record is
+    t=0, spacing scaled by ``time_scale``), ``prompt_bytes`` /
+    ``decode_tokens`` / ``tenant`` (recorded since gie-twin; older
+    dumps fall back to the defaults), ``band`` verbatim, ``model`` !=
+    ``base_model`` becomes the LoRA adapter, and the session id is a
+    stable CRC of the trace ID so recorded prefix-affinity structure
+    survives the replay."""
+
+    def __init__(self, records: Optional[list] = None,
+                 path: Optional[str] = None, time_scale: float = 1.0,
+                 base_model: str = "base-model",
+                 default_prompt_bytes: int = 1024,
+                 default_decode_tokens: float = 16.0):
+        if (records is None) == (path is None):
+            raise ValueError(
+                "TraceReplay needs exactly one of records= / path=")
+        if time_scale <= 0:
+            raise ValueError("time_scale must be > 0")
+        if path is not None:
+            from gie_tpu.obs.recorder import load_records
+
+            with open(path, "r", encoding="utf-8") as fh:
+                records = load_records(fh.read())
+        rows = []
+        for rec in records:
+            ts = rec.get("ts")
+            if isinstance(ts, (int, float)):
+                rows.append((float(ts), rec))
+        if not rows:
+            raise ValueError(
+                "trace-replay dump has no timestamped records")
+        rows.sort(key=lambda r: r[0])
+        t0 = rows[0][0]
+        self._arrivals: list[dict] = []
+        for i, (ts, rec) in enumerate(rows):
+            band = rec.get("band")
+            model = rec.get("model")
+            tenant = rec.get("tenant")
+            trace_id = rec.get("trace_id") or ""
+            session = (zlib.crc32(trace_id.encode("utf-8", "replace"))
+                       if trace_id else i)
+            self._arrivals.append({
+                "t": round((ts - t0) * time_scale, 6),
+                "session": int(session),
+                "prompt_bytes": int(
+                    rec.get("prompt_bytes") or default_prompt_bytes),
+                "decode_tokens": float(
+                    rec.get("decode_tokens") or default_decode_tokens),
+                "band": band if band in BANDS else "standard",
+                "lora": (model if (isinstance(model, str) and model
+                                   and model != base_model) else None),
+                "kind": "chat",
+                "tenant": tenant if tenant else None,
+            })
+
+    def replay_arrivals(self, tc: "TrafficConfig") -> list[dict]:
+        """The literal arrival rows, sessions folded into the program's
+        session space (prefix-affinity structure preserved modulo
+        n_sessions)."""
+        return [dict(a, session=a["session"] % max(tc.n_sessions, 1))
+                for a in self._arrivals]
+
+    def duration_s(self) -> float:
+        return self._arrivals[-1]["t"] if self._arrivals else 0.0
+
+
 class StandbyFailover(Shape):
     """Warm-standby sync checkpoints: at each event the engine publishes
     the live scheduler's replication digest and has a follower fetch +
@@ -479,6 +565,29 @@ class Program:
 
     def compile(self) -> Schedule:
         tc = self.traffic
+        replays = [s for s in self.shapes if isinstance(s, TraceReplay)]
+        if replays:
+            # Recorded arrivals are LITERAL: they replace the Poisson
+            # draw, and other shapes' rate/decorate contributions do not
+            # re-shape them (control-plane shapes still compose — their
+            # events union below). The duration stretches to cover the
+            # replay so a dump longer than the configured window is
+            # never silently truncated.
+            rows: list[dict] = []
+            for shape in replays:
+                rows.extend(shape.replay_arrivals(tc))
+            rows.sort(key=lambda a: (a["t"], a["session"]))
+            arrivals = [Arrival(**a) for a in rows]
+            end = max((a.t for a in arrivals), default=0.0)
+            if end >= tc.duration_s:
+                tc = dataclasses.replace(
+                    tc, duration_s=round(end + 1.0, 6))
+            events: list[ControlEvent] = []
+            for shape in self.shapes:
+                events.extend(shape.control_events(tc.duration_s))
+            events.sort(key=lambda e: (e.t, e.kind, e.args))
+            return Schedule(arrivals=tuple(arrivals), events=tuple(events),
+                            seed=self.seed, traffic=tc)
         rng = np.random.default_rng(self.seed)
         arrivals: list[Arrival] = []
         t = 0.0
@@ -534,6 +643,8 @@ SHAPE_KINDS = {
     "abusive_tenant": AbusiveTenant,
     "cluster_drain": ClusterDrain,
     "peer_partition": PeerPartition,
+    # path= form only from a drive section (records= is programmatic).
+    "trace_replay": TraceReplay,
 }
 
 

@@ -1,20 +1,22 @@
 """Degraded-rung calibration sweeps (docs/RESILIENCE.md "ladder
-calibration"; ISSUE 10/11 satellites).
+calibration"; ISSUE 10/11 satellites) — now a thin wrapper over the
+generalized policy-search harness (gie_tpu/storm/search.py, ISSUE 14).
 
 Two sweeps, one harness: pin the ladder on a rung
 (DegradationLadder.force_level + prohibitive recovery thresholds), run
-the same seeded flash-crowd storm through the REAL stack per candidate
-value, score goodput / SLO attainment / TTFT percentiles — the rung's
-OWN policy performance, isolated from transition dynamics — and record
-the winning default.
+the same seeded flash-crowd storm per candidate value, score goodput /
+SLO attainment / TTFT percentiles — the rung's OWN policy performance,
+isolated from transition dynamics — and record the winning default.
 
   cached-kv   the CACHED rung's ``queue + w*kv`` weight
               (--ladder-cached-kv-weight; ISSUE 10, table recorded).
   wrr-alpha   the ROUND_ROBIN rung's smooth-WRR queue-shape exponent
               ``weight = (1+queue)^-alpha`` (--ladder-wrr-alpha;
-              ISSUE 11 — alpha 0 is uniform rotation, ignoring the
-              last-known-good rows the blackout froze; larger alphas
-              trust the stale queue column harder).
+              ISSUE 11).
+
+Sweeps run under the gie-twin virtual clock by default (seconds of wall
+clock per candidate; --real-time restores the historical mode — the
+recorded PR 10/11 tables were measured in real time).
 
     JAX_PLATFORMS=cpu python hack/storm_sweep.py --sweep wrr-alpha
 """
@@ -27,33 +29,6 @@ import os
 import sys
 
 
-def _run_rung_storm(*, seed: int, duration_s: float, ladder_kw: dict,
-                    rung: int, name: str) -> dict:
-    from gie_tpu.resilience.ladder import LadderConfig
-    from gie_tpu.storm import shapes as S
-    from gie_tpu.storm.engine import EngineConfig, PoolSpec, StormEngine
-
-    tc = S.TrafficConfig(base_qps=36.0, duration_s=duration_s,
-                         n_sessions=16, decode_tokens_mean=20.0)
-    prog = S.Program(tc, [
-        S.FlashCrowd(at_s=1.5, ramp_s=0.8, hold_s=3.0, magnitude=3.0),
-    ], seed=seed)
-    # Prohibitive recovery thresholds + force_level pin the rung so the
-    # sweep measures the rung's policy, not the ladder dynamics.
-    ladder = LadderConfig(
-        dispatch_error_streak=10_000, recover_streak=10_000,
-        min_dwell_s=1e9, probe_interval_s=1e9,
-        serve_min_samples=10_000, **ladder_kw)
-    eng = StormEngine(
-        prog, pool=PoolSpec(n_pods=6),
-        cfg=EngineConfig(ttft_slo_s=2.5, ladder=ladder, force_rung=rung),
-        name=name)
-    try:
-        return eng.run().scorecard
-    finally:
-        eng.close()
-
-
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--sweep", default="cached-kv",
@@ -63,6 +38,9 @@ def main() -> int:
                              "(defaults per sweep)")
     parser.add_argument("--seed", type=int, default=626262)
     parser.add_argument("--duration-s", type=float, default=8.0)
+    parser.add_argument("--real-time", action="store_true",
+                        help="run on the real clock (the historical "
+                             "sweep mode) instead of the virtual clock")
     parser.add_argument("--out", default=None,
                         help="optional JSON artifact path")
     args = parser.parse_args()
@@ -72,7 +50,10 @@ def main() -> int:
     jax.config.update(
         "jax_platforms", os.environ.get("GIE_STORM_PLATFORM", "cpu"))
 
-    from gie_tpu.resilience.ladder import Rung
+    from gie_tpu.resilience.ladder import LadderConfig, Rung
+    from gie_tpu.resilience.scenarios import Scenario
+    from gie_tpu.storm import search
+    from gie_tpu.storm.engine import EngineConfig
 
     if args.sweep == "cached-kv":
         values = args.values or "0,2,4,8,16,32"
@@ -82,30 +63,67 @@ def main() -> int:
         values = args.values or "0,0.5,1,2,4"
         knob, rung = "wrr_queue_alpha", int(Rung.ROUND_ROBIN)
         scenario = "flash-crowd x3 @36qps, 6 pods, forced ROUND_ROBIN"
+    candidates = [float(x) for x in values.split(",")]
 
+    # The historical sweep storm as an in-memory scenario drive.
+    scn = Scenario(
+        name=f"ladder-{args.sweep}-sweep",
+        description=scenario,
+        seed=args.seed,
+        rules={},
+        drive={"storm": {
+            "base_qps": 36.0,
+            "duration_s": args.duration_s,
+            "ttft_slo_s": 2.5,
+            "traffic": {"n_sessions": 16, "decode_tokens_mean": 20.0},
+            "pool": {"n_pods": 6},
+            "shapes": [
+                {"kind": "flash_crowd", "at_s": 1.5, "ramp_s": 0.8,
+                 "hold_s": 3.0, "magnitude": 3.0},
+            ],
+        }})
+    # Prohibitive recovery thresholds + force_rung pin the rung so the
+    # sweep measures the rung's policy, not the ladder dynamics.
+    base_cfg = EngineConfig(
+        ttft_slo_s=2.5,
+        ladder=LadderConfig(
+            dispatch_error_streak=10_000, recover_streak=10_000,
+            min_dwell_s=1e9, probe_interval_s=1e9,
+            serve_min_samples=10_000),
+        force_rung=rung)
+
+    artifact_board = search.search(
+        scn,
+        configs=[{f"ladder.{knob}": v} for v in candidates],
+        seed=args.seed, rounds=1, base_duration_s=args.duration_s,
+        virtual=not args.real_time, cfg=base_cfg)
+
+    by_value = {row["config"][f"ladder.{knob}"]: row
+                for row in artifact_board["leaderboard"]}
     rows = []
-    for v in [float(x) for x in values.split(",")]:
-        card = _run_rung_storm(
-            seed=args.seed, duration_s=args.duration_s,
-            ladder_kw={knob: v}, rung=rung,
-            name=f"{args.sweep}-{v:g}")
+    for v in candidates:
+        row_src = by_value[v]
         row = {
             knob: v,
-            "goodput_tokens_per_s": round(card["goodput_tokens_per_s"], 1),
-            "slo_attainment": round(card["slo_attainment"], 3),
-            "ttft_p50_s": round(card["ttft_p50_s"], 3),
-            "ttft_p99_s": round(card["ttft_p99_s"], 3),
-            "completed": card["completed"],
-            "shed": card["shed"],
-            "client_5xx": card["client_5xx"],
+            "goodput_tokens_per_s": round(
+                row_src["goodput_tokens_per_s"], 1),
+            "slo_attainment": round(row_src["slo_attainment"], 3),
+            "ttft_p50_s": round(row_src["ttft_p50_s"], 3),
+            "ttft_p99_s": round(row_src["ttft_p99_s"], 3),
+            "completed": row_src["completed"],
+            "shed": row_src["shed"],
+            "client_5xx": row_src["client_5xx"],
+            "rank": row_src["rank"],
         }
         rows.append(row)
         print(f"{knob}={v:5g}  goodput={row['goodput_tokens_per_s']:8.1f}"
               f" tok/s  slo={row['slo_attainment']:.3f}"
               f"  p99={row['ttft_p99_s']:.3f}s"
-              f"  completed={row['completed']}", file=sys.stderr)
+              f"  completed={row['completed']}  rank#{row['rank']}",
+              file=sys.stderr)
     artifact = {"sweep": f"ladder-{args.sweep}", "seed": args.seed,
-                "scenario": scenario, "rows": rows}
+                "scenario": scenario,
+                "virtual_time": not args.real_time, "rows": rows}
     if args.out:
         with open(args.out, "w", encoding="utf-8") as fh:
             json.dump(artifact, fh, indent=1)

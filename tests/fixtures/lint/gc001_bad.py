@@ -1,0 +1,27 @@
+"""Golden violation: direct clock calls in a clock-governed module
+(GC001) — a monotonic read in a dwell check, a wall-clock read in a
+window prune, a sleep in a retry loop, and a module-level clock pin.
+Each must route through the Clock seam (gie_tpu/runtime/clock.py)."""
+
+import time
+
+STARTED_AT = time.monotonic()          # GC001: module-level clock pin
+
+
+class Breaker:
+    def __init__(self):
+        self.opened_at = 0.0
+
+    def allow(self):
+        return time.monotonic() - self.opened_at > 2.0   # GC001
+
+    def window_floor(self):
+        return time.time() - 10.0                        # GC001
+
+    def retry(self, fn):
+        for _ in range(3):
+            try:
+                return fn()
+            except OSError:
+                time.sleep(0.1)                          # GC001
+        return None

@@ -1,0 +1,29 @@
+"""Negative: clock-governed code routed through the Clock seam — an
+injected clock callable, a now= parameter, and a clock= DEFAULT-ARG
+REFERENCE (a reference pins nothing; only a call does). GC001 must stay
+silent."""
+
+import time
+
+
+def _default_now():
+    return 0.0
+
+
+class Breaker:
+    def __init__(self, clock=_default_now):
+        self.clock = clock
+        self.opened_at = 0.0
+
+    def allow(self, now=None):
+        now = self.clock() if now is None else now
+        return now - self.opened_at > 2.0
+
+    def window_floor(self, now):
+        return now - 10.0
+
+
+def make_breaker(clock=time.monotonic):
+    # The reference is allowed: the caller's clock (virtual or real)
+    # decides the timeline, not this module.
+    return Breaker(clock=clock)

@@ -1,0 +1,220 @@
+"""Clock-seam unit tests (gie_tpu/runtime/clock.py; gie-twin,
+docs/STORM.md "virtual clock").
+
+The monotonic clock is a passthrough (pinned so the seam can never
+drift from the stdlib semantics production runs on); the virtual clock
+is a deterministic discrete-event core — time advances only when every
+registered actor is parked, exactly one entry fires per advance, wakes
+are serialized run-to-completion, and notifications never outrun the
+advance rule."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from gie_tpu.runtime.clock import MONOTONIC, MonotonicClock, VirtualClock
+
+
+# --------------------------------------------------------------------------
+# MonotonicClock: passthrough semantics
+# --------------------------------------------------------------------------
+
+
+def test_monotonic_clock_is_a_passthrough():
+    clock = MonotonicClock()
+    a = clock.now()
+    assert abs(a - time.monotonic()) < 1.0
+    ev = threading.Event()
+    assert clock.wait_event(ev, 0.01) is False
+    clock.set_event(ev)
+    assert clock.wait_event(ev, 0.01) is True
+    cond = threading.Condition()
+    with cond:
+        assert clock.wait(cond, 0.01) is False
+    assert clock.actor_begin("x") is None  # registration is a no-op
+    clock.actor_end(None)
+    t = clock.actor_thread(lambda: None)
+    t.start()
+    t.join(1)
+    assert not t.is_alive()
+
+
+# --------------------------------------------------------------------------
+# VirtualClock: the advance rule
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def vclock():
+    clock = VirtualClock()
+    yield clock
+    clock.shutdown()
+
+
+def test_virtual_sleep_advances_instantly_for_a_lone_actor(vclock):
+    tok = vclock.actor_begin("solo")
+    try:
+        t0 = vclock.now()
+        wall0 = time.monotonic()
+        vclock.sleep(3600.0)  # an hour of virtual time...
+        assert vclock.now() == pytest.approx(t0 + 3600.0)
+        assert time.monotonic() - wall0 < 5.0  # ...in real milliseconds
+    finally:
+        vclock.actor_end(tok)
+
+
+def test_virtual_time_waits_for_every_actor_to_park(vclock):
+    """Two actors: the clock must not advance past the earlier deadline
+    while the other actor is still active."""
+    order: list = []
+
+    def worker():
+        vclock.sleep(10.0)
+        order.append(("worker", vclock.now()))
+
+    t = vclock.actor_thread(worker)
+    tok = vclock.actor_begin("main")
+    try:
+        t.start()
+        vclock.sleep(5.0)
+        order.append(("main", vclock.now()))
+        vclock.sleep(10.0)  # to 15.0: lets the worker's 10.0 fire first
+    finally:
+        vclock.actor_end(tok)
+    t.join(5)
+    assert order == [("main", 5.0), ("worker", 10.0)]
+    assert vclock.now() == pytest.approx(15.0)
+
+
+def test_virtual_same_deadline_fires_in_registration_order(vclock):
+    hits: list = []
+
+    def sleeper(name):
+        vclock.sleep(1.0)
+        hits.append(name)
+
+    tok = vclock.actor_begin("main")
+    threads = []
+    try:
+        for i in range(4):
+            # Create-and-start per iteration: actor_thread registers at
+            # CREATION (the clock must not advance past work the spawner
+            # just scheduled), so pre-building the whole list would
+            # count actors that never get to park.
+            t = vclock.actor_thread(sleeper, args=(i,))
+            threads.append(t)
+            t.start()
+            vclock.sleep(0.0)  # serialize: each sleeper parks in turn
+        vclock.sleep(2.0)
+    finally:
+        vclock.actor_end(tok)
+    for t in threads:
+        t.join(5)
+    assert hits == [0, 1, 2, 3]
+
+
+def test_virtual_wait_event_times_out_and_wakes_on_set(vclock):
+    ev = threading.Event()
+    results: list = []
+
+    def waiter():
+        results.append(("timeout", vclock.wait_event(ev, 2.0),
+                        vclock.now()))
+        results.append(("set", vclock.wait_event(ev, 50.0), vclock.now()))
+
+    t = vclock.actor_thread(waiter)
+    tok = vclock.actor_begin("main")
+    try:
+        t.start()
+        vclock.sleep(3.0)          # waiter's 2.0 timeout fires first
+        vclock.set_event(ev)       # then the flag, long before 53.0
+        vclock.sleep(0.1)
+    finally:
+        vclock.actor_end(tok)
+    t.join(5)
+    assert results[0] == ("timeout", False, 2.0)
+    assert results[1][1] is True
+    assert results[1][2] < 4.0  # woke on set_event, not the 50 s timeout
+
+
+def test_virtual_condition_wait_notify_and_timeout(vclock):
+    cond = threading.Condition()
+    got: list = []
+
+    def waiter():
+        with cond:
+            got.append(("first", vclock.wait(cond, 30.0), vclock.now()))
+        with cond:
+            got.append(("second", vclock.wait(cond, 1.5), vclock.now()))
+
+    t = vclock.actor_thread(waiter)
+    tok = vclock.actor_begin("main")
+    try:
+        t.start()
+        vclock.sleep(1.0)
+        with cond:
+            vclock.notify_all(cond)  # wakes the first wait at t=1.0
+        vclock.sleep(5.0)            # second wait times out at ~2.5
+    finally:
+        vclock.actor_end(tok)
+    t.join(5)
+    assert got[0] == ("first", True, 1.0)
+    assert got[1][0] == "second" and got[1][1] is False
+    assert got[1][2] == pytest.approx(2.5)
+
+
+def test_virtual_ephemeral_unregistered_thread_can_park(vclock):
+    """A thread that never registered (warmup helpers, teardown) may
+    still sleep: it is counted as an actor only for the park."""
+    wall0 = time.monotonic()
+    vclock.sleep(100.0)
+    assert vclock.now() == pytest.approx(100.0)
+    assert time.monotonic() - wall0 < 5.0
+
+
+def test_virtual_serialized_wakes_run_to_completion(vclock):
+    """Entries readied at the same instant fire one at a time, and a
+    woken actor runs to its NEXT PARK before any other entry fires —
+    the serialization the storm's decision determinism is built on.
+    Each waiter's wake/work records must therefore be adjacent: another
+    actor's wake interleaving between them would mean two woken actors
+    ran concurrently."""
+    events: list = []
+    ev = threading.Event()
+
+    def waiter(i):
+        vclock.wait_event(ev, 60.0)
+        events.append(("wake", i))
+        events.append(("work", i))  # no park between: one atomic run
+
+    tok = vclock.actor_begin("main")
+    threads = []
+    try:
+        for i in range(6):
+            t = vclock.actor_thread(waiter, args=(i,))
+            threads.append(t)
+            t.start()
+            vclock.sleep(0.0)
+        vclock.set_event(ev)  # readies all six at the current instant
+        vclock.sleep(1.0)
+    finally:
+        vclock.actor_end(tok)
+    for t in threads:
+        t.join(5)
+    assert len(events) == 12
+    pairs = [events[j:j + 2] for j in range(0, 12, 2)]
+    for wake, work in pairs:
+        assert wake[0] == "wake" and work[0] == "work"
+        assert wake[1] == work[1], (
+            f"interleaved wakes: {events} — woken actors must run to "
+            "completion one at a time")
+    # Readied-at-the-same-instant entries fire in registration order.
+    assert [w[1] for w, _ in pairs] == [0, 1, 2, 3, 4, 5]
+
+
+def test_default_monotonic_singleton_is_not_virtual():
+    assert MONOTONIC.is_virtual is False
+    assert VirtualClock.is_virtual is True

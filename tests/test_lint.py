@@ -81,6 +81,14 @@ def run_fixture(stem: str, rule: str) -> list[Violation]:
             "socket.socket.recv() inside a daemon loop",
             "gr001_bad.Loop._lock.acquire() inside a daemon loop",
         ]),
+        ("gc001_bad", "GC001", [
+            "time.monotonic() in a clock-governed module",
+            "time.time() in a clock-governed module",
+            "time.sleep() in a clock-governed module",
+            # The module-level clock pin (import-time calls never enter
+            # a FunctionInfo; the rule walks the module body too).
+            "[<module>] direct time.monotonic()",
+        ]),
     ],
 )
 def test_rule_fires_on_golden_fixture(stem, rule, expected_substrings):
@@ -107,6 +115,7 @@ def test_gt001_counts_every_import_time_shape():
         ("gt002_ok", "GT002"),
         ("ga001_ok", "GA001"),
         ("gr001_ok", "GR001"),
+        ("gc001_ok", "GC001"),
     ],
 )
 def test_rule_silent_on_negative_fixture(stem, rule):

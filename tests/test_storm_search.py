@@ -1,0 +1,167 @@
+"""Policy-search harness tests (gie_tpu/storm/search.py; gie-twin,
+docs/STORM.md "policy search").
+
+Fast tier: the grid/assignment/schema machinery. Slow tier (run by
+``make storm-search-smoke``): the bounded 8-config smoke search over
+storm-search-smoke, asserting the leaderboard validates and the
+hand-swept ladder calibration (cached_kv_weight=8, wrr_alpha=1 —
+docs/RESILIENCE.md) re-derives into the top half."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from gie_tpu.storm import search
+
+
+# --------------------------------------------------------------------------
+# Grid + assignment machinery
+# --------------------------------------------------------------------------
+
+
+def test_expand_grid_is_a_full_product_in_order():
+    grid = search.expand_grid({
+        "ladder.cached_kv_weight": [0.0, 8.0],
+        "breaker.open_after": [2, 4, 8],
+    })
+    assert len(grid) == 6
+    assert grid[0] == {"ladder.cached_kv_weight": 0.0,
+                      "breaker.open_after": 2}
+    assert grid[-1] == {"ladder.cached_kv_weight": 8.0,
+                       "breaker.open_after": 8}
+    with pytest.raises(ValueError, match="empty search space"):
+        search.expand_grid({})
+    with pytest.raises(ValueError, match="non-empty value list"):
+        search.expand_grid({"ladder.cached_kv_weight": []})
+    with pytest.raises(ValueError, match="group"):
+        search.expand_grid({"nope.x": [1]})
+    with pytest.raises(ValueError, match="group"):
+        search.expand_grid({"cached_kv_weight": [1]})
+
+
+def test_apply_assignment_builds_the_engine_config():
+    from gie_tpu.storm.engine import DEFAULT_BREAKER, EngineConfig
+
+    cfg = search.apply_assignment(None, {
+        "ladder.cached_kv_weight": 2.0,
+        "ladder.wrr_queue_alpha": 4.0,
+        "breaker.open_after": 7,
+        "outlier.ratio": 2.5,
+        "autoscale.shed_high_per_s": 3.0,
+        "engine.queue_limit": 5.0,
+    })
+    assert isinstance(cfg, EngineConfig)
+    assert cfg.ladder.cached_kv_weight == 2.0
+    assert cfg.ladder.wrr_queue_alpha == 4.0
+    assert cfg.breaker.open_after == 7
+    # Unset breaker fields inherit the engine default, not the library
+    # default (the search must perturb the config a storm actually runs).
+    assert cfg.breaker.open_s == DEFAULT_BREAKER.open_s
+    assert cfg.outlier is not None and cfg.outlier.ratio == 2.5
+    assert cfg.autoscale_shed_high_per_s == 3.0
+    assert cfg.queue_limit == 5.0
+
+
+def test_apply_assignment_rejects_unknown_knobs_loudly():
+    with pytest.raises(ValueError, match="ladder"):
+        search.apply_assignment(None, {"ladder.not_a_field": 1})
+    with pytest.raises(ValueError, match="not searchable"):
+        search.apply_assignment(None, {"engine.serve_timeout_s": 1})
+    with pytest.raises(ValueError, match="group"):
+        search.apply_assignment(None, {"flat": 1})
+
+
+def test_score_key_orders_goodput_then_slo_then_p99():
+    a = {"goodput_tokens_per_s": 100.0, "slo_attainment": 0.9,
+         "ttft_p99_s": 1.0}
+    b = {"goodput_tokens_per_s": 90.0, "slo_attainment": 1.0,
+         "ttft_p99_s": 0.5}
+    c = {"goodput_tokens_per_s": 100.0, "slo_attainment": 0.9,
+         "ttft_p99_s": 2.0}
+    d = {"goodput_tokens_per_s": 100.0, "slo_attainment": 0.9,
+         "ttft_p99_s": None}  # no completions: worst of the ties
+    ranked = sorted([a, b, c, d], key=search._score_key, reverse=True)
+    assert ranked == [a, c, d, b]
+
+
+def test_validate_rejects_malformed_leaderboards():
+    with pytest.raises(ValueError, match="schema"):
+        search.validate({"schema": "nope"})
+    with pytest.raises(ValueError, match="leaderboard"):
+        search.validate({"schema": search.SCHEMA, "leaderboard": []})
+    row = {f: 0 for f in search.REQUIRED_ROW_FIELDS}
+    row["rank"] = 1
+    with pytest.raises(ValueError, match="ranks"):
+        search.validate({
+            "schema": search.SCHEMA, "rounds": [{}],
+            "leaderboard": [dict(row), dict(row)]})  # ranks 1,1 not 1,2
+    bad = dict(row)
+    del bad["goodput_tokens_per_s"]
+    with pytest.raises(ValueError, match="missing fields"):
+        search.validate({
+            "schema": search.SCHEMA, "rounds": [{}], "leaderboard": [bad]})
+
+
+def test_search_arg_validation():
+    with pytest.raises(ValueError, match="exactly one"):
+        search.search("storm-search-smoke")
+    with pytest.raises(ValueError, match="exactly one"):
+        search.search("storm-search-smoke", space={"ladder.x": [1]},
+                      configs=[{}])
+    with pytest.raises(ValueError, match="rounds"):
+        search.search("storm-search-smoke",
+                      space={"ladder.cached_kv_weight": [1.0]}, rounds=0)
+    with pytest.raises(ValueError, match="drive.storm"):
+        search.search("mixed-soak", space={"ladder.cached_kv_weight": [1.0]})
+
+
+def test_smoke_scenario_ships_and_compiles():
+    from gie_tpu.resilience import scenarios
+    from gie_tpu.storm import shapes as S
+
+    scn = scenarios.load(search.SMOKE_SCENARIO)
+    assert scn.rules, "the smoke storm needs its rung-forcing chaos"
+    prog = S.program_from_drive(scn.drive["storm"], seed=scn.seed)
+    a, b = prog.compile(), prog.compile()
+    assert a.fingerprint() == b.fingerprint()
+    assert len(search.expand_grid(search.SMOKE_SPACE)) == 8
+
+
+# --------------------------------------------------------------------------
+# The smoke search itself (make storm-search-smoke; slow tier)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_storm_search_smoke_rederives_ladder_calibration(tmp_path, capsys):
+    """The bounded 8-config grid + successive-halving search over the
+    flash-crowd smoke storm, driven through the CLI entry point
+    (python -m gie_tpu.storm.search): the leaderboard JSON validates,
+    ranks are a clean 1..8, per-round history shows the halving, and
+    the hand-swept ladder calibration (cached_kv_weight=8, wrr_alpha=1)
+    lands in the top half — the harness re-derives what PR 10/11 swept
+    by hand."""
+    out = tmp_path / "leaderboard.json"
+    rc = search.main(["--out", str(out)])
+    assert rc == 0
+    # The CLI prints the artifact JSON on stdout AND writes --out.
+    printed = json.loads(capsys.readouterr().out)
+    artifact = json.loads(out.read_text(encoding="utf-8"))
+    assert printed["leaderboard"] == artifact["leaderboard"]
+    search.validate(artifact)
+    assert artifact["n_configs"] == 8
+    assert artifact["virtual_time"] is True
+    assert len(artifact["rounds"]) == 2
+    # Successive halving: round 1 evaluated half the grid, twice as long.
+    assert artifact["rounds"][0]["evaluated"] == 8
+    assert artifact["rounds"][1]["evaluated"] == 4
+    assert (artifact["rounds"][1]["duration_s"]
+            == 2 * artifact["rounds"][0]["duration_s"])
+    rank = search.rank_of(artifact, search.SMOKE_KNOWN_GOOD)
+    assert rank is not None, "the known-good config fell off the board"
+    assert rank <= len(artifact["leaderboard"]) // 2, (
+        f"known-good ladder defaults ranked {rank} — the search "
+        f"contradicts the hand-swept calibration: "
+        f"{artifact['leaderboard']}")
