@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke storm-search-smoke test test-unit test-conformance bench bench-goodput bench-scrape bench-extproc bench-cpu cost release clean
+.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke storm-search-smoke test test-unit test-conformance bench bench-mesh bench-goodput bench-scrape bench-extproc bench-cpu cost release clean
 
 all: native generate
 
@@ -109,6 +109,16 @@ test-conformance:
 bench:
 	$(PY) bench.py
 
+# gie-mesh scaling sweep (docs/MESH.md): pick latency of the dp x tp
+# sharded scheduling cycle per (mesh size x endpoint width x picker),
+# each against the same-run single-device baseline; every record stamps
+# the BENCH_r02 real-TPU single-device point for cross-capture context.
+# On a box with no reachable TPU the records are cpu-fallback tagged
+# (virtual host-device mesh — trajectory markers, not scaling numbers;
+# the scaling PROPERTY lives in tests/test_distributed_equivalence.py).
+bench-mesh:
+	$(PY) bench.py --mesh-sizes 1,2,4,8 --mesh-m 1024,4096,8192
+
 # XLA cost analysis of the compiled cycle (the HBM-traffic perf model
 # behind the <=50us pick budget; gated in tests/test_cost_budget.py).
 cost:
@@ -138,6 +148,7 @@ bench-extproc: native
 bench-cpu: native
 	JAX_PLATFORMS=cpu GIE_BENCH_BACKEND=cpu-fallback $(PY) bench_extproc.py
 	JAX_PLATFORMS=cpu GIE_GOODPUT_PLATFORM=cpu $(PY) bench_goodput.py
+	JAX_PLATFORMS=cpu GIE_BENCH_PLATFORM=cpu $(PY) bench.py --mesh-sizes 1,2,4,8 --mesh-m 1024,4096,8192
 
 # Versioned release artifacts (CRDs, tuned profile, conformance report).
 release:

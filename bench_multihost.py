@@ -146,11 +146,11 @@ def main() -> None:
             )
         else:
             # The exact production recipe the --mesh-devices flag runs
-            # (same helper, same donation) — the bench must measure the
-            # program it claims to validate.
+            # (same helper, same default dp x tp split, same donation) —
+            # the bench must measure the program it claims to validate.
             from gie_tpu.parallel.mesh import sharded_cycle
 
-            fn = sharded_cycle(make_mesh(width, tp=1), cfg, None,
+            fn = sharded_cycle(make_mesh(width), cfg, None,
                                donate_state=True)
         state = SchedState.init()
         result, state = fn(state, reqs, eps, weights, key, None)

@@ -1,4 +1,4 @@
-"""JAX trace-safety analyzer (rules GT001-GT003).
+"""JAX trace-safety analyzer (rules GT001-GT004).
 
 GT001  import-time device constant: a jnp array constructor or jax
        device query executes at module import (module body, class body,
@@ -23,6 +23,16 @@ GT003  explicit host sync in production code: ``.block_until_ready()``
        / ``jax.block_until_ready`` belong in benches and tests; inside
        ``gie_tpu/`` they serialize the dispatch pipeline the scheduler
        exists to keep full. Allowlist via ``[tracesafe] allow_files``.
+
+GT004  host sync in the mesh/sharding layer: inside ``gie_tpu.parallel``
+       no function may call ``jax.device_get`` / ``block_until_ready`` /
+       ``.item()`` / ``.tolist()``. The sharded cycle is an async
+       dispatch end to end (docs/MESH.md): a D2H sync here stalls EVERY
+       chip of the mesh at pick cadence — the whole-mesh sibling of the
+       D2H-under-lock class GL002 polices on the host facade. (Host
+       bookkeeping like ``numpy.asarray(jax.devices())`` at mesh
+       construction touches no device buffers and stays legal; numpy
+       pulls on traced values are GT002's jurisdiction.)
 """
 
 from __future__ import annotations
@@ -285,4 +295,33 @@ def run(index: RepoIndex, cfg: dict) -> list[Violation]:
                     "the dispatch pipeline — it belongs in bench/test "
                     "paths (allowlist in lockorder.toml [tracesafe] if "
                     "intentional)"))
+
+    # GT004 — host syncs in the mesh/sharding layer (gie_tpu.parallel).
+    # Deliberately NOT gated on the jit chain or the lock set: the whole
+    # package is device-layout code on the pick cadence, and a sync
+    # anywhere in it stalls every chip of the mesh (docs/MESH.md).
+    gt4_modules = tuple(
+        tcfg.get("parallel_modules", ["gie_tpu.parallel"]))
+    for fi in index.all_functions():
+        mod = fi.module.modname
+        if not any(mod == m or mod.startswith(m + ".")
+                   for m in gt4_modules):
+            continue
+        for cs in fi.calls.values():
+            msg = None
+            if (cs.ext == "jax.device_get"
+                    or (cs.ext or "").endswith(".device_get")):
+                msg = "jax.device_get in the sharded-cycle layer"
+            elif (cs.ext == "jax.block_until_ready"
+                    or (cs.ext or "").endswith(".block_until_ready")
+                    or cs.method == "block_until_ready"):
+                msg = "block_until_ready in the sharded-cycle layer"
+            elif cs.method in ("item", "tolist"):
+                msg = f".{cs.method}() in the sharded-cycle layer"
+            if msg is not None:
+                out.append(Violation(
+                    "GT004", fi.module.file, cs.node.lineno, fi.qualname,
+                    msg + " — a D2H sync here stalls every chip in the "
+                    "mesh at pick cadence; materialize on the host "
+                    "facade (Scheduler/PendingWave) instead"))
     return out

@@ -1,22 +1,35 @@
 """Device mesh + shardings for multi-chip operation.
 
-Parallelism map (SURVEY.md section 2.10 — the reference is a router, not a
-trainer; the honest multi-chip axes here are):
+Parallelism map (docs/MESH.md; SURVEY.md section 2.10 — the reference is a
+router, not a trainer; the honest multi-chip axes here are):
 
-  dp — the request axis of the scheduling cycle: N pending requests scored
-       against all endpoints, sharded over chips; XLA inserts the all-gather
-       of picks and the reduction of the (replicated) state updates over
-       ICI. This is the "pjit over the request x endpoint score matrix"
-       sharding BASELINE.json's north star names.
-  tp — the hidden dimension of the latency-predictor MLP: Dense kernels
-       split column-/row-wise so its matmuls ride the MXU of every chip
-       (classic 2-layer tensor parallelism; XLA adds the psum).
+  dp — the request axis of the scheduling cycle: N pending requests
+       sharded over chips. Every [N, ...] tensor (request batch, masks,
+       scorer columns, the cost matrix rows, pick results) splits here.
+  tp — the ENDPOINT axis: M endpoint slots sharded over chips. Every
+       [M, ...] tensor (endpoint metrics, LoRA tables, assumed load, the
+       sinkhorn column duals, the cost matrix columns, the packed
+       prefix-presence words when divisible) splits here, so per-chip
+       memory for the [N, M] score/cost tensors is O(N*M / (dp*tp)) and
+       the M axis scales with chips instead of replicating onto each.
+       The latency-predictor MLP's Dense kernels also split on tp
+       (classic 2-layer tensor parallelism) in the training step.
 
 Pipeline/sequence/expert parallelism have no analogue in this system: there
 is no layer stack deep enough to pipeline, no sequence dimension on device
 (prompts reduce to chunk-hash vectors host-side), and no experts. The design
 keeps the mesh 2-D ("dp", "tp") so a deployment scales either axis by
 reshaping the same program.
+
+Where GSPMD's choices are load-bearing — the sinkhorn solve's coupled
+row/column reductions — the cycle drops into an explicit shard_map with
+ordered grouped all-reduces (sched/sinkhorn.py); everything else (masked
+elementwise scoring, max/argmax top-k, the blend einsum over the replicated
+scorer axis) is layout-exact under GSPMD by construction. The random bits
+feeding the samplers are made sharding-invariant by jax_threefry_partitionable
+(enabled at gie_tpu import), so sharded picks are BIT-IDENTICAL to
+single-device picks: tests/test_distributed_equivalence pins it per mesh
+size x picker x ragged-M.
 """
 
 from __future__ import annotations
@@ -29,8 +42,16 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gie_tpu.sched import constants as C
 from gie_tpu.sched.profile import scheduling_cycle
-from gie_tpu.sched.types import EndpointBatch, RequestBatch, SchedState, Weights
+from gie_tpu.sched.types import (
+    EndpointBatch,
+    PickResult,
+    PrefixTable,
+    RequestBatch,
+    SchedState,
+    Weights,
+)
 
 
 def make_mesh(n_devices: Optional[int] = None, tp: Optional[int] = None) -> Mesh:
@@ -51,35 +72,89 @@ def make_mesh(n_devices: Optional[int] = None, tp: Optional[int] = None) -> Mesh
     return Mesh(grid, ("dp", "tp"))
 
 
+def state_shardings(mesh: Mesh):
+    """NamedShardings for the SchedState pytree under `mesh`: the
+    endpoint-axis vectors (assumed load, sinkhorn column duals) tp-shard —
+    the duals' explicit sharding is what lets the warm start flow through
+    sharded_cycle wave to wave without an implicit replicate/reshard pair
+    around every cycle — and the packed prefix-presence words tp-shard
+    when every M bucket's word count divides tp (tp <= 2: the smallest
+    bucket packs M_BUCKETS[0]/32 words and one jitted cycle must accept
+    every bucket). Table keys/ages are M-independent and replicate; rr and
+    tick are scalars."""
+    repl = NamedSharding(mesh, P())
+    ep = NamedSharding(mesh, P("tp"))
+    tp = int(mesh.shape["tp"])
+    words_ok = (C.M_BUCKETS[0] // 32) % tp == 0
+    present = NamedSharding(mesh, P(None, "tp")) if words_ok else repl
+    return SchedState(
+        prefix=PrefixTable(keys=repl, present=present, ages=repl),
+        assumed_load=ep,
+        rr=repl,
+        tick=repl,
+        ot_v=ep,
+    )
+
+
 def cycle_shardings(mesh: Mesh):
     """in_shardings for profile.scheduling_cycle under `mesh`: requests
-    dp-sharded on their leading axis, endpoint tensors / scheduler state /
-    weights / key replicated. GSPMD turns the dp-sharded contributions to
-    the dense state scatters into ICI collectives."""
+    dp-sharded on their leading axis, endpoint tensors tp-sharded on the
+    M axis (the subset mask shards on both), scheduler state per
+    state_shardings, weights / rng key replicated. GSPMD turns the
+    cross-shard contributions (dense state scatters, top-k reductions)
+    into ICI collectives; the sinkhorn solve's float-sum collectives are
+    explicit in sched/sinkhorn.py."""
     repl = NamedSharding(mesh, P())
+    ep_leading = NamedSharding(mesh, P("tp"))
+    ep_matrix = NamedSharding(mesh, P("tp", None))
 
     def dp_leading(x):
         return NamedSharding(mesh, P("dp", *([None] * (np.ndim(x) - 1))))
 
+    req_tmpl = RequestBatch.empty(8)
+    req_sh = jax.tree.map(dp_leading, req_tmpl)
+    # The candidate-subset hint spans requests x endpoints: both axes cut.
+    req_sh = req_sh.replace(subset_mask=NamedSharding(mesh, P("dp", "tp")))
+
+    eps_sh = EndpointBatch(
+        metrics=ep_matrix,
+        valid=ep_leading,
+        lora_active=ep_matrix,
+        lora_waiting=ep_matrix,
+        role=ep_leading,
+    )
     return (
-        jax.tree.map(lambda _: repl, SchedState.init()),          # state
-        jax.tree.map(dp_leading, RequestBatch.empty(8)),          # requests
-        jax.tree.map(lambda _: repl, EndpointBatch.empty()),      # endpoints
-        jax.tree.map(lambda _: repl, Weights.default()),          # weights
-        repl,                                                     # rng key
+        state_shardings(mesh),                                # state
+        req_sh,                                               # requests
+        eps_sh,                                               # endpoints
+        jax.tree.map(lambda _: repl, Weights.default()),      # weights
+        repl,                                                 # rng key
     )
 
 
 def sharded_cycle(mesh: Mesh, cfg, predictor_fn=None, donate_state: bool = False):
-    """Jit the scheduling cycle with dp-sharded requests over `mesh`.
-    Predictor params (the trailing argument) are replicated. The Scheduler
-    facade passes donate_state=True (its state buffers update in place);
-    equivalence tests keep the default so inputs stay readable."""
-    fn = functools.partial(scheduling_cycle, cfg=cfg, predictor_fn=predictor_fn)
+    """Jit the scheduling cycle dp x tp-sharded over `mesh`. Predictor
+    params (the trailing argument) are replicated. out_shardings are
+    pinned so the state round-trips in its input layout — donation can
+    alias the buffers (the Scheduler facade passes donate_state=True; its
+    state updates in place on device) and the warm-start duals never
+    bounce through a replicated intermediate between waves."""
+    fn = functools.partial(
+        scheduling_cycle, cfg=cfg, predictor_fn=predictor_fn, mesh=mesh)
     repl = NamedSharding(mesh, P())
+    dp1 = NamedSharding(mesh, P("dp"))
+    dp2 = NamedSharding(mesh, P("dp", None))
     in_sh = cycle_shardings(mesh) + (repl,)
+    result_sh = PickResult(
+        indices=dp2,
+        status=dp1,
+        scores=dp2,
+        prefill=dp1 if getattr(cfg, "pd_disaggregation", False) else None,
+    )
+    out_sh = (result_sh, state_shardings(mesh))
     donate = (0,) if donate_state else ()
-    return jax.jit(fn, in_shardings=in_sh, donate_argnums=donate)
+    return jax.jit(
+        fn, in_shardings=in_sh, out_shardings=out_sh, donate_argnums=donate)
 
 
 def predictor_param_shardings(mesh: Mesh, params):

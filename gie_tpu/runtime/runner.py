@@ -102,10 +102,16 @@ class ExtProcServerRunner:
             if opts.mesh_devices > 1:
                 from gie_tpu.parallel.mesh import make_mesh
 
-                # tp=1: the serving path replicates predictor params (the
-                # tp axis only pays in the training step), so every
-                # requested device goes to the dp request axis.
-                mesh = make_mesh(opts.mesh_devices, tp=1)
+                # The full dp x tp layout (docs/MESH.md): since PR 15 the
+                # serving path tp-shards the ENDPOINT axis too (metrics,
+                # cost-matrix columns, assumed load, sinkhorn duals), so
+                # per-chip memory is O(M/tp) and the tp axis pays at
+                # serve time, not just in the training step. make_mesh's
+                # default split (tp=2 when even) serves the production
+                # batching picker; picks are bit-identical to
+                # single-device at every layout
+                # (tests/test_distributed_equivalence).
+                mesh = make_mesh(opts.mesh_devices)
                 self.log.info("multi-chip scheduling mesh",
                               shape=dict(mesh.shape))
             self.scheduler = Scheduler(

@@ -4,7 +4,23 @@ See profile.scheduling_cycle for the full cycle and SURVEY.md section 7 for
 how this replaces the reference's per-request plugin chain.
 """
 
-from gie_tpu.sched.constants import (
+import jax as _jax
+
+# Sharding-invariant PRNG, process-wide (docs/MESH.md). The legacy
+# threefry lowering computes DIFFERENT bits when XLA partitions the
+# random-bits op — the sampler pickers' Gumbel noise was the dominant
+# term in the sinkhorn sharded-vs-single divergence (~60% of lanes).
+# The partitionable form is value-stable under every layout, which the
+# distributed-equivalence guarantee ("sharding is a layout choice,
+# never a semantics change") requires. Set here rather than in the
+# package root: every module that can draw random bits imports
+# gie_tpu.sched (models/storm/parallel/simulator all pull its
+# submodules), while host-only tools (lint CLI, fakeapi, controllers)
+# stay free of the jax import. A pure config update — no backend
+# initialization, no device constants.
+_jax.config.update("jax_threefry_partitionable", True)
+
+from gie_tpu.sched.constants import (  # noqa: E402
     FALLBACKS,
     M_BUCKETS,
     M_MAX,

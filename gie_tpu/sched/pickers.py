@@ -80,7 +80,20 @@ def _iota_tiebreak(masked: jax.Array, mask: jax.Array) -> jax.Array:
     better than silently truncating the fallback list. Rewriting low
     mantissa bits cannot touch the exponent, so finite scores stay
     finite. Ineligible lanes keep the exact NEG sentinel (the
-    ok-threshold compares against it)."""
+    ok-threshold compares against it).
+
+    Shard-layout invariance (ISSUE 15, docs/MESH.md): the tiebreak is
+    exactly as layout-stable as its inputs. The lane iota is GLOBAL —
+    under a tp-sharded M axis GSPMD hands each shard its own global
+    index block, so lane m gets the same field on every mesh shape —
+    and bitcast/mask/or are elementwise, so given bit-identical scores
+    (the grouped sinkhorn solve's contract) the nudged matrix is
+    bit-identical too. Downstream, _topk's max/argmax reductions are
+    EXACT (max has no rounding, and the field makes in-row values
+    pairwise distinct, so there is no tie for a cross-shard combine to
+    resolve arbitrarily) — the equivalence sweep
+    (tests/test_distributed_equivalence: mesh {1,2,4,8} x picker x
+    ragged-M) pins all of this bitwise."""
     m = masked.shape[-1]
     low = jnp.int32((1 << max((m - 1).bit_length(), 1)) - 1)
     lane = jnp.arange(m, dtype=jnp.int32)

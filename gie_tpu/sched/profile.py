@@ -228,6 +228,7 @@ def _pick_stage(
     state: SchedState,
     key: jax.Array,
     cfg: ProfileConfig,
+    mesh=None,
 ) -> tuple[PickResult, dict]:
     """The configured picker over one (total, mask) pair — shared by the
     classic single pick and the dual prefill/decode picks. The aux dict
@@ -258,6 +259,7 @@ def _pick_stage(
             rounding_temp=cfg.sinkhorn_rounding_temp,
             use_pallas=cfg.use_pallas_sinkhorn,
             v0=state.ot_v,
+            mesh=mesh,
         )
         return res, {"ot_v": v_out}
     return pickers.topk_picker(total, mask, shed, reqs.valid, state.rr), {}
@@ -273,8 +275,13 @@ def scheduling_cycle(
     *,
     cfg: ProfileConfig,
     predictor_fn: Optional[PredictorFn],
+    mesh=None,
 ) -> tuple[PickResult, SchedState]:
-    """One full scheduling cycle. Pure; jit-compiled per (N-bucket, cfg)."""
+    """One full scheduling cycle. Pure; jit-compiled per (N-bucket, cfg).
+
+    `mesh` (static, supplied by parallel.mesh.sharded_cycle) scopes the
+    sinkhorn solve's explicit collectives; None = single-device layout.
+    """
     mask, shed, named, stacked, wvec, total = build_stages(
         state, reqs, eps, weights,
         cfg=cfg, predictor_fn=predictor_fn, predictor_params=predictor_params,
@@ -284,12 +291,12 @@ def scheduling_cycle(
         return _pd_cycle(
             state, reqs, eps, key, cfg,
             mask=mask, shed=shed, named=named, stacked=stacked, wvec=wvec,
-            total=total,
+            total=total, mesh=mesh,
         )
 
     # ---- Pick stage ------------------------------------------------------
     result, pick_aux = _pick_stage(
-        total, stacked, wvec, mask, shed, reqs, eps, state, key, cfg)
+        total, stacked, wvec, mask, shed, reqs, eps, state, key, cfg, mesh)
 
     # ---- State update ----------------------------------------------------
     m = state.assumed_load.shape[0]
@@ -334,6 +341,7 @@ def _pd_cycle(
     stacked: jax.Array,
     wvec: jax.Array,
     total: jax.Array,
+    mesh=None,
 ) -> tuple[PickResult, SchedState]:
     """Dual pick for disaggregated serving: prefill endpoint (full blend
     over PREFILL/BOTH roles) then decode endpoint (locality columns
@@ -348,7 +356,8 @@ def _pd_cycle(
     # the carried sinkhorn dual (cross-contaminating one shared vector
     # with two different capacity patterns would poison both warm starts).
     p_res, _ = _pick_stage(
-        total, stacked, wvec, prefill_ok, shed, reqs, eps, state, key_p, cfg)
+        total, stacked, wvec, prefill_ok, shed, reqs, eps, state, key_p, cfg,
+        mesh)
     p_primary = p_res.indices[:, 0]
 
     keep = jnp.asarray(
@@ -402,7 +411,7 @@ def _pd_cycle(
     )
     d_res, _ = _pick_stage(
         d_total, stacked, d_wvec, decode_ok, shed, reqs, eps, state, key_d,
-        d_cfg)
+        d_cfg, mesh)
     d_primary = d_res.indices[:, 0]
 
     ok = (p_primary >= 0) & (d_primary >= 0)

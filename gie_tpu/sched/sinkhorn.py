@@ -19,18 +19,76 @@ colliding.
 Capacity model: each endpoint can absorb headroom proportional to its free
 queue + KV space this wave; capacities are scaled so sum(cap) >= N, keeping
 the problem feasible (best-effort overflow still lands somewhere).
+
+Mesh sharding (docs/MESH.md). The solve couples every request through the
+column duals (fleet-wide endpoint capacity pressure) and every endpoint
+through the row sums, so a dp(requests) x tp(endpoints) layout needs a
+cross-shard reduction per normalize sweep — and "sharding is a layout
+choice, never a semantics change" (tests/test_distributed_equivalence)
+demands those reductions be BIT-IDENTICAL to the single-device solve.
+Floating-point sums are not associative, so identical values require an
+identical reduction TREE, not just identical math: every coupled sum runs
+as fixed contiguous GROUP partials (8 groups — the max mesh axis, so each
+shard always owns whole groups) followed by an ordered left-to-right fold.
+Under `shard_map` the group partials are all-gathered across the mesh axis
+(the "global column-dual all-reduce per sweep" — psum would sum in
+unspecified ring order); on a single device the gather is the identity and
+the very same fold runs over the very same partials. Per-chip memory stays
+O(N*M / (dp*tp)): the kernel, plan, and duals never materialize unsharded.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 
 from gie_tpu.sched import constants as C
 from gie_tpu.sched.pickers import NEG, _finalize
 from gie_tpu.sched.types import EndpointBatch, PickResult
+
+# Canonical reduction-group count: the fixed tree shape shared by every
+# layout. 8 = the largest mesh axis this repo builds (make_mesh caps at
+# the device count; equivalence is pinned for dp, tp <= 8), and every
+# N/M bucket is a power of two, so min(8, axis) always divides the axis
+# and each shard of a <=8-way axis owns whole contiguous groups.
+GROUPS = 8
+
+
+def _group_count(axis_len: int) -> int:
+    for g in (GROUPS, 4, 2, 1):
+        if axis_len % g == 0:
+            return g
+    return 1
+
+
+def _fold_first(parts: jax.Array) -> jax.Array:
+    """Ordered left-to-right sum over the LEADING (group) axis. A python
+    loop on purpose: jnp.sum may tree-reduce in a shape-dependent order,
+    and this fold IS the cross-layout contract."""
+    acc = parts[0]
+    for i in range(1, parts.shape[0]):
+        acc = acc + parts[i]
+    return acc
+
+
+def _fold_last(parts: jax.Array) -> jax.Array:
+    acc = parts[..., 0]
+    for i in range(1, parts.shape[-1]):
+        acc = acc + parts[..., i]
+    return acc
+
+
+def _sum_m(x: jax.Array) -> jax.Array:
+    """Layout-invariant scalar sum of an endpoint-axis vector: fixed
+    group partials + ordered fold, so a tp-sharded [M] input reduces
+    bit-identically to a replicated one (each tp shard owns whole
+    groups; GSPMD computes the in-group sums locally and the fold order
+    is pinned by the unrolled adds)."""
+    g = _group_count(int(x.shape[0]))
+    return _fold_first(jnp.sum(x.reshape(g, -1), axis=1))
 
 
 def _headroom(eps: EndpointBatch, queue_limit: float) -> jax.Array:
@@ -56,8 +114,129 @@ def capacities(
     waves never bind them and the picker degenerates to argmax)."""
     headroom = jnp.where(
         eps.valid, _headroom(eps, queue_limit) + 1e-3, 0.0)
-    total = jnp.maximum(jnp.sum(headroom), 1e-6)
+    total = jnp.maximum(_sum_m(headroom), 1e-6)
     return headroom * (n_requests / total) * 1.25  # 25% slack for feasibility
+
+
+def _dual_solve(
+    k: jax.Array,        # f32[n_loc, m_loc] kernel block (full on 1 device)
+    cap: jax.Array,      # f32[m_loc]
+    v_init: jax.Array,   # f32[m_loc] warm-started column duals
+    *,
+    iters: int,
+    gn: int,             # LOCAL request-axis group count (total // dp)
+    gm: int,             # LOCAL endpoint-axis group count (total // tp)
+    gather_n: Callable[[jax.Array], jax.Array],
+    gather_m: Callable[[jax.Array], jax.Array],
+) -> tuple[jax.Array, jax.Array]:
+    """DUAL-FORM iterations: the iterates of row-normalize-then-column-cap
+    compose into p_t = diag(u_t) K diag(v_t), so the loop only needs two
+    matvecs per iteration (K @ v and u @ K) and carries two VECTORS — the
+    full [N, M] plan is materialized exactly once at the end (the
+    matrix-form scan carried the 1 MiB plan every iteration: ~2.5x the
+    HBM traffic at 8 iterations, hack/cost_analysis.py).
+
+    Both coupled reductions run grouped (see module docstring): the
+    column load's request-axis sum is the capacity-pressure all-reduce —
+    gather_n hands every shard ALL group partials so each sweep caps
+    against fleet-wide load, not the shard's own slice — and the row
+    sum's endpoint-axis fold keeps tp shards on the single-device
+    ordering. gather_n/gather_m are the identity on one device.
+    """
+    n_loc, m_loc = k.shape
+    kg = k.reshape(gn, n_loc // gn, gm, m_loc // gm)
+
+    def row_sums(mat_g: jax.Array, v: jax.Array) -> jax.Array:
+        # sum_m mat[n, m] * v[m] -> [n_loc]; per-(row, m-group) partials,
+        # gathered over tp, folded in group order.
+        parts = jnp.einsum(
+            "anbm,bm->anb", mat_g, v.reshape(gm, m_loc // gm))
+        return _fold_last(gather_m(parts)).reshape(n_loc)
+
+    def col_sums(u: jax.Array) -> jax.Array:
+        # sum_n u[n] * k[n, m] -> [m_loc]; per-(n-group, col) partials,
+        # gathered over dp (the global column-dual all-reduce), folded.
+        parts = jnp.einsum("an,anbm->abm", u.reshape(gn, n_loc // gn), kg)
+        return _fold_first(gather_n(parts)).reshape(m_loc)
+
+    def body(carry, _):
+        u, v = carry
+        # Row normalize: each request's mass is u_n * (K @ v)_n = 1.
+        r = row_sums(kg, v)
+        u = jnp.where(r > 0, 1.0 / r, u)
+        # Column cap: load on endpoint m is v_m * (u @ K)_m.
+        col = v * col_sums(u)
+        v = v * jnp.where(
+            col > cap, cap / jnp.maximum(col, 1e-9), 1.0)
+        return (u, v), None
+
+    (u, v), _ = jax.lax.scan(
+        body,
+        (jnp.ones((n_loc,), jnp.float32), v_init),
+        None, length=iters,
+    )
+    plan = k * u[:, None] * v[None, :]
+    # Final row normalization so the plan is a proper per-request
+    # distribution even where capacity clipped it (grouped like every
+    # other M-axis sum — it feeds the rounded scores directly).
+    plan_g = plan.reshape(gn, n_loc // gn, gm, m_loc // gm)
+    row = _fold_last(gather_m(jnp.sum(plan_g, axis=3))).reshape(n_loc)
+    plan = jnp.where(row[:, None] > 0, plan / row[:, None], plan)
+    return plan, v
+
+
+def _identity(x: jax.Array) -> jax.Array:
+    return x
+
+
+def _solve_plan(
+    k: jax.Array,
+    cap: jax.Array,
+    v_init: jax.Array,
+    *,
+    iters: int,
+    mesh: Optional[Mesh],
+) -> tuple[jax.Array, jax.Array]:
+    """Dispatch the dual solve: single-device grouped form, or the same
+    grouped form under shard_map with explicit all-gather collectives
+    when a mesh is present (GSPMD's own partitioning of the scan inserts
+    correct-but-unordered reductions whose float results drift from the
+    single-device solve — here the collective placement is load-bearing,
+    so it is explicit)."""
+    n, m = int(k.shape[0]), int(k.shape[1])
+    gn_total = _group_count(n)
+    gm_total = _group_count(m)
+    if mesh is None:
+        return _dual_solve(
+            k, cap, v_init, iters=iters, gn=gn_total, gm=gm_total,
+            gather_n=_identity, gather_m=_identity)
+
+    from jax.experimental.shard_map import shard_map
+
+    dp, tp = int(mesh.shape["dp"]), int(mesh.shape["tp"])
+    if gn_total % dp or gm_total % tp:
+        raise ValueError(
+            f"sinkhorn mesh axes (dp={dp}, tp={tp}) must divide the "
+            f"canonical reduction groups (gn={gn_total}, gm={gm_total} "
+            f"for a {n}x{m} wave) — mesh axes are capped at {GROUPS}")
+
+    def _local(k_loc, cap_loc, v_loc):
+        return _dual_solve(
+            k_loc, cap_loc, v_loc, iters=iters,
+            gn=gn_total // dp, gm=gm_total // tp,
+            gather_n=lambda p: jax.lax.all_gather(
+                p, "dp", axis=0, tiled=True),
+            gather_m=lambda p: jax.lax.all_gather(
+                p, "tp", axis=2, tiled=True),
+        )
+
+    solve = shard_map(
+        _local, mesh=mesh,
+        in_specs=(P("dp", "tp"), P("tp"), P("tp")),
+        out_specs=(P("dp", "tp"), P("tp")),
+        check_rep=False,
+    )
+    return solve(k, cap, v_init)
 
 
 def sinkhorn_picker(
@@ -74,15 +253,19 @@ def sinkhorn_picker(
     rounding_temp: float,
     use_pallas: bool = False,
     v0: Optional[jax.Array] = None,  # f32[M] last wave's column duals
+    mesh: Optional[Mesh] = None,
 ) -> tuple[PickResult, jax.Array]:
     # Effective transport mass: valid rows that still have candidates
-    # (padded rows and empty-subset rows contribute nothing).
+    # (padded rows and empty-subset rows contribute nothing). Integer-
+    # valued f32 partial sums are exact under ANY reduction order (all
+    # magnitudes < 2^24), so this one needs no grouping.
     n_eff = jnp.maximum(
         jnp.sum((valid & jnp.any(mask, axis=1)).astype(jnp.float32)), 1.0
     )
     cap = capacities(eps, n_eff, queue_limit=queue_limit)  # f32[M]
 
-    # Kernel: masked Gibbs weights. Subtract per-row max for stability.
+    # Kernel: masked Gibbs weights. Subtract per-row max for stability
+    # (max reductions are exact, so tp sharding cannot perturb them).
     row_max = jnp.max(jnp.where(mask, scores, -jnp.inf), axis=1, keepdims=True)
     row_max = jnp.where(jnp.isfinite(row_max), row_max, 0.0)
     k = jnp.where(mask, jnp.exp((scores - row_max) / tau), 0.0)
@@ -106,51 +289,26 @@ def sinkhorn_picker(
         free = _headroom(eps, queue_limit)
         idle_free = queue_limit * jnp.maximum(
             jnp.sum(eps.valid.astype(jnp.float32)), 1.0)
-        u = jnp.clip(1.0 - jnp.sum(free) / idle_free, 0.0, 1.0)
+        u = jnp.clip(1.0 - _sum_m(free) / idle_free, 0.0, 1.0)
         v_init = jnp.clip(v0, 1e-6, 1.0) ** (0.5 * u)
 
-    if use_pallas:
+    if use_pallas and mesh is None:
         # VMEM-resident iteration loop (one HBM write for the whole
         # solve). The kernel consumes the SAME warm-started duals as the
         # dual-form path below (ADVICE r5 #2): it seeds the plan with
         # diag(v_init) and carries the running column-scale product, so
         # its plan AND its returned duals match the XLA path's iterates —
-        # flipping the flag mid-run keeps the learned pressure.
+        # flipping the flag mid-run keeps the learned pressure. Under a
+        # mesh the grouped shard_map path runs instead: the kernel is a
+        # single-device loop, and the solve must be bit-equal across
+        # layouts (docs/MESH.md).
         from gie_tpu.ops import interpret_default
         from gie_tpu.ops.fused_sinkhorn import fused_sinkhorn_plan
 
         plan, v_out = fused_sinkhorn_plan(
             k, cap, v_init, iters=iters, interpret=interpret_default())
     else:
-        # DUAL-FORM iterations: the iterates of row-normalize-then-
-        # column-cap compose into p_t = diag(u_t) K diag(v_t), so the
-        # loop only needs two matvecs per iteration (K @ v and u @ K)
-        # and carries two VECTORS — the full [N, M] plan is materialized
-        # exactly once at the end. The equivalent matrix-form scan
-        # carried (read + wrote) the 1 MiB plan every iteration: ~2.5x
-        # the HBM traffic at 8 iterations (hack/cost_analysis.py).
-        def body(carry, _):
-            u, v = carry
-            # Row normalize: each request's mass is u_n * (K @ v)_n = 1.
-            r = k @ v                                   # f32[N]
-            u = jnp.where(r > 0, 1.0 / r, u)
-            # Column cap: load on endpoint m is v_m * (u @ K)_m.
-            col = v * (u @ k)                           # f32[M]
-            v = v * jnp.where(
-                col > cap, cap / jnp.maximum(col, 1e-9), 1.0)
-            return (u, v), None
-
-        (u, v), _ = jax.lax.scan(
-            body,
-            (jnp.ones(k.shape[:1], jnp.float32), v_init),
-            None, length=iters,
-        )
-        plan = k * u[:, None] * v[None, :]
-        # Final row normalization so the plan is a proper per-request
-        # distribution even where capacity clipped it.
-        row = jnp.sum(plan, axis=1, keepdims=True)
-        plan = jnp.where(row > 0, plan / row, plan)
-        v_out = v
+        plan, v_out = _solve_plan(k, cap, v_init, iters=iters, mesh=mesh)
 
     # Rounding: argmax of identical fractional rows would herd the whole
     # wave onto one endpoint again, so Gumbel noise (scaled by
@@ -158,6 +316,9 @@ def sinkhorn_picker(
     # rounding, not mass-proportional sampling: at rounding_temp < 1 picks
     # concentrate on each row's plan mode (~ plan^(1/temp)), which the
     # goodput sweep preferred over true proportional rounding (temp=1).
+    # Runs at the GSPMD level: elementwise ops and the max/argmax top-k
+    # are layout-exact, and jax_threefry_partitionable (gie_tpu.parallel)
+    # makes the noise bits sharding-invariant.
     g = jax.random.gumbel(key, plan.shape, jnp.float32) * rounding_temp
     masked = jnp.where(mask & (plan > 0), jnp.log(plan + 1e-20) + g, NEG)
     return _finalize(masked, mask, shed, valid), v_out

@@ -185,6 +185,11 @@ class EngineConfig:
     # byte-for-byte the pre-twin engine (the clock seam is a monotonic
     # passthrough).
     virtual_time: bool = False
+    # gie-mesh (docs/MESH.md): > 1 serves the storm through the
+    # Scheduler(mesh=) production path — the dp x tp sharded cycle on
+    # that many devices (the CPU dryrun's virtual chips in CI). 0/1 =
+    # the classic single-device scheduler.
+    mesh_devices: int = 0
 
     def fast_ladder(self) -> LadderConfig:
         return LadderConfig(
@@ -412,7 +417,14 @@ class StormEngine:
         prof, weights = tuned_profile()
         prof = dataclasses.replace(
             prof, queue_limit=cfg.queue_limit, kv_limit=cfg.kv_limit)
-        self.scheduler = Scheduler(prof, weights=weights)
+        mesh = None
+        if cfg.mesh_devices > 1:
+            # The production --mesh-devices path end to end: the storm's
+            # waves run the dp x tp sharded cycle (docs/MESH.md).
+            from gie_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(cfg.mesh_devices)
+        self.scheduler = Scheduler(prof, weights=weights, mesh=mesh)
         # Virtual mode hands every subsystem the same clock; real mode
         # keeps each subsystem's historical default (monotonic for the
         # resilience layer, wall time for the store's row stamps).

@@ -1,0 +1,23 @@
+"""Golden violation: host syncs in the mesh/sharding layer (GT004) —
+the sharded cycle is an async dispatch end to end; a D2H sync stalls
+every chip of the mesh at pick cadence (docs/MESH.md)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def pull_picks(result):
+    return jax.device_get(result.indices)            # GT004
+
+
+def wait_for_state(state):
+    state.assumed_load.block_until_ready()           # GT004
+    return state
+
+
+def scalarize(duals):
+    return duals.item()                              # GT004
+
+
+def listify(duals):
+    return jnp.cumsum(duals).tolist()                # GT004

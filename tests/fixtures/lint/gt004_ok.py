@@ -1,0 +1,19 @@
+"""Negative fixture for GT004: mesh construction's host bookkeeping
+(numpy over the device LIST, shape reads, sharding trees) touches no
+device buffers and is legal in gie_tpu.parallel."""
+
+import jax
+import numpy as np
+
+
+def build_grid(n):
+    devices = jax.devices()[:n]
+    return np.asarray(devices).reshape(n // 2, 2)    # host objects, fine
+
+
+def dp_axis(mesh):
+    return int(mesh.shape["dp"])                     # static shape read
+
+
+def spec_width(x):
+    return np.ndim(x)                                # structural, no pull

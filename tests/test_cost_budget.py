@@ -35,8 +35,13 @@ from gie_tpu.utils.costmodel import cycle_cost
     # same rule as the original calibration. If a future jaxlib drops
     # the measurement back to ~27 MB, tighten this again.
     ("default-topk", ProfileConfig(), 40.0),
-    # measured 55.5 MB (8 OT iterations re-read the transport kernel)
-    ("sinkhorn", ProfileConfig(picker="sinkhorn"), 64.0),
+    # Re-baselined 2026-08 (PR 15, gie-mesh): measured 63.2 MB, up from
+    # 55.5 — the layout-invariant grouped reductions (sinkhorn.py: fixed
+    # 8-group partials + ordered fold per sweep, the price of bit-equal
+    # picks across every dp x tp mesh layout) materialize the 4-D kernel
+    # view and per-iteration group partials the fused matvecs never
+    # wrote. Ceiling = measured + ~15% slack, same rule as the others.
+    ("sinkhorn", ProfileConfig(picker="sinkhorn"), 72.0),
 ])
 def test_cycle_hbm_budget(name, cfg, ceiling_mb):
     got_mb = cycle_cost(cfg)["bytes"] / 1e6

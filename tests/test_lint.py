@@ -70,6 +70,12 @@ def run_fixture(stem: str, rule: str) -> list[Violation]:
             "called from jit via gt002_bad.py:score",   # reachability
         ]),
         ("gt003_bad", "GT003", ["block_until_ready"]),
+        ("gt004_bad", "GT004", [
+            "jax.device_get in the sharded-cycle layer",
+            "block_until_ready in the sharded-cycle layer",
+            ".item() in the sharded-cycle layer",
+            ".tolist() in the sharded-cycle layer",
+        ]),
         ("ga001_bad", "GA001", [
             "time.sleep",
             "urllib.request.urlopen inside async function via",
@@ -113,6 +119,7 @@ def test_gt001_counts_every_import_time_shape():
         ("gl002_ok", "GL002"),
         ("gt001_ok", "GT001"),
         ("gt002_ok", "GT002"),
+        ("gt004_ok", "GT004"),
         ("ga001_ok", "GA001"),
         ("gr001_ok", "GR001"),
         ("gc001_ok", "GC001"),
