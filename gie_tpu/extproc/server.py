@@ -33,7 +33,7 @@ import grpc
 from google.protobuf.message import DecodeError as _DecodeError
 
 from gie_tpu import obs
-from gie_tpu.extproc import codec, envoy, fieldscan, metadata, pb
+from gie_tpu.extproc import codec, envoy, fieldscan, metadata, pb, wire, wirecodec
 from gie_tpu.obs import trace as obs_trace
 from gie_tpu.resilience import deadline as deadline_mod
 from gie_tpu.resilience import faults
@@ -494,6 +494,30 @@ def _shed_response(e: Exception) -> pb.ProcessingResponse:
             429, details="request shed"))
 
 
+# Pre-serialized constant responses for the wire lane (identity
+# response_serializer): computed ONCE from the same message constructors
+# the legacy path uses, so byte identity holds by construction.
+_PASSTHROUGH_REQUEST_BODY_BYTES = _PASSTHROUGH_REQUEST_BODY.SerializeToString()
+_PASSTHROUGH_RESPONSE_BODY_BYTES = _PASSTHROUGH_RESPONSE_BODY.SerializeToString()
+_SHED_429_BYTES = _shed_response(ShedError()).SerializeToString()
+_SHED_503_BYTES = _shed_response(DeadlineExceeded("wire")).SerializeToString()
+
+
+class _StreamState:
+    """Per-stream frame-loop state, shared verbatim between the legacy
+    recv loop (_process_with) and the wire session: the accumulating
+    request body, the deferred-headers flag, and the done latch the shed
+    paths set (legacy `return`s; the wire session has no loop to return
+    from)."""
+
+    __slots__ = ("body", "headers_deferred", "done")
+
+    def __init__(self):
+        self.body = bytearray()
+        self.headers_deferred = False
+        self.done = False
+
+
 class StreamingServer:
     """One instance serves all streams; Process is invoked per HTTP request
     (Envoy opens an ext-proc stream per request)."""
@@ -664,114 +688,125 @@ class StreamingServer:
             pass  # trace export must never mask the stream outcome
 
     def _process_with(self, ctx: RequestContext, stream: Stream) -> None:
-        body = bytearray()
-        headers_deferred = False
+        state = _StreamState()
+        recv, send, dispatch = stream.recv, stream.send, self._dispatch
         while True:
-            req = stream.recv()
+            req = recv()
             if req is None:
                 return
-            which = req.WhichOneof("request")
-            if which == "request_headers":
-                admission_t0 = time.perf_counter()
-                if self.fast_lane:
-                    # No per-request tracing spans on the fast lane: two
-                    # span observes cost more than the scan they would
-                    # time; gie_extproc_admission_seconds carries the
-                    # admission signal instead (spans return with the
-                    # rollout flag off).
-                    self._handle_request_headers(ctx, req)
-                else:
-                    with tracing.span("extproc.request_headers"):
-                        self._handle_request_headers(ctx, req)
-                if req.request_headers.end_of_stream:
-                    try:
-                        self._pick(ctx, None)
-                    except (ShedError, DeadlineExceeded) as e:
-                        ctx.trace_outcome = (
-                            "deadline" if isinstance(e, DeadlineExceeded)
-                            else "shed")
-                        stream.send(_shed_response(e))
-                        return
-                    stream.send(self._headers_response(ctx))
-                    _observe_admission(ctx, admission_t0)
-                else:
-                    headers_deferred = True
-            elif which == "request_body":
-                chunk = req.request_body.body
-                if len(body) + len(chunk) > MAX_REQUEST_BODY_SIZE:
-                    raise ExtProcError(
-                        grpc.StatusCode.RESOURCE_EXHAUSTED,
-                        f"request body size limit of {MAX_REQUEST_BODY_SIZE} "
-                        "bytes exceeded",
-                    )
-                body.extend(chunk)
-                if req.request_body.end_of_stream:
-                    admission_t0 = time.perf_counter()
-                    try:
-                        result = self._pick(ctx, bytes(body))
-                    except (ShedError, DeadlineExceeded) as e:
-                        ctx.trace_outcome = (
-                            "deadline" if isinstance(e, DeadlineExceeded)
-                            else "shed")
-                        stream.send(_shed_response(e))
-                        return
-                    if headers_deferred:
-                        stream.send(self._headers_response(ctx))
-                        headers_deferred = False
-                    if result.mutated_body is not None:
-                        for resp in envoy.build_chunked_body_responses(
-                            result.mutated_body, request_path=True
-                        ):
-                            stream.send(resp)
-                    elif self.fast_lane:
-                        stream.send(_PASSTHROUGH_REQUEST_BODY)
-                    else:
-                        stream.send(
-                            pb.ProcessingResponse(
-                                request_body=pb.BodyResponse(
-                                    response=pb.CommonResponse()
-                                )
-                            )
-                        )
-                    _observe_admission(ctx, admission_t0)
-                else:
-                    # Intermediate chunks need no reply in buffered-partial
-                    # mode; continue receiving.
-                    continue
-            elif which == "response_headers":
-                stream.send(self._handle_response_headers(ctx, req))
-            elif which == "response_body":
-                now = self._clock.now()
-                if req.response_body.body:
-                    if ctx.resp_first_at == 0.0:
-                        ctx.resp_first_at = now
-                    ctx.resp_last_at = now
-                if ctx.transcoding:
-                    stream.send(
-                        self._transcode_response_body(ctx, req.response_body)
-                    )
-                else:
-                    self._count_plain_tokens(ctx, req.response_body.body)
-                    if self.fast_lane:
-                        stream.send(_PASSTHROUGH_RESPONSE_BODY)
-                    else:
-                        stream.send(
-                            pb.ProcessingResponse(
-                                response_body=pb.BodyResponse(
-                                    response=pb.CommonResponse()
-                                )
-                            )
-                        )
-                if req.response_body.end_of_stream:
-                    self._finish_token_count(ctx)
-                    if self.on_response_complete is not None:
-                        self.on_response_complete(ctx)
+            dispatch(ctx, req, state, send)
+            if state.done:
+                return
+
+    def _dispatch(
+        self, ctx: RequestContext, req: pb.ProcessingRequest,
+        state: _StreamState, emit
+    ) -> None:
+        """One materialized frame through the Process choreography. The
+        legacy loop feeds it straight from recv(); the wire session feeds
+        it only the frames the walker FALLBACKed on (emit then serializes)
+        — the choreography itself has exactly one implementation."""
+        which = req.WhichOneof("request")
+        if which == "request_headers":
+            admission_t0 = time.perf_counter()
+            if self.fast_lane:
+                # No per-request tracing spans on the fast lane: two
+                # span observes cost more than the scan they would
+                # time; gie_extproc_admission_seconds carries the
+                # admission signal instead (spans return with the
+                # rollout flag off).
+                self._handle_request_headers(ctx, req)
             else:
-                # request_trailers / response_trailers parse (wire-correct
-                # fields 4/7) but are ignored, matching the reference
-                # (server.go:283-285). Envoy only sends them when the
-                # processing mode asks, which this EPP never does.
-                continue
+                with tracing.span("extproc.request_headers"):
+                    self._handle_request_headers(ctx, req)
+            if req.request_headers.end_of_stream:
+                try:
+                    self._pick(ctx, None)
+                except (ShedError, DeadlineExceeded) as e:
+                    ctx.trace_outcome = (
+                        "deadline" if isinstance(e, DeadlineExceeded)
+                        else "shed")
+                    emit(_shed_response(e))
+                    state.done = True
+                    return
+                emit(self._headers_response(ctx))
+                _observe_admission(ctx, admission_t0)
+            else:
+                state.headers_deferred = True
+        elif which == "request_body":
+            chunk = req.request_body.body
+            if len(state.body) + len(chunk) > MAX_REQUEST_BODY_SIZE:
+                raise ExtProcError(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"request body size limit of {MAX_REQUEST_BODY_SIZE} "
+                    "bytes exceeded",
+                )
+            state.body.extend(chunk)
+            if req.request_body.end_of_stream:
+                admission_t0 = time.perf_counter()
+                try:
+                    result = self._pick(ctx, bytes(state.body))
+                except (ShedError, DeadlineExceeded) as e:
+                    ctx.trace_outcome = (
+                        "deadline" if isinstance(e, DeadlineExceeded)
+                        else "shed")
+                    emit(_shed_response(e))
+                    state.done = True
+                    return
+                if state.headers_deferred:
+                    emit(self._headers_response(ctx))
+                    state.headers_deferred = False
+                if result.mutated_body is not None:
+                    for resp in envoy.build_chunked_body_responses(
+                        result.mutated_body, request_path=True
+                    ):
+                        emit(resp)
+                elif self.fast_lane:
+                    emit(_PASSTHROUGH_REQUEST_BODY)
+                else:
+                    emit(
+                        pb.ProcessingResponse(
+                            request_body=pb.BodyResponse(
+                                response=pb.CommonResponse()
+                            )
+                        )
+                    )
+                _observe_admission(ctx, admission_t0)
+            # Intermediate chunks need no reply in buffered-partial mode.
+        elif which == "response_headers":
+            emit(self._handle_response_headers(ctx, req))
+        elif which == "response_body":
+            now = self._clock.now()
+            if req.response_body.body:
+                if ctx.resp_first_at == 0.0:
+                    ctx.resp_first_at = now
+                ctx.resp_last_at = now
+            if ctx.transcoding:
+                emit(
+                    self._transcode_response_body(ctx, req.response_body)
+                )
+            else:
+                self._count_plain_tokens(ctx, req.response_body.body)
+                if self.fast_lane:
+                    emit(_PASSTHROUGH_RESPONSE_BODY)
+                else:
+                    emit(
+                        pb.ProcessingResponse(
+                            response_body=pb.BodyResponse(
+                                response=pb.CommonResponse()
+                            )
+                        )
+                    )
+            if req.response_body.end_of_stream:
+                self._finish_token_count(ctx)
+                if self.on_response_complete is not None:
+                    self.on_response_complete(ctx)
+        else:
+            # request_trailers / response_trailers parse (wire-correct
+            # fields 4/7) but are ignored, matching the reference
+            # (server.go:283-285). Envoy only sends them when the
+            # processing mode asks, which this EPP never does.
+            return
 
     # ------------------------------------------------------------------ #
 
@@ -873,7 +908,6 @@ class StreamingServer:
         # read the dict instead of rescanning (and re-lowercasing) every
         # header. Envoy lowercases HTTP/2 header keys, so the exact-match
         # copy sees what the case-insensitive legacy scan would.
-        filter_endpoints: list[str] = []
         if self.fast_lane:
             vals = ctx.headers.get(metadata.TEST_ENDPOINT_SELECTION_HEADER)
             test_val = vals[0] if vals else None
@@ -881,6 +915,18 @@ class StreamingServer:
             test_val = envoy.extract_header_value(
                 hdrs, metadata.TEST_ENDPOINT_SELECTION_HEADER
             )
+        self._resolve_candidates(
+            ctx, test_val, metadata_endpoints, has_subset_filter
+        )
+
+    def _resolve_candidates(
+        self, ctx: RequestContext, test_val: Optional[str],
+        metadata_endpoints: list[str], has_subset_filter: bool
+    ) -> None:
+        """Candidate-set resolution shared by both header handlers (the
+        materialized one above and the wire lane's): steering header over
+        subset hint over the datastore's non-draining snapshot."""
+        filter_endpoints: list[str] = []
         if test_val:
             filter_endpoints = [p.strip() for p in test_val.split(",") if p.strip()]
         if not filter_endpoints and metadata_endpoints:
@@ -1072,13 +1118,11 @@ class StreamingServer:
         ctx.pick_result = result
         return result
 
-    def _headers_response(self, ctx: RequestContext) -> pb.ProcessingResponse:
-        """Destination via BOTH header and envoy.lb dynamic metadata
-        (004 README:46-82; reference server.go:148-190). Fast lane: the
-        response skeleton comes from the pre-serialized template pool and
-        only the endpoint-bearing values are patched — byte-identical to
-        the built-from-scratch legacy path (pinned by
-        tests/test_extproc_fastlane.py)."""
+    def _response_set_headers(self, ctx: RequestContext) -> dict[str, str]:
+        """The headers-response mutation values — one construction for
+        the message lanes (_headers_response) and the wire lane's byte
+        builder, so a drift can only be a serialization bug, never a
+        content bug."""
         set_headers = {
             metadata.DESTINATION_ENDPOINT_KEY: ctx.target_endpoint,
             # Conformance affordance: ask the echo backend to reflect the
@@ -1097,6 +1141,16 @@ class StreamingServer:
                 deadline_mod.remaining_s(
                     ctx.deadline_at, now=self._clock.now()), 0.0) * 1000.0
             set_headers[deadline_mod.REMAINING_HEADER] = str(int(rem_ms))
+        return set_headers
+
+    def _headers_response(self, ctx: RequestContext) -> pb.ProcessingResponse:
+        """Destination via BOTH header and envoy.lb dynamic metadata
+        (004 README:46-82; reference server.go:148-190). Fast lane: the
+        response skeleton comes from the pre-serialized template pool and
+        only the endpoint-bearing values are patched — byte-identical to
+        the built-from-scratch legacy path (pinned by
+        tests/test_extproc_fastlane.py)."""
+        set_headers = self._response_set_headers(ctx)
         if self.fast_lane:
             return self._headers_templates.build(
                 set_headers, ctx.target_endpoint
@@ -1113,6 +1167,151 @@ class StreamingServer:
                 {metadata.DESTINATION_ENDPOINT_KEY: ctx.target_endpoint},
             ),
         )
+
+    # ------------------------------------------------------------------ #
+    # Wire lane (docs/EXTPROC.md): raw frame bytes in, raw response bytes
+    # out — zero ProcessingRequest objects on the classified paths.
+
+    def wire_session(self) -> "WireSession":
+        """One per Process stream, created by the wire service handler
+        (service.py). Requires the fast lane: the wire path IS the fast
+        lane minus the protobuf, and shares its template/scan machinery."""
+        if not self.fast_lane:
+            raise ValueError("wire lane requires fast_lane=True")
+        return WireSession(self)
+
+    def _wire_dispatch(
+        self, ctx: RequestContext, data: bytes, state: _StreamState,
+        out: list
+    ) -> None:
+        """One raw frame through admission. Classified header/body frames
+        never materialize; FALLBACK/INVALID verdicts funnel through
+        wire.materialize into the shared _dispatch — for INVALID bytes
+        FromString raises there, failing the stream exactly where the
+        legacy request_deserializer would have."""
+        verdict, off, length = wire.walk(data)
+        if verdict < 0:
+            self._dispatch(ctx, wire.materialize(data), state,
+                           lambda resp: out.append(resp.SerializeToString()))
+            return
+        kind = verdict & 0x07
+        if kind == wire.KIND_NONE:
+            return  # no oneof arm set: the handler ignores the frame
+        eos = bool(verdict & wire.EOS_BIT)
+        payload = data[off:off + length] if verdict & wire.PAYLOAD_BIT else b""
+        if kind == wire.KIND_REQUEST_HEADERS:
+            admission_t0 = time.perf_counter()
+            self._wire_request_headers(ctx, payload)
+            if eos:
+                try:
+                    self._pick(ctx, None)
+                except (ShedError, DeadlineExceeded) as e:
+                    ctx.trace_outcome = (
+                        "deadline" if isinstance(e, DeadlineExceeded)
+                        else "shed")
+                    out.append(_SHED_503_BYTES
+                               if isinstance(e, DeadlineExceeded)
+                               else _SHED_429_BYTES)
+                    state.done = True
+                    return
+                out.append(wirecodec.headers_response_bytes(
+                    self._response_set_headers(ctx), ctx.target_endpoint))
+                _observe_admission(ctx, admission_t0)
+            else:
+                state.headers_deferred = True
+        elif kind == wire.KIND_REQUEST_BODY:
+            if len(state.body) + len(payload) > MAX_REQUEST_BODY_SIZE:
+                raise ExtProcError(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"request body size limit of {MAX_REQUEST_BODY_SIZE} "
+                    "bytes exceeded",
+                )
+            state.body.extend(payload)
+            if eos:
+                admission_t0 = time.perf_counter()
+                try:
+                    result = self._pick(ctx, bytes(state.body))
+                except (ShedError, DeadlineExceeded) as e:
+                    ctx.trace_outcome = (
+                        "deadline" if isinstance(e, DeadlineExceeded)
+                        else "shed")
+                    out.append(_SHED_503_BYTES
+                               if isinstance(e, DeadlineExceeded)
+                               else _SHED_429_BYTES)
+                    state.done = True
+                    return
+                if state.headers_deferred:
+                    out.append(wirecodec.headers_response_bytes(
+                        self._response_set_headers(ctx),
+                        ctx.target_endpoint))
+                    state.headers_deferred = False
+                if result.mutated_body is not None:
+                    for resp in envoy.build_chunked_body_responses(
+                        result.mutated_body, request_path=True
+                    ):
+                        out.append(resp.SerializeToString())
+                else:
+                    out.append(_PASSTHROUGH_REQUEST_BODY_BYTES)
+                _observe_admission(ctx, admission_t0)
+            # Intermediate chunks need no reply in buffered-partial mode.
+        elif kind == wire.KIND_RESPONSE_BODY and not ctx.transcoding:
+            now = self._clock.now()
+            if payload:
+                if ctx.resp_first_at == 0.0:
+                    ctx.resp_first_at = now
+                ctx.resp_last_at = now
+            self._count_plain_tokens(ctx, payload)
+            out.append(_PASSTHROUGH_RESPONSE_BODY_BYTES)
+            if eos:
+                self._finish_token_count(ctx)
+                if self.on_response_complete is not None:
+                    self.on_response_complete(ctx)
+        else:
+            # response_headers (the :status harvest + served-endpoint
+            # echo, once per stream — and the real Envoy frame carries
+            # metadata_context, FALLBACKing above anyway) and transcoded
+            # response bodies (codec work on message objects) take the
+            # materialized choreography.
+            self._dispatch(ctx, wire.materialize(data), state,
+                           lambda resp: out.append(resp.SerializeToString()))
+
+    def _wire_request_headers(self, ctx: RequestContext, hmap: bytes) -> None:
+        """_handle_request_headers for a classified frame: the needed-keys
+        scan runs directly on the frame's HeaderMap slice — the legacy
+        fast lane re-serializes the materialized map per request just to
+        feed the same scanner. No metadata subset arm: frames carrying
+        metadata_context never classify (FALLBACK)."""
+        out = ctx.headers
+        pairs = (
+            fieldscan.scan_headers(hmap, self._header_spec)
+            if fieldscan.headers_available()
+            else None
+        )
+        if pairs is None:
+            # No native library (or >cap matches): a pure-Python walk of
+            # the same wire bytes — still zero protobuf objects.
+            pairs = wire.scan_header_map_py(hmap, self._needed_headers)
+        for key, value in pairs:
+            bucket = out.get(key)
+            if bucket is None:
+                out[key] = [value]
+            else:
+                bucket.append(value)
+
+        if obs.ENABLED:
+            tracer = obs.TRACER
+            if tracer is not None:
+                ctx.trace = tracer.begin(ctx.headers)
+
+        if (deadline_mod.GATEWAY_DEADLINE_HEADER in ctx.headers
+                or deadline_mod.ENVOY_TIMEOUT_HEADER in ctx.headers):
+            ctx.deadline_at = deadline_mod.deadline_from_headers(
+                ctx.headers, now=self._clock.now())
+
+        vals = ctx.headers.get(metadata.TEST_ENDPOINT_SELECTION_HEADER)
+        self._resolve_candidates(ctx, vals[0] if vals else None, [], False)
+
+    # ------------------------------------------------------------------ #
 
     @staticmethod
     def _replace_body(body: bytes) -> pb.ProcessingResponse:
@@ -1345,3 +1544,70 @@ class StreamingServer:
                 )
             )
         )
+
+
+class WireSession:
+    """One ext-proc stream on the wire lane: raw frame bytes in via
+    :meth:`feed`, raw serialized responses out, with the same lifecycle
+    accounting as the legacy ``process(stream)`` path — STREAMS gauge,
+    context pool, abort teardown, trace closure — replicated step for
+    step (the wire service handler has no recv loop to wrap).
+
+    The generator handler in service.py drives it inline on the gRPC
+    thread (no per-stream worker thread: a thread spawn costs more than
+    the whole classified admission), so feed() runs strictly
+    sequentially per session and needs no locking.
+    """
+
+    __slots__ = ("_server", "_ctx", "_state", "_closed")
+
+    def __init__(self, server: StreamingServer):
+        self._server = server
+        own_metrics.STREAMS.inc()
+        self._ctx = _acquire_ctx()
+        self._state = _StreamState()
+        self._closed = False
+
+    @property
+    def done(self) -> bool:
+        """True after a shed/deadline ImmediateResponse: the legacy loop
+        returns there, so the wire handler must also end the stream."""
+        return self._state.done
+
+    def feed(self, data: bytes) -> list:
+        """Process one raw ProcessingRequest frame; returns the raw
+        serialized responses to send (possibly empty). Raises
+        ExtProcError / DecodeError for stream-fatal conditions — the
+        caller routes them through close(error)."""
+        out: list = []
+        self._server._wire_dispatch(self._ctx, data, self._state, out)
+        return out
+
+    def close(self, error: Exception = None) -> None:
+        """Stream teardown, every exit path — mirrors _process's
+        except/finally ladder: StreamAborted marks an abnormal end
+        quietly, ExtProcError/internal errors also stamp the trace
+        outcome, and the finally-side accounting (abort hook, trace
+        closure, context-pool return, STREAMS dec) always runs."""
+        if self._closed:
+            return
+        self._closed = True
+        ctx = self._ctx
+        srv = self._server
+        if error is not None:
+            ctx.aborted = True
+            if not isinstance(error, StreamAborted) and not ctx.trace_outcome:
+                if isinstance(error, ExtProcError):
+                    ctx.trace_outcome = (
+                        "unavailable"
+                        if error.code == grpc.StatusCode.UNAVAILABLE
+                        else "error")
+                else:
+                    ctx.trace_outcome = "error"
+        try:
+            srv._finish_stream(ctx)
+            if ctx.trace is not None:
+                srv._finish_trace(ctx)
+        finally:
+            _CTX_POOL.append(ctx)
+            own_metrics.STREAMS.dec()

@@ -5,6 +5,21 @@ Registers under Envoy's service name
 generic handlers — no protoc-gen-grpc plugin needed — so an Envoy configured
 for a standard ext-proc cluster reaches us without config changes (reference
 runserver.go:115 RegisterExternalProcessorServer).
+
+Two registrations share the wire:
+
+legacy (wire=False): ProcessingRequest.FromString as the request
+    deserializer, a per-stream worker thread driving server.process —
+    every frame is a materialized protobuf.
+wire (wire=True, docs/EXTPROC.md): IDENTITY deserializer/serializer
+    (None — grpc passes raw message bytes both ways) and an inline
+    generator driving a WireSession on the gRPC thread. Classified
+    frames never become ProcessingRequest objects; the walker's
+    FALLBACK verdict routes a frame through wire.materialize into the
+    same choreography, so responses are byte-identical lane to lane.
+    Inline, not thread-per-stream: the protocol is strictly
+    request-driven (one request frame -> zero or more response frames),
+    and a thread spawn costs more than the whole classified admission.
 """
 
 from __future__ import annotations
@@ -13,6 +28,8 @@ import queue
 import threading
 
 import grpc
+
+from google.protobuf.message import DecodeError as _DecodeError
 
 from gie_tpu.extproc import pb
 from gie_tpu.extproc.server import (
@@ -25,8 +42,10 @@ from gie_tpu.runtime import metrics as own_metrics
 SERVICE_NAME = "envoy.service.ext_proc.v3.ExternalProcessor"
 
 
-def _process_handler(server: StreamingServer):
+def _process_handler(server: StreamingServer, on_accept=None):
     def process(request_iterator, context: grpc.ServicerContext):
+        if on_accept is not None:
+            on_accept()
         out: queue.Queue = queue.Queue()
         done = object()
 
@@ -80,12 +99,77 @@ def _process_handler(server: StreamingServer):
     return process
 
 
-def add_extproc_service(grpc_server: grpc.Server, server: StreamingServer) -> None:
-    handler = grpc.stream_stream_rpc_method_handler(
-        _process_handler(server),
-        request_deserializer=pb.ProcessingRequest.FromString,
-        response_serializer=pb.ProcessingResponse.SerializeToString,
-    )
+def _wire_process_handler(server: StreamingServer, on_accept=None):
+    def process(request_iterator, context: grpc.ServicerContext):
+        if on_accept is not None:
+            on_accept()
+        session = server.wire_session()
+        error = None
+        try:
+            while True:
+                try:
+                    data = next(request_iterator)
+                except StopIteration:
+                    break  # clean half-close: not a serve outcome
+                except grpc.RpcError:
+                    error = StreamAborted()  # reset/cancel mid-recv
+                    break
+                try:
+                    for resp in session.feed(data):
+                        yield resp
+                except ExtProcError as e:
+                    error = e
+                    break
+                except _DecodeError as e:
+                    # The legacy lane fails these in the request
+                    # deserializer before the handler ever runs; the wire
+                    # lane meets them at wire.materialize instead and
+                    # owes the same stream-fatal outcome.
+                    error = ExtProcError(
+                        grpc.StatusCode.INTERNAL,
+                        f"malformed ProcessingRequest: {e}")
+                    break
+                except Exception as e:  # stream-fatal internal error
+                    error = ExtProcError(
+                        grpc.StatusCode.INTERNAL, f"internal error: {e}")
+                    break
+                if session.done:
+                    break  # ImmediateResponse sent: stream over
+        except GeneratorExit:
+            # grpc closes the generator at a yield point when the RPC is
+            # cancelled mid-send — the same abort recv would have seen.
+            session.close(StreamAborted())
+            raise
+        finally:
+            session.close(error)
+            if error is not None and not isinstance(error, StreamAborted):
+                own_metrics.STREAM_ERRORS.labels(
+                    code=error.code.name.lower()).inc()
+        if error is not None and not isinstance(error, StreamAborted):
+            context.abort(error.code, error.message)
+
+    return process
+
+
+def add_extproc_service(
+    grpc_server: grpc.Server, server: StreamingServer, *,
+    wire: bool = False, on_accept=None,
+) -> None:
+    """Register Process. ``wire=True`` selects the zero-protobuf lane
+    (requires the fast lane); ``on_accept`` is called once per accepted
+    stream — the worker pool wires per-worker tallies through it."""
+    if wire:
+        handler = grpc.stream_stream_rpc_method_handler(
+            _wire_process_handler(server, on_accept),
+            request_deserializer=None,   # raw frame bytes in
+            response_serializer=None,    # raw response bytes out
+        )
+    else:
+        handler = grpc.stream_stream_rpc_method_handler(
+            _process_handler(server, on_accept),
+            request_deserializer=pb.ProcessingRequest.FromString,
+            response_serializer=pb.ProcessingResponse.SerializeToString,
+        )
     generic = grpc.method_handlers_generic_handler(
         SERVICE_NAME, {"Process": handler}
     )

@@ -14,7 +14,10 @@ that registers instruments and enforces:
   OC002  help text present and not just the name echoed back.
   OC003  label-set width bounded (<= MAX_LABELS): labels multiply
          series; anything wider than a few enum-ish dimensions belongs
-         in the flight recorder, not the exposition.
+         in the flight recorder, not the exposition. ``_info`` gauges
+         get a wider bound (MAX_INFO_LABELS): the constant-1 info idiom
+         is one series total no matter how many identity labels it
+         carries, and build/feature-flag mixes legitimately stack up.
   OC004  no per-endpoint/per-request identity labels (endpoint, pod,
          ip, slot, trace/request IDs, url...): identity lives in
          exemplars and /debugz records, never in series labels.
@@ -28,6 +31,7 @@ from __future__ import annotations
 import sys
 
 MAX_LABELS = 4
+MAX_INFO_LABELS = 8  # _info gauges: one constant-1 series by idiom
 
 # Identity-shaped label names whose value sets scale with the pool or
 # the request stream — per-series cardinality bombs.
@@ -79,10 +83,11 @@ def _check_one(name: str, doc: str, labels: list) -> list[str]:
         out.append(f"OC001 {name}: metric name must be gie_-prefixed")
     if not doc.strip() or doc.strip() == name:
         out.append(f"OC002 {name}: help text missing")
-    if len(labels) > MAX_LABELS:
+    bound = MAX_INFO_LABELS if name.endswith("_info") else MAX_LABELS
+    if len(labels) > bound:
         out.append(
             f"OC003 {name}: {len(labels)} labels {sorted(labels)} exceeds "
-            f"the {MAX_LABELS}-label cardinality bound")
+            f"the {bound}-label cardinality bound")
     bad = sorted(set(labels) & FORBIDDEN_LABELS)
     if bad:
         out.append(

@@ -29,6 +29,16 @@ BATCH_SIZE = prom.Histogram(
 STREAMS = prom.Gauge(
     "gie_active_streams", "Open ext-proc streams", registry=REGISTRY
 )
+# Multi-core acceptors (extproc/workers.py, --extproc-workers): streams
+# accepted per SO_REUSEPORT worker. The label is the worker index —
+# bounded by the flag value. A one-worker skew here means the kernel is
+# not spreading connections (storm-ci pins balance; docs/EXTPROC.md).
+WORKER_ACCEPTS = prom.Counter(
+    "gie_extproc_worker_accepted_streams_total",
+    "ext-proc streams accepted, by SO_REUSEPORT worker index",
+    ["worker"],
+    registry=REGISTRY,
+)
 # Admission fast lane (extproc/server.py, docs/EXTPROC.md): per-request
 # EPP overhead between "request fully received" and "routing decision
 # sent" — pick + body scan/parse + response build. The lane label splits
@@ -291,8 +301,9 @@ PD_BUDGET_SINGLEHOP = prom.Counter(
 BUILD_INFO = prom.Gauge(
     "gie_build_info",
     "Constant 1 with build/runtime identity labels: package version and "
-    "the lane/resilience/obs feature-flag mix this replica runs",
-    ["version", "fast_lane", "resilience", "obs"],
+    "the lane/resilience/obs/wire feature-flag mix (plus acceptor count) "
+    "this replica runs",
+    ["version", "fast_lane", "resilience", "obs", "wire", "workers"],
     registry=REGISTRY,
 )
 STREAM_ERRORS = prom.Counter(
@@ -407,7 +418,8 @@ FED_DRAINING = prom.Gauge(
 )
 
 
-def set_build_info(fast_lane: bool, resilience: bool, obs: bool) -> None:
+def set_build_info(fast_lane: bool, resilience: bool, obs: bool,
+                   wire: bool = False, workers: int = 1) -> None:
     """Stamp the constant-1 build-identity series (runner startup)."""
     from gie_tpu.version import __version__
 
@@ -416,6 +428,8 @@ def set_build_info(fast_lane: bool, resilience: bool, obs: bool) -> None:
         fast_lane=str(bool(fast_lane)).lower(),
         resilience=str(bool(resilience)).lower(),
         obs=str(bool(obs)).lower(),
+        wire=str(bool(wire)).lower(),
+        workers=str(int(workers)),
     ).set(1)
 
 

@@ -69,6 +69,17 @@ class Options:
     # exists for safe rollout and for custom pickers that read request
     # headers outside server.NEEDED_REQUEST_HEADERS.
     extproc_fast_lane: bool = True
+    # Wire lane (extproc/wire.py, docs/EXTPROC.md): identity gRPC
+    # deserializers plus a native serialized-frame walker — classified
+    # admission frames never materialize as ProcessingRequest objects.
+    # Requires the fast lane (the walker feeds the same native header
+    # scan); any unclassified frame falls back to the legacy
+    # choreography with byte-identical responses (pinned by tests).
+    extproc_wire: bool = True
+    # SO_REUSEPORT acceptor count (extproc/workers.py): N in-process
+    # gRPC servers sharing one port, one datastore snapshot, and one
+    # metrics registry. 1 keeps the single-server layout.
+    extproc_workers: int = 1
     # Flow-control queue bounds (reference flow-controller overload policy,
     # proposal 0683): max picks waiting (0 = unbounded) and max seconds a
     # non-critical pick may queue before shedding 429 (0 = unbounded).
@@ -318,6 +329,22 @@ class Options:
                                  "json.loads + per-request response "
                                  "build; use when a custom picker reads "
                                  "headers beyond the needed-keys set)")
+        parser.add_argument("--extproc-wire", dest="extproc_wire",
+                            action="store_true", default=d.extproc_wire,
+                            help="zero-protobuf wire lane: walk serialized "
+                                 "ProcessingRequest frames natively and "
+                                 "reply with pre-built bytes (needs the "
+                                 "fast lane; unclassified frames fall "
+                                 "back to the legacy path)")
+        parser.add_argument("--no-extproc-wire", dest="extproc_wire",
+                            action="store_false",
+                            help="disable the wire lane (materialize "
+                                 "every ext-proc frame as a protobuf)")
+        parser.add_argument("--extproc-workers", type=int,
+                            default=d.extproc_workers,
+                            help="SO_REUSEPORT gRPC acceptors sharing the "
+                                 "ext-proc port, datastore snapshot, and "
+                                 "metrics registry (default 1)")
         parser.add_argument("--queue-bound", type=int, default=d.queue_bound,
                             help="max picks waiting in the flow-control "
                                  "queue; a full queue sheds by criticality "
@@ -613,6 +640,8 @@ class Options:
             kv_events_bind=args.kv_events_bind,
             kv_events_token=args.kv_events_token,
             extproc_fast_lane=args.extproc_fast_lane,
+            extproc_wire=args.extproc_wire,
+            extproc_workers=args.extproc_workers,
             queue_bound=args.queue_bound,
             queue_max_age_s=args.queue_max_age_s,
             autoscale_mode=args.autoscale_mode,
@@ -691,6 +720,11 @@ class Options:
             raise ValueError("--scrape-workers must be >= 0 (0 = auto)")
         if self.scrape_interval_ms <= 0:
             raise ValueError("--scrape-interval-ms must be > 0")
+        # One completion queue per worker plus a 64-thread pool each:
+        # beyond ~64 acceptors the thread count, not the port spread, is
+        # the binding constraint, and the value is surely a typo.
+        if not (1 <= self.extproc_workers <= 64):
+            raise ValueError("--extproc-workers must be 1..64")
         # With tp=1 the dp axis equals the device count, and dp must be a
         # power of two to divide the request buckets (sched/profile.py).
         if self.mesh_devices > 1 and self.mesh_devices & (self.mesh_devices - 1):

@@ -10,7 +10,6 @@ context/signal (internal/runnable/grpc.go:44-57 GracefulStop).
 from __future__ import annotations
 
 import threading
-from concurrent import futures
 from typing import Optional
 
 import grpc
@@ -25,7 +24,7 @@ from gie_tpu.controller.reconcilers import (
 from gie_tpu.datastore import Datastore
 from gie_tpu.sched import constants as C
 from gie_tpu.extproc.server import StreamingServer
-from gie_tpu.extproc.service import add_extproc_service
+from gie_tpu.extproc.workers import ExtProcWorkerPool
 from gie_tpu.metricsio import MetricsStore
 from gie_tpu.metricsio.engine import ScrapeEngine
 from gie_tpu.metricsio.mappings import BY_NAME
@@ -445,7 +444,7 @@ class ExtProcServerRunner:
             on_stream_aborted=self.picker.observe_stream_aborted,
             fast_lane=opts.extproc_fast_lane,
         )
-        self.grpc_server: Optional[grpc.Server] = None
+        self.grpc_server: Optional[ExtProcWorkerPool] = None
         self.health_server: Optional[grpc.Server] = None
         self.debugz_server = None
         self.kv_events = None
@@ -744,10 +743,14 @@ class ExtProcServerRunner:
             self.resilience.healthy if self.resilience is not None
             else None,
         )
+        # The wire lane rides on the fast lane's native header scan:
+        # --no-extproc-fast-lane quietly implies the legacy gRPC lane.
+        wire_lane = self.opts.extproc_wire and self.opts.extproc_fast_lane
         own_metrics.set_build_info(
             fast_lane=self.opts.extproc_fast_lane,
             resilience=self.opts.resilience,
-            obs=self._obs_installed)
+            obs=self._obs_installed,
+            wire=wire_lane, workers=self.opts.extproc_workers)
         try:
             self.debugz_server = own_metrics.start_metrics_server(
                 self.opts.metrics_port,
@@ -757,26 +760,28 @@ class ExtProcServerRunner:
         except OSError as e:
             self.log.error("metrics server failed to start", err=e)
 
-        server = grpc.server(futures.ThreadPoolExecutor(max_workers=64))
-        add_extproc_service(server, self.streaming)
-        # Colocated health on the ext-proc port (runserver.go:117-123).
-        HealthService(
-            self.ready,
-            self.replication.healthy if self.replication is not None
-            else None,
-            self.resilience.healthy if self.resilience is not None
-            else None,
-        ).add_to_server(server)
+        # Colocated health on the ext-proc port (runserver.go:117-123) —
+        # registered per acceptor so probes hit the same socket spread
+        # real traffic does.
+        def _add_health(srv):
+            HealthService(
+                self.ready,
+                self.replication.healthy if self.replication is not None
+                else None,
+                self.resilience.healthy if self.resilience is not None
+                else None,
+            ).add_to_server(srv)
+
+        pool = ExtProcWorkerPool(
+            self.streaming, self.opts.extproc_workers, wire=wire_lane,
+            health_factory=_add_health)
         addr = f"0.0.0.0:{self.opts.grpc_port}"
+        creds = None
         if self.opts.secure_serving:
             creds, self._cert_reloader = server_credentials(self.opts.cert_path)
-            port = server.add_secure_port(addr, creds)
-        else:
-            port = server.add_insecure_port(addr)
-        if port == 0:
-            raise OSError(f"failed to bind ext-proc port {addr}")
-        server.start()
-        self.grpc_server = server
+        port = pool.bind(addr, creds)
+        pool.start()
+        self.grpc_server = pool
         if self.opts.kv_events_port > 0:
             from gie_tpu.sched.kvevents import (
                 KVEventAggregator,
@@ -813,6 +818,8 @@ class ExtProcServerRunner:
             "ext-proc server started",
             port=port,
             secure=self.opts.secure_serving,
+            workers=self.opts.extproc_workers,
+            wire=wire_lane,
             health_port=self.opts.grpc_health_port,
             metrics_port=self.opts.metrics_port,
         )

@@ -176,6 +176,14 @@ class EngineConfig:
     force_rung: Optional[int] = None
     # Per-request data-plane resolution timeout (wall seconds).
     serve_timeout_s: float = 30.0
+    # gie-wire (docs/EXTPROC.md "workers"): model the multi-core
+    # ext-proc acceptor pool in engine time. 0 disables — the default,
+    # so the pinned decision fingerprints of pre-wire storms never
+    # move; >= 1 routes every arrival's admission through a per-worker
+    # serial-service gate (queueing + extproc_admission_s of service on
+    # its round-robin-assigned worker) BEFORE the real ext-proc stream.
+    extproc_workers: int = 0
+    extproc_admission_s: float = 0.0
     # Multi-cluster federation storms (gie-fed): a peer cluster spec,
     # or None for the classic single-cluster engine.
     federation: Optional[FederationSpec] = None
@@ -210,6 +218,72 @@ class _ZombieSnapshot:
 
     def serve(self, **_kw):
         return self.response
+
+
+class _AdmissionGate:
+    """Engine-time model of the multi-core ext-proc acceptor pool
+    (gie-wire, docs/EXTPROC.md "workers"): each arrival's admission is
+    one serial service interval on one of N workers, assigned round
+    robin (Envoy's connection pool spreads its per-request ext-proc
+    streams across per-worker connections). The gate charges queueing +
+    service time on the ENGINE clock before the real StreamingServer
+    stream runs, so a flash crowd against workers=1 saturates admission
+    exactly the way one GIL-bound acceptor does — and the monotone-
+    throughput-through-workers proof (tests/test_storm.py) runs on the
+    deterministic virtual clock. The lock covers only the next-free
+    bookkeeping, never a sleep; ranked in lint/lockorder.toml."""
+
+    def __init__(self, workers: int, service_s: float, clock):
+        self.workers = workers
+        self.service_s = service_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._next_free = [0.0] * workers
+        self._accepts = [0] * workers
+        self._waits: list[float] = []
+
+    def admit(self) -> int:
+        """Block (on the engine clock) until the assigned worker has
+        served this admission; returns the worker index."""
+        now = self._clock.now()
+        with self._lock:
+            w = self._rr % self.workers
+            self._rr += 1
+            start = max(now, self._next_free[w])
+            self._next_free[w] = start + self.service_s
+            self._accepts[w] += 1
+            self._waits.append(start - now)
+        delay = (start + self.service_s) - now
+        if delay > 0:
+            self._clock.sleep(delay)
+        return w
+
+    def accepts(self) -> list[int]:
+        with self._lock:
+            return list(self._accepts)
+
+    def report(self) -> dict:
+        with self._lock:
+            accepts = list(self._accepts)
+            waits = sorted(self._waits)
+        n = len(waits)
+
+        def pct(p: float) -> float:
+            if not n:
+                return 0.0
+            return round(waits[min(int(p * (n - 1)), n - 1)] * 1e3, 3)
+
+        return {
+            "workers": self.workers,
+            "admission_service_s": self.service_s,
+            "admitted": sum(accepts),
+            "per_worker_accepts": accepts,
+            "per_worker_busy_s": [round(a * self.service_s, 3)
+                                  for a in accepts],
+            "admission_wait_p50_ms": pct(0.50),
+            "admission_wait_p99_ms": pct(0.99),
+        }
 
 
 class _StubSlot:
@@ -361,6 +435,12 @@ class StormEngine:
         self._stop = threading.Event()
         self._t0 = 0.0
         self._sem = threading.Semaphore(self.cfg.max_concurrency)
+        # Multi-core admission model (gie-wire): None = pre-wire engine
+        # byte for byte (the pinned-fingerprint storms run with 0).
+        self._admission = (
+            _AdmissionGate(self.cfg.extproc_workers,
+                           self.cfg.extproc_admission_s, self.clock)
+            if self.cfg.extproc_workers >= 1 else None)
         # Tallies (worker threads append; small lists, GIL-atomic).
         self._completions: list[tuple] = []   # (ttft_s, tokens, tenant)
         self._client_5xx: list[tuple] = []    # (t, phase, detail)
@@ -731,6 +811,11 @@ class StormEngine:
         stream = _StormStream(self, a)
         stream.t_enqueue = self.clock.now()
         try:
+            if self._admission is not None:
+                # The acceptor-pool stage: queueing + service on the
+                # assigned worker elapses BEFORE the ext-proc exchange,
+                # so admission waits land inside the user TTFT.
+                self._admission.admit()
             self.server.process(stream)
         except ExtProcError as e:
             self._client_5xx.append(
@@ -1216,6 +1301,10 @@ class StormEngine:
             "fed_picks": sorted(
                 (c, b, n) for (c, b), n in self._fed_picks.items()),
         }
+        if self._admission is not None:
+            # Only when the gate is armed: a pre-wire storm's digest
+            # input must stay byte-identical to its pinned value.
+            decisions["extproc_accepts"] = self._admission.accepts()
         return hashlib.sha256(json.dumps(
             decisions, sort_keys=True, default=float).encode()).hexdigest()
 
@@ -1315,6 +1404,11 @@ class StormEngine:
             "breaker_events": [list(e) for e in self.board.events],
             "decision_fingerprint": self._decision_fingerprint(),
         }
+        if self._admission is not None:
+            # Multi-core admission section (gie-wire): per-worker accept
+            # spread + admission queueing — the storm-ci monotone-
+            # throughput and no-skew assertions read these.
+            card["extproc"] = self._admission.report()
         if self.fed_state is not None:
             # Per-cluster federation section (gie-fed): the four pinned
             # properties — spill with CRITICAL locality, drain bleed,
@@ -1363,6 +1457,8 @@ _STORM_DRIVE_KEYS = frozenset({
     # spend its wall-clock budget sweeping /metrics).
     "virtual_time", "scrape_interval_s", "world_dt_s",
     "autoscale_interval_s",
+    # gie-wire: the multi-core admission model (0 workers = off).
+    "extproc_workers", "extproc_admission_s",
 })
 
 
@@ -1400,7 +1496,9 @@ def engine_from_drive(storm: dict, *, seed: int,
                       ("queue_limit", float), ("max_concurrency", int),
                       ("virtual_time", bool), ("scrape_interval_s", float),
                       ("world_dt_s", float),
-                      ("autoscale_interval_s", float)):
+                      ("autoscale_interval_s", float),
+                      ("extproc_workers", int),
+                      ("extproc_admission_s", float)):
         if key in storm:
             cfg = dataclasses.replace(cfg, **{key: cast(storm[key])})
     if "federation" in storm:

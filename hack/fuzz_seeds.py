@@ -4,8 +4,10 @@ The jsonscan corpus is lifted verbatim from tests/test_fieldscan.py's
 directed corpora (the same bodies the parity suite pins against
 json.loads), the promparse corpus from production-shaped exposition
 samples (including the 0xFE spec||text split the harness understands),
-and the chunker corpus from prompt-like byte blobs sized around the
-header scheme fuzz_chunker.cc decodes. Run from the repo root:
+the chunker corpus from prompt-like byte blobs sized around the
+header scheme fuzz_chunker.cc decodes, and the pbwalk corpus from
+hand-serialized ProcessingRequest frames covering every walker verdict
+class (classified / FALLBACK / INVALID). Run from the repo root:
 
     python hack/fuzz_seeds.py [out_dir]   # default native/fuzz/corpus
 
@@ -70,6 +72,56 @@ CHUNKER_SEEDS = [
 ]
 
 
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while n > 0x7F:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _varint(field << 3 | 2) + _varint(len(payload)) + payload
+
+
+def _hv(key: bytes, raw: bytes) -> bytes:
+    return _ld(1, key) + _ld(3, raw)
+
+
+# The admission HeaderMap every classified request-headers frame
+# carries (serialized HeaderMap: repeated HeaderValue in field 1), and
+# its HttpHeaders.headers wrapping (field 1 again, one level up).
+_HEADER_MAP = (_ld(1, _hv(b":path", b"/v1/completions"))
+               + _ld(1, _hv(b"content-type", b"application/json"))
+               + _ld(1, _hv(b"x-gateway-model-name", b"llama")))
+_HDRS = _ld(1, _HEADER_MAP)
+
+# Hand-built serialized ProcessingRequest frames spanning every pbwalk
+# verdict class (gie-wire): classified headers/body arms, FALLBACK
+# triggers (trailers, metadata_context, reserved field 1, duplicate
+# arms), and INVALID shapes (truncation, bad UTF-8, over-length LEN) —
+# the byte-mutation fuzzer then walks outward from valid structures.
+PBWALK_SEEDS = [
+    _ld(2, _HDRS + bytes([3 << 3, 1])),              # request_headers eos
+    _ld(2, _HDRS),                                   # headers, no eos
+    _ld(3, _ld(1, b'{"model":"llama","prompt":"hi"}')
+        + bytes([2 << 3, 1])),                       # request_body eos
+    _ld(3, _ld(1, b'{"stream":')),                   # body chunk, no eos
+    _ld(5, _HDRS),                                   # response_headers
+    _ld(6, _ld(1, b'data: {"ok":1}\n\n')),           # response_body
+    _ld(4, _ld(1, _ld(1, _hv(b"grpc-status", b"0")))),  # trailers: FALLBACK
+    _ld(8, _ld(1, b"")) + _ld(2, _HDRS),             # metadata_context
+    _ld(1, b"\x01\x02") + _ld(2, _HDRS),             # reserved field 1
+    _ld(2, _HDRS) + _ld(3, _ld(1, b"{}")),           # duplicate oneof arms
+    # HeaderValue.value (field 2) is a proto3 string: bad UTF-8 is
+    # INVALID (raw_value, field 3, is bytes and takes anything).
+    _ld(2, _ld(1, _ld(1, _ld(1, b"k") + _ld(2, b"\xff\xfe")))),
+    _ld(2, _HDRS)[:-4],                              # truncated
+    b"",                                             # empty frame
+]
+
+
 def _load_fieldscan_bodies() -> list[bytes]:
     if REPO not in sys.path:
         sys.path.insert(0, REPO)  # test module imports gie_tpu
@@ -117,6 +169,7 @@ def main(argv: list[str]) -> int:
         "jsonscan": _write(out_dir, "jsonscan", json_seeds),
         "promparse": _write(out_dir, "promparse", PROMPARSE_SEEDS),
         "chunker": _write(out_dir, "chunker", CHUNKER_SEEDS),
+        "pbwalk": _write(out_dir, "pbwalk", PBWALK_SEEDS),
     }
     for name, n in sorted(counts.items()):
         print(f"fuzz_seeds: {n:3d} seed(s) -> {out_dir}/{name}")

@@ -1,0 +1,43 @@
+// Fuzz harness for native/pbwalk.cc (gie_pbwalk).
+//
+// Seeds: serialized ProcessingRequest frames from the wire-lane parity
+// suite, exported by hack/fuzz_seeds.py. ASan/UBSan judge memory
+// safety; the asserts pin the packed-return contract — a classified
+// frame must name a real oneof arm and any payload slice must lie
+// inside the input buffer. The stronger property (FromString accept
+// parity) needs a protobuf runtime and lives in the tier-1 mutation
+// fuzz test (tests/test_extproc_wirelane.py).
+
+#include <assert.h>
+#include <stdint.h>
+#include <string.h>
+
+#include "driver.h"
+
+extern "C" long gie_pbwalk(const char* buf, long n, long* out_off,
+                           long* out_len);
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  static const uint8_t kEmpty[1] = {0};
+  if (size == 0) data = kEmpty;  // walker gets a valid pointer
+  const char* buf = (const char*)data;
+  long n = (long)size;
+
+  long off = -7, len = -7;
+  long rc = gie_pbwalk(buf, n, &off, &len);
+  if (rc >= 0) {
+    long kind = rc & 0x07;
+    // 0 = no arm; trailers (4/7) always FALLBACK, never classified.
+    assert(kind == 0 || kind == 2 || kind == 3 || kind == 5 || kind == 6);
+    if (rc & 0x10) {
+      assert(kind != 0);
+      assert(off >= 0 && len >= 0 && off + len <= n);
+    } else {
+      assert(off == 0 && len == 0);
+    }
+    if (kind == 0) assert(rc == 0);  // no arm => no eos, no payload
+  } else {
+    assert(rc == -1 || rc == -2);
+  }
+  return 0;
+}

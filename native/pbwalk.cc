@@ -1,0 +1,311 @@
+// gie-wire: serialized-ProcessingRequest frame walker (ISSUE 16).
+//
+// The ext-proc wire lane receives RAW gRPC message bytes (identity
+// request_deserializer, extproc/service.py) and must decide — without
+// materializing a protobuf object — which oneof arm of
+// envoy.service.ext_proc.v3.ProcessingRequest a frame carries, whether
+// it ends the stream, and where the interesting payload bytes live:
+// the serialized HeaderMap for header frames (handed to
+// gie_headers_scan, jsonscan.cc), the body chunk for body frames
+// (handed to gie_json_scan). One walk, offsets out, no allocation.
+//
+// The verdict is deliberately conservative: anything the wire lane does
+// not handle BYTE-IDENTICALLY to the legacy FromString path returns
+// FALLBACK (-2) and the caller materializes the message — duplicate
+// oneof arms (protobuf merge semantics), metadata_context (the subset
+// hint / served echo the legacy handler walks as a Struct), trailer
+// frames (parsed only to be ignored; FromString stays the judge of
+// their validity), and any group wire type (upb skips well-formed
+// unknown groups). Wire-malformed bytes return INVALID (-1): the caller
+// falls back, FromString raises, and the stream fails exactly as the
+// legacy deserializer would have failed it.
+//
+// Accept parity (pinned by tests/test_extproc_wirelane.py's mutation
+// fuzz + native/fuzz/fuzz_pbwalk.cc): when the walker returns a kind,
+// ProcessingRequest.FromString MUST accept the same bytes and
+// WhichOneof must agree. That forces this walk to be as strict as upb
+// where it claims understanding: exact (field, wire-type) matches only
+// (a known field number at the wrong wire type is an unknown field to
+// upb, and to us), remaining-bytes overflow checks on every length
+// (the unsigned-compare lesson of jsonscan.cc), and strict UTF-8
+// validation of the string fields it vouches for (HeaderValue.key /
+// .value — upb rejects overlongs and surrogates at parse time, so a
+// frame we classify must not hide one).
+//
+// Field numbers (pinned by tests/test_extproc_wire.py against hand-built
+// golden bytes):
+//   ProcessingRequest: reserved 1; request_headers=2, request_body=3,
+//     request_trailers=4, response_headers=5, response_body=6,
+//     response_trailers=7, metadata_context=8
+//   HttpHeaders: headers=1 (HeaderMap), end_of_stream=3
+//   HttpBody:    body=1, end_of_stream=2
+//
+// Return value (long):
+//   -1  INVALID: wire-malformed at a level we walk
+//   -2  FALLBACK: well-formed but not wire-lane eligible
+//   >=0 bits 0-2  oneof arm field number (2..7; 0 = no arm set)
+//       bit 3     end_of_stream
+//       bit 4     payload present: out_off/out_len describe the
+//                 HeaderMap slice (header frames) or body bytes
+//                 (body frames) within buf
+//
+// Build: make -C native (libgiepbwalk.so; -asan variant + the
+// standalone fuzz harness fuzz/fuzz_pbwalk.cc ride the same source).
+
+#include <stdint.h>
+#include <string.h>
+
+namespace {
+
+constexpr long kInvalid = -1;
+constexpr long kFallback = -2;
+
+// Top-level ProcessingRequest fields.
+constexpr unsigned long long kArmFirst = 2;   // request_headers
+constexpr unsigned long long kArmLast = 7;    // response_trailers
+constexpr unsigned long long kMetadataContext = 8;
+constexpr unsigned long long kReservedField = 1;
+
+constexpr unsigned long long kReqHeaders = 2;
+constexpr unsigned long long kReqBody = 3;
+constexpr unsigned long long kReqTrailers = 4;
+constexpr unsigned long long kRespHeaders = 5;
+constexpr unsigned long long kRespBody = 6;
+constexpr unsigned long long kRespTrailers = 7;
+
+bool rd_varint(const unsigned char* p, long n, long* i,
+               unsigned long long* out) {
+  unsigned long long v = 0;
+  int shift = 0;
+  while (*i < n && shift < 64) {
+    unsigned char b = p[*i];
+    ++*i;
+    v |= (unsigned long long)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;  // truncated or > 10 bytes
+}
+
+// Skip one field of wire type `wire` (tag already consumed). Returns 0,
+// kInvalid on truncation / a nonexistent wire type (6/7 — upb rejects),
+// or kFallback on the group wire types (3/4): upb SKIPS a well-formed
+// unknown group even in proto3, so a group-bearing frame's validity is
+// FromString's call, not ours — the mutation fuzz caught exactly this.
+long skip_field(const unsigned char* p, long n, long* i,
+                unsigned long long wire) {
+  unsigned long long tmp;
+  switch (wire) {
+    case 0:
+      return rd_varint(p, n, i, &tmp) ? 0 : kInvalid;
+    case 1:
+      if (n - *i < 8) return kInvalid;
+      *i += 8;
+      return 0;
+    case 2:
+      if (!rd_varint(p, n, i, &tmp)) return kInvalid;
+      if (tmp > (unsigned long long)(n - *i)) return kInvalid;
+      *i += (long)tmp;
+      return 0;
+    case 5:
+      if (n - *i < 4) return kInvalid;
+      *i += 4;
+      return 0;
+    case 3:
+    case 4:
+      return kFallback;
+    default:
+      return kInvalid;  // wire types 6/7 do not exist
+  }
+}
+
+// Strict UTF-8 validation (what upb enforces for proto3 string fields):
+// no overlongs, no surrogates, no > U+10FFFF.
+bool utf8_valid(const unsigned char* s, long len) {
+  long i = 0;
+  while (i < len) {
+    unsigned char c = s[i];
+    if (c < 0x80) {
+      ++i;
+    } else if ((c & 0xE0) == 0xC0) {
+      if (i + 1 >= len || (s[i + 1] & 0xC0) != 0x80) return false;
+      if (c < 0xC2) return false;  // overlong
+      i += 2;
+    } else if ((c & 0xF0) == 0xE0) {
+      if (i + 2 >= len || (s[i + 1] & 0xC0) != 0x80 ||
+          (s[i + 2] & 0xC0) != 0x80)
+        return false;
+      if (c == 0xE0 && s[i + 1] < 0xA0) return false;  // overlong
+      if (c == 0xED && s[i + 1] >= 0xA0) return false;  // surrogate
+      i += 3;
+    } else if ((c & 0xF8) == 0xF0) {
+      if (i + 3 >= len || (s[i + 1] & 0xC0) != 0x80 ||
+          (s[i + 2] & 0xC0) != 0x80 || (s[i + 3] & 0xC0) != 0x80)
+        return false;
+      if (c == 0xF0 && s[i + 1] < 0x90) return false;  // overlong
+      if (c > 0xF4 || (c == 0xF4 && s[i + 1] >= 0x90))
+        return false;  // > U+10FFFF
+      i += 4;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// Validate one serialized HeaderMap: repeated HeaderValue headers=1,
+// each {key=1 string, value=2 string, raw_value=3 bytes}. Strict where
+// FromString is strict (UTF-8 on the string fields), unknown-skip
+// elsewhere. Returns kInvalid / kFallback / 0.
+long walk_header_map(const unsigned char* p, long start, long end) {
+  long i = start;
+  while (i < end) {
+    unsigned long long tag;
+    if (!rd_varint(p, end, &i, &tag)) return kInvalid;
+    unsigned long long field = tag >> 3, wire = tag & 7;
+    if (field == 0 || field > 0x1FFFFFFF) return kInvalid;  // tag 0 is always a parse error
+    if (field == 1 && wire == 2) {
+      unsigned long long hv_len;
+      if (!rd_varint(p, end, &i, &hv_len)) return kInvalid;
+      if (hv_len > (unsigned long long)(end - i)) return kInvalid;
+      long hv_end = i + (long)hv_len;
+      while (i < hv_end) {
+        unsigned long long t2;
+        if (!rd_varint(p, hv_end, &i, &t2)) return kInvalid;
+        unsigned long long f2 = t2 >> 3, w2 = t2 & 7;
+        if (f2 == 0 || f2 > 0x1FFFFFFF) return kInvalid;
+        if ((f2 == 1 || f2 == 2) && w2 == 2) {
+          unsigned long long sl;
+          if (!rd_varint(p, hv_end, &i, &sl)) return kInvalid;
+          if (sl > (unsigned long long)(hv_end - i)) return kInvalid;
+          if (!utf8_valid(p + i, (long)sl)) return kInvalid;
+          i += (long)sl;
+        } else {
+          long rc = skip_field(p, hv_end, &i, w2);
+          if (rc < 0) return rc;
+        }
+      }
+      if (i != hv_end) return kInvalid;
+    } else {
+      long rc = skip_field(p, end, &i, wire);
+      if (rc < 0) return rc;
+    }
+  }
+  return (i == end) ? 0 : kInvalid;
+}
+
+}  // namespace
+
+extern "C" long gie_pbwalk(const char* buf, long n, long* out_off,
+                           long* out_len) {
+  const unsigned char* p = (const unsigned char*)buf;
+  *out_off = 0;
+  *out_len = 0;
+  long payload_off = 0, payload_len = 0;
+  long i = 0;
+  unsigned long long kind = 0;
+  long arm_off = -1, arm_len = 0;
+  while (i < n) {
+    unsigned long long tag;
+    if (!rd_varint(p, n, &i, &tag)) return kInvalid;
+    unsigned long long field = tag >> 3, wire = tag & 7;
+    if (field == 0 || field > 0x1FFFFFFF) return kInvalid;
+    if (field >= kArmFirst && field <= kArmLast && wire == 2) {
+      if (kind != 0) return kFallback;  // second arm: merge/last-wins
+      unsigned long long alen;
+      if (!rd_varint(p, n, &i, &alen)) return kInvalid;
+      if (alen > (unsigned long long)(n - i)) return kInvalid;
+      kind = field;
+      arm_off = i;
+      arm_len = (long)alen;
+      i += (long)alen;
+    } else if (field == kMetadataContext && wire == 2) {
+      // Subset hint / served echo: the legacy handler walks this as a
+      // Struct pyramid — not a wire-lane path.
+      return kFallback;
+    } else if (field == kReservedField) {
+      // Reserved in the published proto; a sender using it is odd
+      // enough that FromString should be the judge.
+      return kFallback;
+    } else {
+      long rc = skip_field(p, n, &i, wire);
+      if (rc < 0) return rc;
+    }
+  }
+  if (i != n) return kInvalid;
+  if (kind == 0) return 0;  // empty / no arm: handler ignores the frame
+  if (kind == kReqTrailers || kind == kRespTrailers) {
+    // Ignored by the handler but still validated by the legacy
+    // deserializer — let FromString keep that contract.
+    return kFallback;
+  }
+
+  long verdict = (long)kind;
+  long end = arm_off + arm_len;
+  i = arm_off;
+  if (kind == kReqHeaders || kind == kRespHeaders) {
+    // HttpHeaders: headers=1 (HeaderMap), end_of_stream=3.
+    bool have_map = false;
+    while (i < end) {
+      unsigned long long tag;
+      if (!rd_varint(p, end, &i, &tag)) return kInvalid;
+      unsigned long long field = tag >> 3, wire = tag & 7;
+      if (field == 0 || field > 0x1FFFFFFF) return kInvalid;
+      if (field == 1 && wire == 2) {
+        if (have_map) return kFallback;  // submessage merge semantics
+        unsigned long long mlen;
+        if (!rd_varint(p, end, &i, &mlen)) return kInvalid;
+        if (mlen > (unsigned long long)(end - i)) return kInvalid;
+        long rc = walk_header_map(p, i, i + (long)mlen);
+        if (rc < 0) return rc;
+        have_map = true;
+        payload_off = i;
+        payload_len = (long)mlen;
+        verdict |= 0x10;
+        i += (long)mlen;
+      } else if (field == 3 && wire == 0) {
+        unsigned long long eos;
+        if (!rd_varint(p, end, &i, &eos)) return kInvalid;
+        if (eos) verdict |= 0x08; else verdict &= ~0x08L;
+      } else {
+        long rc = skip_field(p, end, &i, wire);
+        if (rc < 0) return rc;
+      }
+    }
+    if (i != end) return kInvalid;
+  } else {
+    // HttpBody: body=1 (bytes), end_of_stream=2. Scalar bytes follow
+    // last-one-wins, which a simple overwrite reproduces exactly.
+    while (i < end) {
+      unsigned long long tag;
+      if (!rd_varint(p, end, &i, &tag)) return kInvalid;
+      unsigned long long field = tag >> 3, wire = tag & 7;
+      if (field == 0 || field > 0x1FFFFFFF) return kInvalid;
+      if (field == 1 && wire == 2) {
+        unsigned long long blen;
+        if (!rd_varint(p, end, &i, &blen)) return kInvalid;
+        if (blen > (unsigned long long)(end - i)) return kInvalid;
+        payload_off = i;
+        payload_len = (long)blen;
+        verdict |= 0x10;
+        i += (long)blen;
+      } else if (field == 2 && wire == 0) {
+        unsigned long long eos;
+        if (!rd_varint(p, end, &i, &eos)) return kInvalid;
+        if (eos) verdict |= 0x08; else verdict &= ~0x08L;
+      } else {
+        long rc = skip_field(p, end, &i, wire);
+        if (rc < 0) return rc;
+      }
+    }
+    if (i != end) return kInvalid;
+  }
+  // Outs are written only on a classified verdict: every negative
+  // return above leaves them zeroed, stale-slice-proof.
+  *out_off = payload_off;
+  *out_len = payload_len;
+  return verdict;
+}

@@ -1,0 +1,458 @@
+"""gie-wire acceptance suite (docs/EXTPROC.md): the zero-protobuf wire
+lane against the legacy lane, byte for byte.
+
+Four pins:
+
+1. Byte parity across the PR 5 matrix: every scripted stream produces
+   the exact serialized ProcessingResponse sequence the legacy
+   (full-parse, fast_lane=False) server emits — classified frames and
+   FALLBACK frames alike.
+2. Zero materialization: classified headers-only and scanned-body
+   admissions construct ZERO ProcessingRequest objects, counted by
+   wire.MATERIALIZED (every wire-path FromString funnels through
+   wire.materialize).
+3. Walker parity under byte mutation: the native walker and the pure-
+   Python mirror agree on every mutated frame, and their verdicts are
+   sound against the real protobuf parser (classified => FromString
+   accepts and the oneof matches; INVALID => FromString raises).
+4. Worker-pool graceful drain: an in-flight stream on a draining
+   2-worker SO_REUSEPORT pool runs to completion inside the grace
+   window with no aborted-stream callback and no leaked active-stream
+   gauge charge.
+"""
+
+import json
+import queue
+import random
+import time
+
+import pytest
+
+from gie_tpu.extproc import pb, wire
+from gie_tpu.extproc.server import (
+    RoundRobinPicker,
+    ShedError,
+    StreamingServer,
+)
+from tests.test_extproc import body_msg, headers_msg
+from tests.test_extproc_fastlane import (
+    CHAT,
+    COMPLETION,
+    REQUEST_HEADERS,
+    RecordingPicker,
+    extractor_chain,
+    make_ds,
+    run_stream,
+)
+
+
+def wire_stream(server, messages):
+    """Drive serialized frames through a WireSession the way the wire
+    service handler does; returns the raw response bytes in order."""
+    session = server.wire_session()
+    out = []
+    try:
+        for msg in messages:
+            out.extend(session.feed(msg.SerializeToString()))
+            if session.done:
+                break
+    finally:
+        session.close(None)
+    return out
+
+
+def both_lanes_wire(messages, *, n=3, grpc_pool=False, chain_fn=None,
+                    picker_fn=RecordingPicker):
+    """(wire_response_bytes, legacy_response_bytes, wire_picker,
+    legacy_picker) for one scripted stream on identically-wired
+    servers."""
+    ds_w, ds_l = make_ds(n, grpc_pool=grpc_pool), make_ds(n, grpc_pool=grpc_pool)
+    pw, pl = picker_fn(), picker_fn()
+    wire_srv = StreamingServer(
+        ds_w, pw, bbr_chain=chain_fn() if chain_fn else None, fast_lane=True)
+    legacy_srv = StreamingServer(
+        ds_l, pl, bbr_chain=chain_fn() if chain_fn else None, fast_lane=False)
+    got = wire_stream(wire_srv, messages)
+    want = [r.SerializeToString() for r in run_stream(legacy_srv, messages)]
+    return got, want, pw, pl
+
+
+def assert_wire_byte_identical(messages, **kw):
+    got, want, pw, pl = both_lanes_wire(messages, **kw)
+    assert len(got) == len(want), (len(got), len(want))
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert g == w, (
+            f"response {i} differs:\nwire:   "
+            f"{pb.ProcessingResponse.FromString(g)}\nlegacy: "
+            f"{pb.ProcessingResponse.FromString(w)}")
+    return got, want, pw, pl
+
+
+# --------------------------------------------------------------------------
+# 1. Byte parity, classified and fallback paths
+# --------------------------------------------------------------------------
+
+
+def test_wire_parity_headers_only():
+    assert_wire_byte_identical([headers_msg(REQUEST_HEADERS)])
+
+
+def test_wire_parity_body_no_chain():
+    assert_wire_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+         body_msg(COMPLETION)])
+
+
+def test_wire_parity_body_with_extractor_chain():
+    got, _, pw, pl = assert_wire_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+         body_msg(COMPLETION)],
+        chain_fn=extractor_chain)
+    mut = pb.ProcessingResponse.FromString(
+        got[0]).request_headers.response.header_mutation
+    keys = {o.header.key: o.header.raw_value for o in mut.set_headers}
+    assert keys["X-Gateway-Model-Name"] == b"llama-3.1-8b"
+    # The scheduler-visible PickRequests match too, not just the bytes.
+    assert pw.requests[-1].model == pl.requests[-1].model
+
+
+def test_wire_parity_chat_and_chunked_body():
+    assert_wire_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+         body_msg(CHAT[:9], end_of_stream=False),
+         body_msg(CHAT[9:])],
+        chain_fn=extractor_chain)
+
+
+def test_wire_parity_malformed_and_empty_bodies():
+    for body in (b"not json", b"", b"[1,2,3]", b'{"model": 5}',
+                 b'{"truncated": ', b'\xff\xfe garbage'):
+        assert_wire_byte_identical(
+            [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+             body_msg(body)],
+            chain_fn=extractor_chain)
+
+
+def test_wire_parity_rewrite_applies():
+    """A firing rewrite mutates the body: the wire lane emits the same
+    CONTINUE_AND_REPLACE chunk stream the legacy lane builds."""
+    from gie_tpu.api.modelrewrite import (
+        InferenceModelRewrite,
+        ModelMatch,
+        RewriteEngine,
+        RewriteRule,
+        TargetModel,
+    )
+    from gie_tpu.bbr.chain import (
+        ModelExtractorPlugin,
+        ModelRewritePlugin,
+        PluginChain,
+    )
+
+    def chain():
+        eng = RewriteEngine(seed=0)
+        eng.apply(InferenceModelRewrite(
+            name="rw", pool_ref="pool",
+            rules=[RewriteRule(matches=[ModelMatch("llama-3.1-8b")],
+                               targets=[TargetModel("llama-70b")])]))
+        return PluginChain([ModelExtractorPlugin(),
+                            ModelRewritePlugin(eng, "pool")])
+
+    got, _, _, _ = assert_wire_byte_identical(
+        [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+         body_msg(COMPLETION)],
+        chain_fn=chain)
+    body_resp = pb.ProcessingResponse.FromString(got[1]).request_body.response
+    assert body_resp.status == pb.CommonResponse.CONTINUE_AND_REPLACE
+    assert json.loads(body_resp.body_mutation.body)["model"] == "llama-70b"
+
+
+def test_wire_parity_transcoding_buffered_and_streaming():
+    for body in (COMPLETION, CHAT):
+        assert_wire_byte_identical(
+            [headers_msg(REQUEST_HEADERS, end_of_stream=False),
+             body_msg(body)],
+            grpc_pool=True, chain_fn=extractor_chain)
+
+
+def test_wire_parity_subset_metadata_falls_back():
+    """A frame carrying metadata_context never classifies: the wire lane
+    materializes it and the subset filter still applies identically."""
+    md = {"envoy.lb.subset_hint":
+          {"x-gateway-destination-endpoint-subset": "10.0.0.1,10.0.0.2"}}
+    before = wire.MATERIALIZED
+    got, _, _, _ = assert_wire_byte_identical(
+        [headers_msg(REQUEST_HEADERS, metadata_struct=md)])
+    assert wire.MATERIALIZED > before  # the fallback really fired
+    mut = pb.ProcessingResponse.FromString(
+        got[0]).request_headers.response.header_mutation
+    dest = {o.header.key: o.header.raw_value for o in mut.set_headers}
+    assert dest["x-gateway-destination-endpoint"] in (
+        b"10.0.0.1:8000", b"10.0.0.2:8000")
+
+
+def test_wire_parity_steering_header():
+    hdrs = dict(REQUEST_HEADERS)
+    hdrs["test-epp-endpoint-selection"] = "10.0.0.2:8000"
+    got, _, _, _ = assert_wire_byte_identical([headers_msg(hdrs)])
+    mut = pb.ProcessingResponse.FromString(
+        got[0]).request_headers.response.header_mutation
+    dest = {o.header.key: o.header.raw_value for o in mut.set_headers}
+    assert dest["x-gateway-destination-endpoint"] == b"10.0.0.2:8000"
+
+
+def test_wire_parity_shed():
+    class SheddingPicker(RecordingPicker):
+        def pick(self, req, candidates):
+            raise ShedError()
+
+    got, want, _, _ = both_lanes_wire(
+        [headers_msg(REQUEST_HEADERS)], picker_fn=SheddingPicker)
+    assert got == want
+    resp = pb.ProcessingResponse.FromString(got[0])
+    assert resp.immediate_response.status.code == 429
+
+
+def test_wire_parity_response_phase_sse_counting():
+    sse = (b'data: {"choices": [{"text": "a"}]}\n\n'
+           b'data: {"choices": [{"text": "b"}]}\n\n'
+           b'data: [DONE]\n\n')
+    messages = [
+        headers_msg(REQUEST_HEADERS, end_of_stream=False),
+        body_msg(COMPLETION),
+        pb.ProcessingRequest(response_headers=pb.HttpHeaders()),
+        pb.ProcessingRequest(response_body=pb.HttpBody(
+            body=sse, end_of_stream=True)),
+    ]
+    tokens = {}
+    for lane in ("wire", "legacy"):
+        seen = []
+        server = StreamingServer(
+            make_ds(), RecordingPicker(), fast_lane=(lane == "wire"),
+            on_response_complete=lambda ctx: seen.append(ctx.resp_tokens))
+        if lane == "wire":
+            resp_bytes = wire_stream(server, messages)
+        else:
+            resp_bytes = [r.SerializeToString()
+                          for r in run_stream(server, messages)]
+        tokens[lane] = (seen, resp_bytes)
+    assert tokens["wire"] == tokens["legacy"]
+    assert tokens["wire"][0] == [2]
+
+
+def test_wire_invalid_frame_fails_like_the_deserializer():
+    """Truncated bytes: the legacy lane dies in the request deserializer;
+    the wire session must surface the same DecodeError from materialize."""
+    from google.protobuf.message import DecodeError
+
+    server = StreamingServer(make_ds(), RecordingPicker(), fast_lane=True)
+    session = server.wire_session()
+    good = headers_msg(REQUEST_HEADERS).SerializeToString()
+    with pytest.raises(DecodeError):
+        session.feed(good[:-3])
+    session.close(None)
+
+
+def test_wire_session_requires_fast_lane():
+    server = StreamingServer(make_ds(), RecordingPicker(), fast_lane=False)
+    with pytest.raises(ValueError, match="fast_lane"):
+        server.wire_session()
+
+
+# --------------------------------------------------------------------------
+# 2. Zero materialization on classified admissions
+# --------------------------------------------------------------------------
+
+
+def test_zero_materialization_headers_only_and_scanned_body():
+    server = StreamingServer(make_ds(), RecordingPicker(), fast_lane=True,
+                             bbr_chain=extractor_chain())
+    before = wire.MATERIALIZED
+    out = wire_stream(server, [headers_msg(REQUEST_HEADERS)])
+    assert len(out) == 1
+    out = wire_stream(server, [
+        headers_msg(REQUEST_HEADERS, end_of_stream=False),
+        body_msg(COMPLETION[:40], end_of_stream=False),
+        body_msg(COMPLETION[40:]),
+    ])
+    assert len(out) == 2  # deferred headers response + body passthrough
+    assert wire.MATERIALIZED == before, (
+        "classified admission frames materialized a ProcessingRequest")
+
+
+def test_response_headers_frame_materializes_exactly_once():
+    server = StreamingServer(make_ds(), RecordingPicker(), fast_lane=True)
+    session = server.wire_session()
+    session.feed(headers_msg(REQUEST_HEADERS).SerializeToString())
+    before = wire.MATERIALIZED
+    session.feed(pb.ProcessingRequest(
+        response_headers=pb.HttpHeaders()).SerializeToString())
+    assert wire.MATERIALIZED == before + 1
+    session.close(None)
+
+
+# --------------------------------------------------------------------------
+# 3. Walker parity under byte mutation (bounded tier-1 fuzz; the deep
+#    ASan run lives in test_fuzz_smoke.py / make fuzz-smoke)
+# --------------------------------------------------------------------------
+
+
+def _mutate(rng, data: bytes) -> bytes:
+    buf = bytearray(data)
+    for _ in range(rng.randint(1, 3)):
+        op = rng.randrange(4)
+        if op == 0 and buf:
+            buf[rng.randrange(len(buf))] = rng.randrange(256)
+        elif op == 1:
+            buf.insert(rng.randrange(len(buf) + 1), rng.randrange(256))
+        elif op == 2 and buf:
+            del buf[rng.randrange(len(buf))]
+        elif buf:
+            i = rng.randrange(len(buf))
+            buf[i] ^= 1 << rng.randrange(8)
+    return bytes(buf)
+
+
+_ONEOF_BY_KIND = {2: "request_headers", 3: "request_body",
+                  5: "response_headers", 6: "response_body"}
+
+
+def test_walker_native_python_parity_under_mutation():
+    if wire.walk_native(b"") is None:
+        pytest.skip("native pbwalk library not built")
+    import sys
+    sys.path.insert(0, "hack")
+    try:
+        from fuzz_seeds import PBWALK_SEEDS
+    finally:
+        sys.path.pop(0)
+
+    rng = random.Random(0x61E)
+    checked = 0
+    for _ in range(4000):
+        data = _mutate(rng, rng.choice(PBWALK_SEEDS))
+        native = wire.walk_native(data)
+        pure = wire.walk_py(data)
+        assert native is not None and tuple(native) == pure, (
+            f"walker divergence on {data.hex()}: "
+            f"native={native} python={pure}")
+        verdict, off, length = pure
+        try:
+            msg = pb.ProcessingRequest.FromString(data)
+        except Exception:
+            msg = None
+        if verdict == wire.INVALID:
+            assert msg is None, (
+                f"walker rejected bytes upb accepts: {data.hex()}")
+        elif verdict >= 0:
+            assert msg is not None, (
+                f"walker classified bytes upb rejects: {data.hex()}")
+            kind = verdict & 0x07
+            which = msg.WhichOneof("request")
+            assert which == _ONEOF_BY_KIND.get(kind), (data.hex(), which)
+            if verdict & wire.PAYLOAD_BIT and kind in (3, 6):
+                body = (msg.request_body if kind == 3
+                        else msg.response_body).body
+                assert data[off:off + length] == body, data.hex()
+            checked += 1
+        # FALLBACK makes no claim: upb may accept or reject.
+    assert checked > 100, "mutation run went vacuous"
+
+
+# --------------------------------------------------------------------------
+# 4. Worker pool: graceful drain
+# --------------------------------------------------------------------------
+
+
+def test_worker_pool_graceful_drain_finishes_inflight_stream():
+    import grpc
+
+    from gie_tpu.extproc.workers import ExtProcWorkerPool
+    from gie_tpu.runtime import metrics as own_metrics
+
+    aborted = []
+    server = StreamingServer(make_ds(), RoundRobinPicker(), fast_lane=True,
+                             on_stream_aborted=lambda ctx: aborted.append(ctx))
+    pool = ExtProcWorkerPool(server, 2, wire=True)
+    port = pool.bind("127.0.0.1:0")
+    pool.start()
+    streams_before = own_metrics.REGISTRY.get_sample_value(
+        "gie_active_streams") or 0.0
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    process = channel.stream_stream(
+        "/envoy.service.ext_proc.v3.ExternalProcessor/Process",
+        request_serializer=pb.ProcessingRequest.SerializeToString,
+        response_deserializer=pb.ProcessingResponse.FromString)
+
+    feed: queue.Queue = queue.Queue()
+
+    def requests():
+        while True:
+            item = feed.get()
+            if item is None:
+                return
+            yield item
+
+    call = process(requests())
+    # Open the stream mid-request: headers in, body still pending. The
+    # deferred headers frame produces no response yet, so wait for the
+    # in-process active-streams gauge to show the accepted stream (no
+    # initial metadata flows before the first response message).
+    feed.put(headers_msg(REQUEST_HEADERS, end_of_stream=False))
+    deadline = time.monotonic() + 5.0
+    while (own_metrics.REGISTRY.get_sample_value("gie_active_streams")
+           or 0.0) <= streams_before:
+        assert time.monotonic() < deadline, "stream never accepted"
+        time.sleep(0.01)
+
+    stopped = pool.stop(grace=10.0)
+    # The drain must NOT cut the in-flight stream: finish the whole
+    # request AND response phase (response headers seen = the served
+    # feedback fired normally, so no aborted-stream release is owed).
+    responses = []
+    try:
+        feed.put(body_msg(COMPLETION))
+        responses.append(next(call))  # deferred headers response
+        responses.append(next(call))  # body passthrough
+        feed.put(pb.ProcessingRequest(response_headers=pb.HttpHeaders()))
+        responses.append(next(call))
+        feed.put(pb.ProcessingRequest(response_body=pb.HttpBody(
+            body=b"done", end_of_stream=True)))
+        responses.append(next(call))
+    finally:
+        feed.put(None)
+    assert responses[0].HasField("request_headers")
+    assert responses[1].HasField("request_body")
+    assert responses[2].HasField("response_headers")
+    assert responses[3].HasField("response_body")
+    assert stopped.wait(15), "drain never completed"
+    channel.close()
+
+    assert aborted == [], "drain aborted an in-flight stream"
+    streams_after = own_metrics.REGISTRY.get_sample_value(
+        "gie_active_streams") or 0.0
+    assert streams_after == streams_before, (
+        "active-stream charge leaked across the drain")
+    # New RPCs are refused once draining.
+    ch2 = grpc.insecure_channel(f"127.0.0.1:{port}")
+    proc2 = ch2.stream_stream(
+        "/envoy.service.ext_proc.v3.ExternalProcessor/Process",
+        request_serializer=pb.ProcessingRequest.SerializeToString,
+        response_deserializer=pb.ProcessingResponse.FromString)
+    with pytest.raises(grpc.RpcError):
+        list(proc2(iter([headers_msg(REQUEST_HEADERS)])))
+    ch2.close()
+
+
+def test_worker_pool_rejects_second_bind_and_bad_worker_count():
+    from gie_tpu.extproc.workers import ExtProcWorkerPool
+
+    server = StreamingServer(make_ds(), RoundRobinPicker(), fast_lane=True)
+    with pytest.raises(ValueError):
+        ExtProcWorkerPool(server, 0)
+    pool = ExtProcWorkerPool(server, 1)
+    pool.bind("127.0.0.1:0")
+    with pytest.raises(RuntimeError):
+        pool.bind("127.0.0.1:0")
+    pool.stop(grace=0).wait(5)

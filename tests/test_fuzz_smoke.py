@@ -7,10 +7,10 @@ GIE_FUZZ_SECS seconds (default 30, the acceptance bound; CI can dial it
 down). A sanitizer finding aborts the binary non-zero and fails the
 test with the tail of its stderr.
 
-Slow tier: three libraries x the budget is ~90 s wall. Tier-1 still
+Slow tier: four libraries x the budget is ~120 s wall. Tier-1 still
 covers the native code through the parity suites (test_fieldscan,
-test_promparse_native, test_native); this module is the memory-safety
-layer on top.
+test_promparse_native, test_native, test_extproc_wirelane); this module
+is the memory-safety layer on top.
 """
 
 import os
@@ -26,7 +26,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE = os.path.join(REPO, "native")
 FUZZ_SECS = os.environ.get("GIE_FUZZ_SECS", "30")
 
-LIBS = ["jsonscan", "promparse", "chunker"]
+LIBS = ["jsonscan", "promparse", "chunker", "pbwalk"]
 
 
 @pytest.fixture(scope="module")

@@ -104,6 +104,54 @@ def test_default_ranks_load_the_repo_hierarchy():
 # --------------------------------------------------------------------------
 
 
+def test_worker_pool_lifecycle_lock_stays_off_the_dispatch_path():
+    """gie-wire: drive real streams through a 2-worker SO_REUSEPORT pool
+    with the pool's lifecycle lock and the datastore lock tracked. The
+    declared contract (lockorder.toml rank 18) is that the pool lock
+    guards bind/start/stop only — so no nesting involving it may ever be
+    observed, in either direction, while traffic flows."""
+    import grpc
+
+    from gie_tpu.extproc import pb
+    from gie_tpu.extproc.server import StreamingServer
+    from gie_tpu.extproc.workers import ExtProcWorkerPool
+    from tests.test_extproc import RoundRobinPicker, make_ds
+
+    POOL_LOCK = "gie_tpu.extproc.workers.ExtProcWorkerPool._lock"
+    DS_LOCK = "gie_tpu.datastore.datastore.Datastore._lock"
+
+    ds = make_ds()
+    streaming = StreamingServer(ds, RoundRobinPicker(), fast_lane=True)
+    pool = ExtProcWorkerPool(streaming, 2, wire=True)
+    tracker = LockTracker(ranks=default_ranks())
+    tracker.wrap(pool, "_lock", POOL_LOCK)
+    tracker.wrap(ds, "_lock", DS_LOCK)
+
+    port = pool.bind("127.0.0.1:0")
+    pool.start()
+    try:
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        process = channel.stream_stream(
+            "/envoy.service.ext_proc.v3.ExternalProcessor/Process",
+            request_serializer=pb.ProcessingRequest.SerializeToString,
+            response_deserializer=pb.ProcessingResponse.FromString)
+        req = pb.ProcessingRequest()
+        req.request_headers.headers.headers.add(
+            key=":path", raw_value=b"/v1/completions")
+        req.request_headers.end_of_stream = True
+        for _ in range(10):
+            assert len(list(process(iter([req])))) == 1
+        channel.close()
+    finally:
+        pool.stop(grace=2.0).wait(5)
+
+    tracker.assert_consistent()
+    for outer, inner in tracker.observed():
+        assert POOL_LOCK not in (outer, inner), (
+            f"pool lifecycle lock nested with {outer!r}/{inner!r} — the "
+            "accept/dispatch path must stay lock-free")
+
+
 def test_engine_store_traffic_matches_declared_hierarchy():
     from gie_tpu.metricsio.engine import ScrapeEngine
     from gie_tpu.metricsio.mappings import BY_NAME

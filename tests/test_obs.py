@@ -666,12 +666,14 @@ def test_obs_check_catches_bad_metrics():
 
 
 def test_build_info_gauge():
-    own_metrics.set_build_info(fast_lane=True, resilience=True, obs=False)
+    own_metrics.set_build_info(fast_lane=True, resilience=True, obs=False,
+                               wire=True, workers=2)
     from gie_tpu.version import __version__
 
     assert own_metrics.REGISTRY.get_sample_value("gie_build_info", {
         "version": __version__, "fast_lane": "true",
-        "resilience": "true", "obs": "false"}) == 1.0
+        "resilience": "true", "obs": "false",
+        "wire": "true", "workers": "2"}) == 1.0
 
 
 def test_logging_trace_enabled_accessor():
