@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke storm-search-smoke test test-unit test-conformance bench bench-mesh bench-goodput bench-scrape bench-extproc bench-cpu cost release clean
+.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke storm-search-smoke learn-ci test test-unit test-conformance bench bench-mesh bench-goodput bench-scrape bench-extproc bench-cpu cost release clean
 
 all: native generate
 
@@ -84,6 +84,17 @@ storm-smoke: storm-ci
 storm-search-smoke:
 	$(PY) -m pytest tests/test_storm_search.py -q
 
+# gie-learn gate (docs/LEARNED.md "CI gate"): retrain the policy from
+# the checked-in fixture dump and require the committed artifact's
+# weight BITS back (same dump + seed => byte-identical), then race it
+# against the tuned heuristic through the virtual-clock twin on the
+# storm-learn-judge deep-overload gauntlet + the fixture trace replay
+# and require the PROMOTE verdict at the committed schedule
+# fingerprints. Deterministic end to end — a failure is a trainer,
+# dataset, or scheduling regression, never flake.
+learn-ci:
+	$(PY) hack/learn_ci.py
+
 # CRD manifests (reference `make generate`).
 generate:
 	$(PY) -m gie_tpu.api.crdgen config/crd/bases
@@ -95,7 +106,7 @@ generate:
 # before the full suite. The chaos/storm files are excluded from the
 # main sweep — chaos-ci/storm-ci already ran them (the slow soaks live
 # in chaos-smoke/storm-smoke, not here).
-test: lint obs-check chaos-ci storm-ci
+test: lint obs-check chaos-ci storm-ci learn-ci
 	$(PY) -m pytest tests/ -q --ignore=tests/test_scenarios.py --ignore=tests/test_chaos.py --ignore=tests/test_storm.py --ignore=tests/test_storm_search.py
 
 test-unit: lint obs-check

@@ -1,0 +1,21 @@
+"""gie-learn: offline-trained multiplicative scheduling policies.
+
+The pieces, in data-flow order:
+
+- `dataset.py`  — flight-recorder dumps -> feature matrices + targets,
+  train/eval split keyed by schedule fingerprint (no eval leakage).
+- `train.py`    — seeded closed-form trainer (CPU-fine JAX/numpy); the
+  same dump + seed always produces byte-identical artifact bytes.
+- `policy.py`   — the runtime form: exp(sum_s w_s * log(col_s)), one
+  fused elementwise op over the existing scorer columns, slotted into
+  `sched.profile.build_stages` behind ProfileConfig.scorer="learned".
+- `artifact.py` — the versioned, checksummed policy artifact the runner
+  loads via --policy-artifact and validates against the live feature
+  schema at startup.
+- `judge.py`    — head-to-head promotion through the virtual-clock twin:
+  learned vs heuristic on identical storm seeds and replayed traces,
+  verdict gated on goodput/SLO/p99 no-regression.
+
+The heuristic weighted-sum blend remains the untouched default; nothing
+in this package runs unless the operator opts in.
+"""

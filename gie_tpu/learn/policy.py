@@ -1,0 +1,102 @@
+"""The multiplicative learned scorer — runtime form and serialization.
+
+Following "Simple is Better: Multiplication May Be All You Need for LLM
+Request Scheduling" (PAPERS.md): the learned score is a product of the
+existing normalized scorer columns raised to trained exponents,
+
+    total = prod_s col_s ** w_s  =  exp(sum_s w_s * log(max(col_s, EPS)))
+
+computed in log space so it lowers to one fused elementwise multiply-add
+chain over the already-stacked [S, N, M] columns — a drop-in for the
+weighted-sum blend at the same seam in build_stages, with the SAME
+dynamic `Weights` scalars (retuning or hot-swapping a trained artifact
+never recompiles).
+
+Bitwise discipline (the PR 15 rule, applied here): the log-space sum
+uses the SAME ``einsum("s,snm->nm", ...)`` idiom as the heuristic blend,
+so the single-device and mesh-sharded jitted programs compile one
+formula — shards split N/M, never S, and the mesh parity matrix pins
+the learned cycle bit-identical across mesh sizes. Across COMPILATION
+boundaries (eager per-op vs one fused jit, XLA vs numpy libm) bitwise
+equality is not a real property of ANY fused float formula — XLA
+rewrites exp(a)*exp(b) into exp(a+b) and contracts multiply-adds into
+FMAs inside fusions — so the numpy reference below pins the algebra
+with a measured ULP bound instead (tests/test_learn.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Floor applied inside the log: scorer columns are normalized to [0, 1]
+# and 0.0 is a legitimate "worst" value; log(EPS) ~= -13.8 keeps the
+# exponentiated product at a representable, strictly-positive minimum so
+# masked-out comparisons downstream behave exactly like the blend's.
+EPS = np.float32(1e-6)
+
+
+def multiplicative_total(stacked: jax.Array, wvec: jax.Array) -> jax.Array:
+    """exp(sum_s w_s * log(max(col_s, EPS))) over stacked [S, N, M].
+
+    Pure and jittable; S must be static (it always is — the column set
+    is baked into the trace by ProfileConfig). The contraction mirrors
+    the heuristic blend's einsum exactly, so the sharded cycle treats
+    both scorers identically (the mesh splits N/M; the S reduction is
+    shard-local either way).
+    """
+    logs = jnp.log(jnp.maximum(stacked, jnp.float32(EPS)))
+    return jnp.exp(jnp.einsum("s,snm->nm", wvec, logs))
+
+
+def multiplicative_total_reference(
+    stacked: np.ndarray, wvec: np.ndarray
+) -> np.ndarray:
+    """Plain-numpy reference of multiplicative_total for tests and the
+    trainer: same algebra, float32 intermediates, left-to-right fold.
+
+    numpy libm and a fused XLA program differ in the last ULPs of
+    transcendental chains (see the module docstring), so this reference
+    is compared with an ULP bound, not ==; the bitwise claims live where
+    they are real — same-formula jit vs jit across mesh shardings.
+    """
+    stacked = np.asarray(stacked, dtype=np.float32)
+    wvec = np.asarray(wvec, dtype=np.float32)
+    acc = (wvec[0] * np.log(np.maximum(stacked[0], EPS))).astype(np.float32)
+    for s in range(1, stacked.shape[0]):
+        term = wvec[s] * np.log(np.maximum(stacked[s], EPS))
+        acc = (acc + term).astype(np.float32)
+    return np.exp(acc).astype(np.float32)
+
+
+def float32_hex(value: float) -> str:
+    """Little-endian IEEE-754 float32 bytes as hex — the bitwise-stable
+    wire form of a trained weight (json floats round-trip through decimal
+    repr; this never does)."""
+    return np.array(value, dtype="<f4").tobytes().hex()
+
+
+def float32_from_hex(hexed: str) -> np.float32:
+    """Inverse of float32_hex."""
+    raw = bytes.fromhex(hexed)
+    if len(raw) != 4:
+        raise ValueError(f"float32 hex must be 8 hex chars (got {hexed!r})")
+    return np.frombuffer(raw, dtype="<f4")[0]
+
+
+def weights_from_mapping(mapping: dict[str, float]):
+    """Build a sched Weights struct from a {column_name: exponent} dict
+    (the artifact's weight table). Columns absent from the mapping get
+    0.0 — in the multiplicative form col**0 == 1, a clean no-op."""
+    import dataclasses
+
+    from gie_tpu.sched.types import Weights
+
+    fields = {f.name for f in dataclasses.fields(Weights)}
+    unknown = set(mapping) - fields
+    if unknown:
+        raise ValueError(
+            f"unknown scorer columns in policy weights: {sorted(unknown)}")
+    kwargs = {name: np.float32(mapping.get(name, 0.0)) for name in fields}
+    return Weights(**kwargs)

@@ -33,6 +33,9 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
+import threading
+import time
 from typing import Optional
 
 # Record-schema version, stamped as ``v`` on every published record.
@@ -44,7 +47,7 @@ from typing import Optional
 SCHEMA_VERSION = 1
 
 
-def load_records(text: str) -> list[dict]:
+def load_records(text: str, stats: Optional[dict] = None) -> list[dict]:
     """Tolerant loader for flight-recorder dumps (export_json /
     obs.dump_artifact artifacts): accepts a bare record list or a
     ``{"records": [...]}`` envelope, keeps unknown fields verbatim, and
@@ -52,7 +55,21 @@ def load_records(text: str) -> list[dict]:
     dumps (no ``v``) are stamped ``v: 0``, future-version records are
     kept as-is rather than dropped (the consumer decides what of a newer
     record it understands; a trainer that crashed on a new field would
-    rot every archived dump the day the schema grew one)."""
+    rot every archived dump the day the schema grew one).
+
+    Tolerance is COUNTED, never silent: pass ``stats`` (any dict) and
+    the loader increments a reason key per tolerated entry —
+    ``junk_entry`` for non-dict list items, ``unversioned`` for records
+    missing a schema version. Records a serve outcome never closed
+    (abort/5xx cleared or never wrote ``served``) load fine here; it is
+    the CONSUMER's job to skip them with its own counted reason
+    (gie_tpu/learn/dataset.py does exactly that) rather than KeyError on
+    the missing field."""
+
+    def _count(reason: str) -> None:
+        if stats is not None:
+            stats[reason] = stats.get(reason, 0) + 1
+
     raw = json.loads(text)
     if isinstance(raw, dict):
         raw = raw.get("records", [])
@@ -63,8 +80,10 @@ def load_records(text: str) -> list[dict]:
     out: list[dict] = []
     for rec in raw:
         if not isinstance(rec, dict):
+            _count("junk_entry")
             continue  # tolerate-unknown: skip non-record junk entries
         if not isinstance(rec.get("v"), int):
+            _count("unversioned")
             rec = {**rec, "v": 0}
         out.append(rec)
     return out
@@ -127,3 +146,81 @@ class FlightRecorder:
         GL002 blocking set: serialization is I/O-scale work and must
         never run under a declared lock (the pick lock above all)."""
         return json.dumps(self.snapshot(n), default=str)
+
+
+class DumpRotator:
+    """Periodic flight-recorder harvesting with a bounded file budget —
+    gie-learn's training feed (--obs-dump-interval-s, docs/LEARNED.md).
+
+    Each :meth:`rotate_once` snapshots the installed recorder into
+    ``directory/<name>-<seq>.json`` (the same envelope shape
+    obs.dump_artifact writes, so gie_tpu.learn.dataset loads both), then
+    prunes the oldest rotation files beyond ``keep``. The lock guards
+    ONLY the sequence counter — callers race from the runner's rotation
+    thread and ad-hoc harvests (tests, a future zpage action) — while
+    every snapshot/serialize/unlink happens OUTSIDE it, per the GL002
+    rule that recorder export I/O never runs under a declared lock.
+    """
+
+    def __init__(self, directory: str, *, keep: int = 8,
+                 name: str = "rotation", clock=None):
+        if keep < 1:
+            raise ValueError("dump rotation keep must be >= 1")
+        self.directory = directory
+        self.keep = keep
+        self.name = name
+        self._clock = clock
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        return seq
+
+    def rotation_files(self) -> list[str]:
+        """This rotator's dump files, oldest first (zero-padded sequence
+        numbers make name order == age order). Other artifacts in the
+        directory — chaos-scenario dumps, foreign rotators — are never
+        listed, so they can never be pruned by this one."""
+        prefix = f"{self.name}-"
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        return sorted(
+            os.path.join(self.directory, n) for n in names
+            if n.startswith(prefix) and n.endswith(".json"))
+
+    def rotate_once(self, recorder=None) -> Optional[str]:
+        """Dump one snapshot and prune; returns the written path, or
+        None when no recorder is installed or the write failed (the
+        rotation thread rides shutdown-adjacent paths — it logs through
+        its caller, never raises)."""
+        from gie_tpu import obs
+
+        rec = recorder if recorder is not None else obs.RECORDER
+        if rec is None:
+            return None
+        seq = self._next_seq()
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            path = os.path.join(
+                self.directory, f"{self.name}-{seq:08d}.json")
+            payload = {
+                "name": f"{self.name}-{seq:08d}",
+                "written_at": (self._clock() if self._clock is not None
+                               else time.time()),
+                "records": rec.snapshot(),
+            }
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, indent=1, default=str)
+            for stale in self.rotation_files()[:-self.keep]:
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass  # pruned by a racing rotate, or perms — skip
+            return path
+        except (OSError, ValueError):
+            return None

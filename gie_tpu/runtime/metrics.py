@@ -7,6 +7,8 @@ pick counts/latency, shed/unavailable counts, batch sizes, assumed load.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import prometheus_client as prom
 
 REGISTRY = prom.CollectorRegistry()
@@ -416,6 +418,40 @@ FED_DRAINING = prom.Gauge(
     "1 while THIS cluster is draining its traffic to peers, else 0",
     registry=REGISTRY,
 )
+
+
+# gie-learn (gie_tpu/learn, docs/LEARNED.md): scorer identity. Same
+# constant-1 info idiom as gie_build_info — which scorer this replica
+# runs, which trained artifact (if any) backs it, and the live blend
+# exponents, joinable onto goodput/SLO series during a policy rollout.
+POLICY_INFO = prom.Gauge(
+    "gie_policy_info",
+    "Constant 1 with scheduling-policy identity labels: active scorer "
+    "kind (blend|learned), the loaded policy artifact's schema version/"
+    "checksum/trained-at (empty for the heuristic), and the live blend "
+    "weights as name=value pairs",
+    ["scorer", "artifact_schema", "checksum", "trained_at", "weights"],
+    registry=REGISTRY,
+)
+
+
+def set_policy_info(scorer: str, weights: dict,
+                    artifact: Optional[dict] = None) -> None:
+    """Stamp the constant-1 policy-identity series (runner startup).
+
+    ``weights`` is {column: float} — the LIVE values the cycle blends,
+    whatever their provenance (tuned profile, --scheduler-config, or a
+    learned artifact's exponents)."""
+    prov = (artifact or {}).get("provenance", {})
+    POLICY_INFO.labels(
+        scorer=str(scorer),
+        artifact_schema=str((artifact or {}).get("schema", "")),
+        checksum=str((artifact or {}).get("checksum", "")),
+        trained_at=str(prov.get("trained_at", "")),
+        weights=",".join(
+            f"{name}={float(val):g}" for name, val in sorted(
+                weights.items())),
+    ).set(1)
 
 
 def set_build_info(fast_lane: bool, resilience: bool, obs: bool,

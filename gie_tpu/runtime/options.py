@@ -220,6 +220,23 @@ class Options:
     # Where --fault-scenario runs (and failed chaos tests) dump the
     # flight-recorder JSON artifact.
     obs_dump_dir: str = "/tmp/gie-obs"
+    # Periodic flight-recorder harvesting (gie-learn's training feed,
+    # docs/LEARNED.md): every interval the recorder ring is dumped into
+    # --obs-dump-dir as a rotation-numbered JSON file, keeping at most
+    # --obs-dump-keep files (oldest deleted first). 0 = no rotation
+    # thread at all (the default; chaos dumps are unaffected).
+    obs_dump_interval_s: float = 0.0
+    obs_dump_keep: int = 8
+    # gie-learn (gie_tpu/learn, docs/LEARNED.md): which scorer the cycle
+    # blends. "blend" is the heuristic weighted sum (the production
+    # default, byte-identical to the pre-learn path); "learned" is the
+    # offline-trained multiplicative policy and requires
+    # --policy-artifact.
+    scorer: str = "blend"
+    # Trained policy artifact (gie-learn-policy/1 JSON): checksum-
+    # verified and schema-validated against the live profile's feature
+    # columns at startup — a stale artifact fails fast, never scores.
+    policy_artifact: str = ""
     # OTLP span export (gie_tpu/obs/otlp.py, docs/OBSERVABILITY.md):
     # exported traces additionally POST as OTLP/HTTP JSON spans to
     # <endpoint>/v1/traces, batched on a background thread — never the
@@ -543,6 +560,27 @@ class Options:
         parser.add_argument("--obs-dump-dir", default=d.obs_dump_dir,
                             help="directory for chaos-scenario flight-"
                                  "recorder JSON artifacts")
+        parser.add_argument("--obs-dump-interval-s", type=float,
+                            default=d.obs_dump_interval_s,
+                            help="periodic flight-recorder dump rotation "
+                                 "into --obs-dump-dir (gie-learn's "
+                                 "training feed); 0 = off")
+        parser.add_argument("--obs-dump-keep", type=int,
+                            default=d.obs_dump_keep,
+                            help="rotation bound: at most this many "
+                                 "periodic dump files kept (oldest "
+                                 "deleted first)")
+        parser.add_argument("--scorer", default=d.scorer,
+                            choices=("blend", "learned"),
+                            help="cycle scorer: the heuristic weighted-"
+                                 "sum blend (default) or the gie-learn "
+                                 "multiplicative policy (needs "
+                                 "--policy-artifact)")
+        parser.add_argument("--policy-artifact", default=d.policy_artifact,
+                            metavar="PATH",
+                            help="trained gie-learn-policy/1 artifact "
+                                 "(checksum-verified, feature schema "
+                                 "validated at startup)")
         parser.add_argument("--obs-tenant-sample", action="append",
                             default=[], dest="obs_tenant_sample",
                             metavar="TENANT=RATE",
@@ -687,6 +725,10 @@ class Options:
             obs_slow_ms=args.obs_slow_ms,
             obs_tenant_sample=list(args.obs_tenant_sample),
             obs_dump_dir=args.obs_dump_dir,
+            obs_dump_interval_s=args.obs_dump_interval_s,
+            obs_dump_keep=args.obs_dump_keep,
+            scorer=args.scorer,
+            policy_artifact=args.policy_artifact,
             obs_otlp_endpoint=args.obs_otlp_endpoint,
             fed_cluster=args.fed_cluster,
             fed_peers=list(args.fed_peers),
@@ -868,6 +910,26 @@ class Options:
             raise ValueError("--obs-ring must be >= 1")
         if self.obs_slow_ms <= 0:
             raise ValueError("--obs-slow-ms must be > 0")
+        if self.obs_dump_interval_s < 0:
+            raise ValueError("--obs-dump-interval-s must be >= 0")
+        if self.obs_dump_interval_s > 0:
+            if not self.obs:
+                raise ValueError(
+                    "--obs-dump-interval-s needs the flight recorder "
+                    "(drop --no-obs)")
+            if self.obs_dump_keep < 1:
+                raise ValueError("--obs-dump-keep must be >= 1")
+        if self.scorer not in ("blend", "learned"):
+            raise ValueError(
+                f"--scorer {self.scorer!r} must be blend|learned")
+        if self.scorer == "learned" and not self.policy_artifact:
+            raise ValueError(
+                "--scorer learned requires --policy-artifact (a "
+                "gie-learn-policy/1 file; see docs/LEARNED.md)")
+        if self.policy_artifact and self.scorer != "learned":
+            raise ValueError(
+                "--policy-artifact is only read with --scorer learned "
+                "(refusing to silently ignore a trained policy)")
         if self.pd_budget_floor_ms < 0:
             raise ValueError("--pd-budget-floor-ms must be >= 0")
         for spec in self.objectives:

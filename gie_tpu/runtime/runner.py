@@ -51,6 +51,10 @@ class ExtProcServerRunner:
         self.cluster = cluster
         self.lora_registry = LoraRegistry()
         self.trainer = None
+        # gie-learn (gie_tpu/learn, docs/LEARNED.md): the loaded policy
+        # artifact, when --scorer learned; None on the heuristic path
+        # (and with an injected scheduler — tests own that config).
+        self.policy_artifact = None
         if scheduler is not None:
             self.scheduler = scheduler
         else:
@@ -64,6 +68,36 @@ class ExtProcServerRunner:
             cfg, weights = tuned_profile()
             if opts.scheduler_config:
                 cfg, weights = load_scheduler_config_file(opts.scheduler_config)
+            if opts.scorer == "learned":
+                # Trained multiplicative policy (docs/LEARNED.md):
+                # checksum-verified, feature schema validated against
+                # THIS profile's live columns — a stale artifact fails
+                # startup loudly, never scores silently wrong. The
+                # artifact's exponents REPLACE the blend weights
+                # wholesale (absent columns ride at 0.0 = multiplicative
+                # no-op), and the blend itself swaps via the static
+                # ProfileConfig.scorer field.
+                import dataclasses
+
+                from gie_tpu.learn import artifact as artifact_mod
+                from gie_tpu.learn.policy import weights_from_mapping
+                from gie_tpu.sched.profile import feature_schema
+
+                art = artifact_mod.load_artifact(opts.policy_artifact)
+                artifact_mod.validate_feature_schema(
+                    art, feature_schema(
+                        cfg, has_predictor=opts.enable_predictor))
+                cfg = dataclasses.replace(cfg, scorer="learned")
+                weights = weights_from_mapping(
+                    artifact_mod.artifact_weight_values(art))
+                self.policy_artifact = art
+                self.log.info(
+                    "learned policy loaded",
+                    path=opts.policy_artifact,
+                    checksum=art["checksum"],
+                    columns=list(art["feature_schema"]),
+                    promoted=bool(
+                        (art.get("judgment") or {}).get("promote")))
             predictor_fn = predictor_params = None
             if opts.enable_predictor:
                 # Learned TTFT column with online training (configs[3]);
@@ -296,6 +330,7 @@ class ExtProcServerRunner:
         own_metrics.register_pool_aggregates(self._pool_snapshot)
         self._train_stop = threading.Event()
         self._train_thread: Optional[threading.Thread] = None
+        self._dump_thread: Optional[threading.Thread] = None
         self.elector = None
         # With replication enabled, the elector's holder identity carries
         # this replica's advertised digest address — the Lease doubles as
@@ -565,6 +600,7 @@ class ExtProcServerRunner:
             "datastore": lambda q: self.datastore.debug_report(),
             "scheduler": lambda q: self.scheduler.debug_report(),
             "drain": drain,
+            "policy": lambda q: self._policy_report(),
             "buildinfo": lambda q: {
                 "version": __version__,
                 "fast_lane": self.opts.extproc_fast_lane,
@@ -589,6 +625,37 @@ class ExtProcServerRunner:
             providers["federation"] = (
                 lambda q: self.fed_exchange.report())
         return providers
+
+    def _policy_report(self) -> dict:
+        """/debugz/policy (docs/LEARNED.md): which scorer this replica
+        runs, the LIVE blend/exponent weights the cycle reads, and —
+        with --scorer learned — the loaded artifact's identity,
+        provenance, and promotion verdict. Mirrors gie_policy_info; the
+        zpage carries the detail the bounded label set cannot."""
+        import dataclasses
+
+        w = self.scheduler.weights
+        report = {
+            "scorer": getattr(self.scheduler.cfg, "scorer", "blend"),
+            "weights": {
+                f.name: float(getattr(w, f.name))
+                for f in dataclasses.fields(type(w))},
+        }
+        art = self.policy_artifact
+        if art is not None:
+            judgment = art.get("judgment") or {}
+            report["artifact"] = {
+                "path": self.opts.policy_artifact,
+                "schema": art.get("schema"),
+                "checksum": art.get("checksum"),
+                "feature_schema": list(art.get("feature_schema", ())),
+                "provenance": art.get("provenance", {}),
+                "judgment_promote": judgment.get("promote"),
+                "judgment_scenarios": [
+                    {"name": row.get("name"), "passed": row.get("passed")}
+                    for row in judgment.get("scenarios", [])],
+            }
+        return report
 
     def _autoscale_ttft_probe(self):
         """-> (predicted_ttft_s, ttft_slo_s) for the autoscale capacity
@@ -751,6 +818,17 @@ class ExtProcServerRunner:
             resilience=self.opts.resilience,
             obs=self._obs_installed,
             wire=wire_lane, workers=self.opts.extproc_workers)
+        # gie_policy_info (docs/LEARNED.md): scorer identity, stamped
+        # from the SAME live weights the cycle blends — dashboards can
+        # join goodput series against the policy that produced them.
+        import dataclasses as _dc
+
+        _w = self.scheduler.weights
+        own_metrics.set_policy_info(
+            scorer=getattr(self.scheduler.cfg, "scorer", "blend"),
+            weights={f.name: float(getattr(_w, f.name))
+                     for f in _dc.fields(type(_w))},
+            artifact=self.policy_artifact)
         try:
             self.debugz_server = own_metrics.start_metrics_server(
                 self.opts.metrics_port,
@@ -806,6 +884,31 @@ class ExtProcServerRunner:
                 target=self._train_loop, daemon=True
             )
             self._train_thread.start()
+        if self.opts.obs_dump_interval_s > 0 and self._obs_installed:
+            # Periodic flight-recorder harvesting (--obs-dump-interval-s,
+            # docs/LEARNED.md): gie-learn's training feed. The rotator
+            # bounds the file count itself; the thread holds no gie_tpu
+            # lock across the dump (GL002 — export I/O is in the
+            # blocking set).
+            from gie_tpu.obs.recorder import DumpRotator
+
+            rotator = DumpRotator(self.opts.obs_dump_dir,
+                                  keep=self.opts.obs_dump_keep)
+
+            def _dump_loop():
+                while not self._stopped.wait(self.opts.obs_dump_interval_s):
+                    path = rotator.rotate_once()
+                    if path:
+                        self.log.v(3).info("flight recorder rotated",
+                                           path=path)
+
+            self._dump_thread = threading.Thread(
+                target=_dump_loop, daemon=True)
+            self._dump_thread.start()
+            self.log.info("obs dump rotation started",
+                          dir=self.opts.obs_dump_dir,
+                          interval_s=self.opts.obs_dump_interval_s,
+                          keep=self.opts.obs_dump_keep)
         if self.autoscaler is not None:
             self.autoscaler.start()
             self.log.info(
@@ -879,6 +982,10 @@ class ExtProcServerRunner:
         self._train_stop.set()
         if self._train_thread is not None:
             self._train_thread.join(timeout=5)
+        if self._dump_thread is not None:
+            # _stopped is already set; the wait()-gated loop exits on
+            # its next wake.
+            self._dump_thread.join(timeout=5)
         if self.grpc_server is not None:
             self.grpc_server.stop(grace).wait()
         if self.health_server is not None:

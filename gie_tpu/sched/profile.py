@@ -92,6 +92,13 @@ class ProfileConfig:
     # VMEM-resident pallas loop for the sinkhorn iterations (same default-
     # off rationale).
     use_pallas_sinkhorn: bool = False
+    # How the scorer columns combine into the total ("blend" = the
+    # normalized weighted sum that has always been the default; "learned" =
+    # the gie-learn multiplicative policy exp(sum w*log(col)) — one fused
+    # elementwise op, weights trained offline by gie_tpu/learn/train.py).
+    # Static so each form is its own trace; the weights stay dynamic either
+    # way, so swapping a trained artifact in never recompiles.
+    scorer: str = "blend"
 
     def __post_init__(self) -> None:
         # The noise temperatures are what guarantee pairwise-distinct
@@ -109,6 +116,23 @@ class ProfileConfig:
                 f"sinkhorn_rounding_temp must be > 0 (got "
                 f"{self.sinkhorn_rounding_temp}): zero noise permits "
                 "exact score ties, which truncate the fallback list")
+        if self.scorer not in ("blend", "learned"):
+            raise ValueError(
+                f"scorer must be 'blend' or 'learned' (got {self.scorer!r})")
+        if self.scorer == "learned" and self.use_pallas_topk:
+            # fused_blend_topk recomputes the WEIGHTED-SUM blend from
+            # (stacked, wvec) inside the kernel — it would silently ignore
+            # a multiplicative total. Reject rather than mis-route.
+            raise ValueError(
+                "scorer='learned' is incompatible with use_pallas_topk: "
+                "the fused kernel hard-codes the weighted-sum blend")
+        if self.scorer == "learned" and self.pd_disaggregation:
+            # _pd_cycle arithmetically de-blends the total (total*wsum -
+            # dropped columns) / remaining-wsum — only valid for the linear
+            # blend. The dual-pick learned form is future work.
+            raise ValueError(
+                "scorer='learned' is incompatible with pd_disaggregation: "
+                "the dual pick de-blends the linear total arithmetically")
 
 
 def request_cost(reqs: RequestBatch) -> jax.Array:
@@ -142,6 +166,24 @@ def pd_costs_host(prompt_len: float, decode_len: float) -> tuple[float, float]:
         float(np.clip(prompt_len / 2048.0, 0.125, 8.0)),
         float(np.clip(decode_len / 2048.0, 0.125, 8.0)),
     )
+
+
+def feature_schema(
+    cfg: ProfileConfig, *, has_predictor: bool = False
+) -> tuple[str, ...]:
+    """Ordered names of the scorer columns build_stages will stack for this
+    config — the ONE source of truth a gie-learn policy artifact is
+    validated against at load time (insertion order of `named` below)."""
+    cols = ["queue", "kv_cache", "assumed_load"]
+    if cfg.enable_prefix:
+        cols.append("prefix")
+    if cfg.enable_session:
+        cols.append("session")
+    if cfg.enable_lora:
+        cols.append("lora")
+    if has_predictor:
+        cols.append("latency")
+    return tuple(cols)
 
 
 def build_stages(
@@ -211,9 +253,14 @@ def build_stages(
 
     stacked = jnp.stack(list(named.values()))       # [S, N, M]
     wvec = jnp.stack([getattr(weights, k) for k in named])  # [S]
-    total = jnp.einsum("s,snm->nm", wvec, stacked) / jnp.maximum(
-        jnp.sum(wvec), jnp.float32(1e-6)
-    )
+    if cfg.scorer == "learned":
+        from gie_tpu.learn.policy import multiplicative_total
+
+        total = multiplicative_total(stacked, wvec)
+    else:
+        total = jnp.einsum("s,snm->nm", wvec, stacked) / jnp.maximum(
+            jnp.sum(wvec), jnp.float32(1e-6)
+        )
     return mask, shed, named, stacked, wvec, total
 
 

@@ -198,6 +198,13 @@ class EngineConfig:
     # that many devices (the CPU dryrun's virtual chips in CI). 0/1 =
     # the classic single-device scheduler.
     mesh_devices: int = 0
+    # gie-learn (docs/LEARNED.md): "learned" swaps the profile's total
+    # to the multiplicative policy, with the trained exponents from
+    # policy_weights ((name, float32-hex) pairs — hashable, bit-exact;
+    # empty keeps the tuned heuristic Weights). Defaults preserve every
+    # pinned pre-learn decision fingerprint.
+    scorer: str = "blend"
+    policy_weights: tuple = ()
 
     def fast_ladder(self) -> LadderConfig:
         return LadderConfig(
@@ -497,6 +504,17 @@ class StormEngine:
         prof, weights = tuned_profile()
         prof = dataclasses.replace(
             prof, queue_limit=cfg.queue_limit, kv_limit=cfg.kv_limit)
+        if cfg.scorer != "blend":
+            # gie-learn judge path: the multiplicative scorer with the
+            # trained exponents (bit-exact from their float32 hex form).
+            prof = dataclasses.replace(prof, scorer=cfg.scorer)
+            if cfg.policy_weights:
+                from gie_tpu.learn.policy import (
+                    float32_from_hex, weights_from_mapping)
+
+                weights = weights_from_mapping({
+                    name: float(float32_from_hex(hexed))
+                    for name, hexed in cfg.policy_weights})
         mesh = None
         if cfg.mesh_devices > 1:
             # The production --mesh-devices path end to end: the storm's

@@ -1,0 +1,81 @@
+"""Regenerate the gie-learn fixture dump (tests/fixtures/learn/).
+
+The fixture is a REAL flight-recorder dump: a seeded virtual-clock storm
+(LoRA churn over a small pool — enough contention that queue/kv/load
+columns vary and serve latencies spread) with the recorder armed, dumped
+through the same load_records format production harvests produce. The
+learn tests and `make learn-ci` train from this file and replay it
+through TraceReplay, so regenerate ONLY when the record schema or the
+storm engine's decision sequence intentionally changes, and commit the
+result:
+
+    JAX_PLATFORMS=cpu python hack/learn_fixture.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update(
+    "jax_platforms", os.environ.get("GIE_STORM_PLATFORM", "cpu"))
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures", "learn", "storm-fixture-flightrec.json")
+SEED = 2024
+
+
+def main() -> int:
+    from gie_tpu import obs
+    from gie_tpu.obs.recorder import FlightRecorder, load_records
+    from gie_tpu.storm import shapes as S
+    from gie_tpu.storm.engine import PoolSpec, StormEngine
+
+    prog = S.Program(
+        S.TrafficConfig(base_qps=24.0, duration_s=8.0, n_sessions=12,
+                        sheddable_fraction=0.2),
+        [S.LoraChurn(adapters=3, hot=1, rotate_every_s=2.0, p=0.4),
+         S.FlashCrowd(at_s=2.0, ramp_s=0.5, hold_s=3.0, magnitude=4.0,
+                      decay_s=0.5)],
+        seed=SEED)
+    eng = StormEngine(prog, pool=PoolSpec(n_pods=3), virtual_time=True,
+                      name="learn-fixture")
+    try:
+        sched = prog.compile()
+        # Warm BEFORE arming the recorder: warmup picks are harness
+        # traffic, not workload — the fixture must carry arrivals only.
+        eng.warmup(sched)
+        obs.install(recorder=FlightRecorder(8192))
+        try:
+            eng.run(schedule=sched, warmup=False)
+            records = obs.RECORDER.snapshot()
+        finally:
+            obs.uninstall()
+        fingerprint = sched.fingerprint()
+    finally:
+        eng.close()
+
+    payload = {
+        "name": "learn-fixture",
+        "schedule_fingerprint": fingerprint,
+        "records": records,
+    }
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, default=str, sort_keys=True)
+    loaded = load_records(json.dumps(payload, default=str))
+    served = sum(1 for r in loaded if r.get("outcome") == "2xx"
+                 and "serve_latency_ms" in r)
+    print(f"wrote {OUT}: {len(loaded)} records, {served} scored serves, "
+          f"fingerprint {fingerprint[:16]}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
