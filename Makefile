@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke storm-search-smoke learn-ci test test-unit test-conformance bench bench-mesh bench-goodput bench-scrape bench-extproc bench-cpu cost release clean
+.PHONY: all native native-asan generate lint obs-check fuzz-smoke chaos-ci chaos-smoke storm-ci storm-smoke storm-search-smoke learn-ci test test-unit test-conformance bench bench-mesh bench-fleet bench-goodput bench-scrape bench-extproc bench-cpu cost release clean
 
 all: native generate
 
@@ -129,6 +129,15 @@ bench:
 # the scaling PROPERTY lives in tests/test_distributed_equivalence.py).
 bench-mesh:
 	$(PY) bench.py --mesh-sizes 1,2,4,8 --mesh-m 1024,4096,8192
+
+# gie-fleet hierarchical-picker sweep (docs/FLEET.md): pick latency at
+# fleet widths far past M_MAX (65k / 262k endpoints) with the dense
+# stage compressed to the top-K candidate cells; each record carries the
+# compression ratio. cpu-fallback tagged when no TPU is reachable (the
+# BENCH_r09 trajectory marker; the bitwise parity property lives in
+# tests/test_fleet.py).
+bench-fleet:
+	$(PY) bench.py --fleet-m 65536,262144 --fleet-topk 4 --fleet-cell-cap 256
 
 # XLA cost analysis of the compiled cycle (the HBM-traffic perf model
 # behind the <=50us pick budget; gated in tests/test_cost_budget.py).

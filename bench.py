@@ -579,7 +579,151 @@ def _parse_args(argv=None):
     p.add_argument(
         "--mesh-pickers", default="topk",
         help="comma list of pickers to sweep (topk and/or sinkhorn)")
+    p.add_argument(
+        "--fleet-m", default="",
+        help="comma list of FLEET widths (e.g. 65536,262144): run the "
+        "gie-fleet hierarchical two-level sweep (docs/FLEET.md) instead "
+        "of the headline capture")
+    p.add_argument(
+        "--fleet-topk", type=int, default=4,
+        help="coarse-stage candidate cells per wave (fleet sweep)")
+    p.add_argument(
+        "--fleet-cell-cap", type=int, default=256,
+        help="endpoints per cell (fleet sweep; multiple of 32)")
+    p.add_argument(
+        "--fleet-n", type=int, default=0,
+        help="request-axis width for the fleet sweep (0 = 256 on the "
+        "CPU fallback, 1024 otherwise)")
     return p.parse_args(argv)
+
+
+def fleet_sweep(args) -> None:
+    """gie-fleet scaling sweep (docs/FLEET.md): pick latency of the
+    hierarchical two-level cycle — coarse cell stage over the WHOLE
+    fleet, dense chain over the gathered top-K candidate block — at
+    fleet widths far past M_MAX (65k, 262k endpoints), per wave of N
+    requests. Emits one JSON record per width with the compression
+    ratio (dense-stage fraction of the fleet) and the same backend
+    tagging as every capture; on the CPU fallback the number is a
+    tagged trajectory marker (BENCH_r09), not a TPU target check —
+    the scaling SHAPE (cost ~ cells + K*cell_cap, not M) is the
+    claim, and the bitwise parity property is pinned separately by
+    tests/test_fleet.py.
+    """
+    widths = [int(s) for s in args.fleet_m.split(",") if s]
+    backend = _wait_for_backend()
+    _in_process_watchdog()
+    _preflight()
+    _apply_platform_override()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gie_tpu.fleet import FleetPicker
+    from gie_tpu.fleet.picker import fleet_cycle
+    from gie_tpu.sched.profile import ProfileConfig
+    from gie_tpu.sched.types import Weights, chunk_bucket_for
+    from gie_tpu.utils.testing import make_endpoints, make_requests
+
+    cpu = jax.devices()[0].platform == "cpu"
+    tag = "cpu-fallback" if cpu else backend
+    n = args.fleet_n or (256 if cpu else 1024)
+    chain, pipeline, reps = (4, 1, 3) if cpu else (32, 4, 10)
+    topk, cell_cap = args.fleet_topk, args.fleet_cell_cap
+    _log(f"fleet sweep: m={widths} topk={topk} cell_cap={cell_cap} n={n} "
+         f"chain={chain} reps={reps} backend={tag}")
+
+    # The picker is the state factory + ratio oracle; the measured cycle
+    # is its jitted fleet_cycle, chained exactly like the headline scan.
+    picker = FleetPicker(
+        ProfileConfig(), topk=topk, cell_cap=cell_cap)
+    cfg = ProfileConfig()
+    cycle = functools.partial(
+        fleet_cycle, cfg=cfg, predictor_fn=None,
+        cell_cap=cell_cap, topk=topk)
+
+    rng = np.random.default_rng(0)
+    weights = Weights.default()
+    for m in widths:
+        if m % cell_cap:
+            _log(f"m={m}: not a multiple of cell_cap={cell_cap} — skipped")
+            continue
+        eps = make_endpoints(
+            m,
+            queue=rng.integers(0, 50, m).tolist(),
+            kv=rng.uniform(0, 0.95, m).tolist(),
+            max_lora=8,
+            m_slots=m,
+        )
+        base = b"SYSTEM: You are a helpful assistant for task %d. "
+        prompts = [(base % (i % 16)) * 6 + b"user question %d" % i
+                   for i in range(n)]
+        reqs = make_requests(
+            n, prompts=prompts,
+            lora_id=(rng.integers(-1, 12, n)).tolist(), m_slots=m)
+        cb = chunk_bucket_for(int(np.asarray(reqs.n_chunks).max()))
+        reqs = reqs.replace(chunk_hashes=reqs.chunk_hashes[:, :cb])
+        salts = jnp.asarray(rng.integers(
+            1, 2**32, chain, dtype=np.uint64).astype(np.uint32))
+        shifts = jnp.asarray(
+            ((17 * np.arange(1, chain + 1) + 3) % n).astype(np.int32))
+
+        def window(state, key, reqs, eps, weights):
+            def step(carry, xs):
+                st, k = carry
+                salt, shift = xs
+                wave = jax.tree.map(
+                    lambda x: jnp.roll(x, shift, axis=0), reqs)
+                wave = wave.replace(chunk_hashes=wave.chunk_hashes ^ salt)
+                k, sub = jax.random.split(k)
+                result, st = cycle(st, wave, eps, weights, sub, None)
+                return (st, k), result.indices[:, 0]
+
+            (state, key), primaries = jax.lax.scan(
+                step, (state, key), (salts, shifts))
+            return state, key, primaries[-1]
+
+        fn = jax.jit(window, donate_argnums=(0,))
+        state = picker._init_state(m)
+        key = jax.random.PRNGKey(0)
+        t0 = time.perf_counter()
+        state, key, last = fn(state, key, reqs, eps, weights)
+        jax.block_until_ready(last)
+        _log(f"m={m}: compile+first {time.perf_counter()-t0:.2f}s "
+             f"(cells={m // cell_cap} dense block={topk * cell_cap})")
+        state, key, last = fn(state, key, reqs, eps, weights)
+        jax.block_until_ready(last)
+
+        def rep():
+            nonlocal state, key
+            out = None
+            for _ in range(pipeline):
+                state, key, out = fn(state, key, reqs, eps, weights)
+            return out
+
+        med, _ = _timed_reps(rep, reps, jax.block_until_ready)
+        p50 = med / (pipeline * chain) * 1e6
+        rec = {
+            "metric": f"fleet_pick_p50_us_{n}x{m}",
+            "value": round(p50, 1),
+            "unit": "us",
+            "m": m,
+            "n": n,
+            "fleet_topk": topk,
+            "fleet_cell_cap": cell_cap,
+            "cells": m // cell_cap,
+            # Dense-stage fraction of the fleet: the two-level cycle
+            # scores topk*cell_cap endpoints where the flat cycle would
+            # score (an impossible) M.
+            "compression_ratio": round(picker.compression_ratio(m), 6),
+            "mode": "sketch" if m > 1024 else "exact",
+            "method": "bulk",
+            "chain": chain,
+            "reps": reps,
+            "backend": tag,
+        }
+        print(json.dumps(rec), flush=True)
+    _log("fleet sweep complete")
 
 
 def mesh_sweep(args) -> None:
@@ -757,7 +901,9 @@ def mesh_sweep(args) -> None:
 
 if __name__ == "__main__":
     _ARGS = _parse_args()
-    if _ARGS.mesh_sizes:
+    if _ARGS.fleet_m:
+        fleet_sweep(_ARGS)
+    elif _ARGS.mesh_sizes:
         mesh_sweep(_ARGS)
     else:
         main()

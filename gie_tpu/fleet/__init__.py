@@ -1,0 +1,46 @@
+"""gie-fleet: hierarchical two-level pick cycle for 100k+ endpoint fleets.
+
+The dense cycle scores every request against every endpoint slot — even
+tp-sharded, O(N*M/(dp*tp)) tops out around M=8192 (ROADMAP item 3). The
+fleet subsystem splits the pick into two device-side stages
+(docs/FLEET.md):
+
+  1. a COARSE stage over bounded per-cell rows (CellRows: queue / kv /
+     assumed-load aggregates, LoRA residency bitsets, hot-prefix
+     sketches) that emits top-K candidate cells per request, and
+  2. a candidate-COMPRESSED dense stage that gathers the selected
+     cells' endpoints into an [N, K*cell_cap] block and runs the
+     UNCHANGED scorer chain / picker / sinkhorn over it.
+
+The parity contract (tests/test_fleet.py): selected cells are gathered
+in ascending cell-id order, so whenever top-K covers every cell the
+gather is the identity permutation, the compressed inputs are byte-equal
+to the dense inputs, and the picks are BITWISE-identical to the dense
+cycle — independent of what the coarse scores said. Default off
+(`--fleet-topk 0`) leaves the dense path byte-identical.
+"""
+
+from gie_tpu.fleet.cells import CellRows, build_cell_rows, cell_match_from_table
+from gie_tpu.fleet.coarse import coarse_total, select_cells
+from gie_tpu.fleet.compress import (
+    broadcast_presence,
+    compact_presence,
+    gather_endpoints,
+    global_slots,
+)
+from gie_tpu.fleet.picker import FleetAux, FleetPicker, fleet_cycle
+
+__all__ = [
+    "CellRows",
+    "FleetAux",
+    "FleetPicker",
+    "broadcast_presence",
+    "build_cell_rows",
+    "cell_match_from_table",
+    "coarse_total",
+    "compact_presence",
+    "fleet_cycle",
+    "gather_endpoints",
+    "global_slots",
+    "select_cells",
+]

@@ -1,0 +1,166 @@
+"""Bounded per-cell rows: the coarse stage's view of the fleet.
+
+A CELL is a fixed, contiguous run of ``cell_cap`` endpoint slots — a
+cluster of the federation capacity matrix, a peer's imported slot range,
+or simply a pool shard of the local Datastore (slot layout is owned by
+the datastore/federation layers; the fleet index only requires that a
+cell's slots are contiguous, which is how imported peers are laid out
+already). Cell c owns global slots [c*cell_cap, (c+1)*cell_cap).
+
+Per-cell rows fold the dense endpoint tensors into O(cells) aggregates
+(Gavel-style pool rows — PAPERS.md "Heterogeneity-Aware Cluster
+Scheduling Policies" prices (job, pool) against throughput-matrix rows,
+not individual accelerators): mean queue depth, mean KV utilization,
+mean assumed load, live-slot count, a LoRA-residency bloom, and — via
+:func:`cell_match_from_table` / the sketch table of
+gie_tpu/fleet/compress.py — a hot-prefix sketch column. All reductions
+follow sinkhorn.py's grouped-partial discipline (fixed group partials +
+ordered left-to-right fold), so a tp-sharded cell axis reduces each cell
+bit-identically to the replicated layout.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.sinkhorn import _group_count
+from gie_tpu.sched.types import EndpointBatch, PrefixTable, RequestBatch
+
+
+@flax.struct.dataclass
+class CellRows:
+    """One bounded row per cell — everything the coarse stage scores.
+
+    Raw aggregates, not normalized scores: normalization happens in
+    coarse.py with the SAME formulas (and the same ProfileConfig norms)
+    the dense scorer chain uses, so a cell row reads like a virtual
+    endpoint whose metrics are its members' means.
+    """
+
+    queue: jax.Array    # f32[cells] mean queue depth over valid slots
+    kv: jax.Array       # f32[cells] mean KV-cache utilization
+    load: jax.Array     # f32[cells] mean assumed load
+    n_valid: jax.Array  # f32[cells] live slot count (exact integer-valued)
+    lora: jax.Array     # u32[cells] residency bloom: bit (id % 32) per adapter
+    valid: jax.Array    # bool[cells] cell has at least one live slot
+
+
+def _cell_fold(x: jax.Array, cell_cap: int) -> jax.Array:
+    """Grouped-partial per-cell sum: f32[cells*cap] -> f32[cells].
+
+    Fixed contiguous group partials over the cap axis + an ordered
+    left-to-right fold (sinkhorn._fold_first's discipline): each cell is
+    always whole on one shard (the fleet shards the CELL axis, never
+    within a cell), and the unrolled fold pins the add order so the row
+    values never depend on layout."""
+    cells = int(x.shape[0]) // cell_cap
+    g = _group_count(cell_cap)
+    parts = jnp.sum(x.reshape(cells, g, cell_cap // g), axis=2)
+    acc = parts[:, 0]
+    for i in range(1, g):
+        acc = acc + parts[:, i]
+    return acc
+
+
+def _or_fold(x: jax.Array, cell_cap: int) -> jax.Array:
+    """Bitwise-OR per-cell fold: u32[cells*cap] -> u32[cells]. OR is
+    exactly associative, so a plain reduce needs no grouping."""
+    cells = int(x.shape[0]) // cell_cap
+    return jax.lax.reduce(
+        x.reshape(cells, cell_cap), jnp.uint32(0),
+        jax.lax.bitwise_or, dimensions=(1,))
+
+
+def lora_residency_bits(eps: EndpointBatch) -> jax.Array:
+    """Per-slot adapter bloom -> u32[m]: bit (adapter_id % 32) for every
+    resident adapter on a valid slot. 32 bits is a bloom, not a map —
+    false positives send a request to a cell that must then page the
+    adapter in, the same soft cost the dense LoRA affinity column
+    already prices; false negatives cannot happen."""
+    ids = eps.lora_active
+    bits = jnp.where(
+        ids >= 0,
+        jnp.uint32(1) << (ids % 32).astype(jnp.uint32),
+        jnp.uint32(0),
+    )
+    slot_bits = jax.lax.reduce(
+        bits, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(1,))
+    return jnp.where(eps.valid, slot_bits, jnp.uint32(0))
+
+
+def build_cell_rows(
+    eps: EndpointBatch,
+    assumed_load: jax.Array,
+    *,
+    cell_cap: int,
+) -> CellRows:
+    """Fold the dense endpoint tensors into per-cell rows -> CellRows.
+
+    Means are over VALID slots only (a half-empty cell of idle pods must
+    not look twice as loaded as a full one); cells with no live slots
+    are marked invalid and score -inf in the coarse stage."""
+    valid_f = eps.valid.astype(jnp.float32)
+    n_valid = _cell_fold(valid_f, cell_cap)
+    denom = jnp.maximum(n_valid, 1.0)
+
+    def mean(col: jax.Array) -> jax.Array:
+        return _cell_fold(jnp.where(eps.valid, col, 0.0), cell_cap) / denom
+
+    return CellRows(
+        queue=mean(eps.metrics[:, C.Metric.QUEUE_DEPTH]),
+        kv=mean(eps.metrics[:, C.Metric.KV_CACHE_UTIL]),
+        load=mean(assumed_load),
+        n_valid=n_valid,
+        lora=_or_fold(lora_residency_bits(eps), cell_cap),
+        valid=n_valid > 0,
+    )
+
+
+def cell_match_from_table(
+    table: PrefixTable,
+    reqs: RequestBatch,
+    tick: jax.Array,
+    *,
+    cell_cap: int,
+    max_age: int,
+) -> jax.Array:
+    """Cell-granular longest-prefix match fraction -> f32[N, cells], from
+    a PER-ENDPOINT packed table (exact mode: fleet_m <= the largest M
+    bucket, so the full-resolution table exists).
+
+    Same gather + cumulative-AND sweep as prefix.match_scores, but the
+    presence words collapse to one bit per cell ("some endpoint in this
+    cell plausibly holds the chunk") before the depth count — the coarse
+    stage only needs to know WHICH cells hold the prefix, the compressed
+    dense stage re-scores the surviving cells at full resolution."""
+    wpc = cell_cap // 32
+    nslots = int(table.keys.shape[0])
+    slots = (reqs.chunk_hashes & jnp.uint32(nslots - 1)).astype(jnp.int32)
+    keys = table.keys[slots]                                   # u32[N, C]
+    cmax = reqs.chunk_hashes.shape[1]
+    chunk_valid = (
+        jnp.arange(cmax, dtype=jnp.int32)[None, :] < reqs.n_chunks[:, None]
+    )
+    fresh = (tick - table.ages[slots]) <= jnp.uint32(max_age)
+    hit = (
+        (keys == reqs.chunk_hashes) & (reqs.chunk_hashes != 0)
+        & chunk_valid & fresh
+    )
+    words = table.present[slots] * hit[..., None].astype(jnp.uint32)
+    n, _, w = words.shape
+    cells = w // wpc
+    # One [N, cells] slice per chunk lane — never the unpacked bit tensor.
+    cell_words = words.reshape(n, cmax, cells, wpc)
+    acc = jnp.ones((n, cells), bool)
+    depth = jnp.zeros((n, cells), jnp.float32)
+    for ci in range(cmax):
+        lane = jax.lax.reduce(
+            cell_words[:, ci], jnp.uint32(0),
+            jax.lax.bitwise_or, dimensions=(2,))
+        acc = acc & (lane != 0)
+        depth = depth + acc.astype(jnp.float32)
+    denom = jnp.maximum(reqs.n_chunks.astype(jnp.float32), 1.0)
+    return depth / denom[:, None]

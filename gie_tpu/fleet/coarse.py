@@ -1,0 +1,107 @@
+"""Coarse stage: score request batches against cells, emit candidates.
+
+The cell blend reuses the dense chain's normalization formulas
+(scorers.py) over the per-cell aggregates and the dense Weights fields —
+a cell row scores exactly like a virtual endpoint carrying its members'
+mean metrics. The session column has no cell-level analogue (the
+consistent-hash home is a single slot, priced by the compressed dense
+stage once its cell survives) and is left out of the coarse blend; see
+docs/FLEET.md for the tuning consequence.
+
+Selection is two-phase:
+  - per request: top-K cells by coarse score (recorded as flight-record
+    provenance and pinned by the recall property test), then
+  - per batch: the K highest cells of the request-max score (any cell
+    that is SOME request's best candidate ranks at its strongest
+    advocate's value), gathered in ascending cell-id order.
+
+The ascending sort is the parity keystone: with k >= cells the
+selection is every cell id regardless of what the scores were, the
+gather in compress.py degenerates to the identity permutation, and the
+compressed dense stage sees byte-identical inputs to the dense cycle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gie_tpu.fleet.cells import CellRows
+from gie_tpu.sched.types import EndpointBatch, RequestBatch, Weights
+
+
+def coarse_total(
+    rows: CellRows,
+    prefix_col: jax.Array,   # f32[N, cells] cell-granular match fraction
+    reqs: RequestBatch,
+    weights: Weights,
+    *,
+    queue_norm: float,
+    load_norm: float,
+) -> jax.Array:
+    """Blended coarse score -> f32[N, cells] (higher = better cell)."""
+    queue = jnp.clip(1.0 - rows.queue / queue_norm, 0.0, 1.0)
+    kv = jnp.clip(1.0 - rows.kv, 0.0, 1.0)
+    load = jnp.clip(1.0 - rows.load / load_norm, 0.0, 1.0)
+    # Residency bloom probe: base-model requests are indifferent (1.0);
+    # adapter requests prefer cells already holding bit (id % 32).
+    bit = jnp.uint32(1) << (
+        jnp.maximum(reqs.lora_id, 0) % 32).astype(jnp.uint32)
+    resident = ((rows.lora[None, :] & bit[:, None]) != 0).astype(jnp.float32)
+    lora = jnp.where(reqs.lora_id[:, None] >= 0, resident, 1.0)
+
+    cellwise = (
+        weights.queue * queue
+        + weights.kv_cache * kv
+        + weights.assumed_load * load
+    )[None, :]
+    requestwise = weights.prefix * prefix_col + weights.lora * lora
+    wsum = (
+        weights.queue + weights.kv_cache + weights.assumed_load
+        + weights.prefix + weights.lora
+    )
+    return (cellwise + requestwise) / jnp.maximum(wsum, jnp.float32(1e-6))
+
+
+def select_cells(
+    coarse: jax.Array,       # f32[N, cells]
+    rows: CellRows,
+    reqs: RequestBatch,
+    eps: EndpointBatch,
+    *,
+    cell_cap: int,
+    k: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """-> (sel i32[k] ascending, cand_cells i32[N, k], cand_scores
+    f32[N, k]).
+
+    Eligibility folds the request's candidate-subset hint and slot
+    liveness to cell granularity (a cell none of whose slots are in the
+    subset can never serve the request, so its coarse score must not
+    crowd a servable cell out of the batch budget). Ineligible and dead
+    cells score NEG; with k >= cells they are still selected — harmless,
+    their slots stay masked in the dense stage — which is exactly what
+    keeps the covering-case selection score-independent."""
+    n = int(coarse.shape[0])
+    cells = int(coarse.shape[1])
+    elig = jnp.any(
+        reqs.subset_mask.reshape(n, cells, cell_cap)
+        & eps.valid.reshape(cells, cell_cap)[None, :, :],
+        axis=2,
+    )
+    neg = jnp.float32(-1e9)
+    scored = jnp.where(elig & rows.valid[None, :], coarse, neg)
+
+    # Per-request candidates: provenance + the recall property's subject.
+    cand_scores, cand_cells = jax.lax.top_k(scored, k)
+
+    # Batch selection: request-max advocacy (padded/invalid rows advocate
+    # for nothing), ties broken toward lower cell ids by top_k's stable
+    # first-occurrence order.
+    advocacy = jnp.max(
+        jnp.where(reqs.valid[:, None], scored, neg), axis=0)
+    _, sel = jax.lax.top_k(advocacy, k)
+    # Canonical ascending gather order — the bitwise-parity keystone:
+    # k == cells makes this arange(cells) no matter what was scored.
+    sel = jnp.sort(sel)
+    return sel.astype(jnp.int32), cand_cells.astype(jnp.int32), cand_scores

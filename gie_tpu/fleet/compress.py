@@ -1,0 +1,191 @@
+"""Candidate compression: gather selected cells into a dense block.
+
+Every helper gathers in the caller-provided ascending cell order and
+pads the block up to an M bucket with dead slots (valid=False, subset
+bit off, presence words zero), so one compiled compressed cycle serves
+every selection and the pad can never be picked. Padded positions index
+with an out-of-bounds sentinel on the scatter-back side (JAX drop
+semantics), so they alias nothing in the fleet-width state.
+
+The parity mechanics live here: when the selection is arange(cells) —
+top-K covered every cell — `global_slots` is the identity, every gather
+below returns its input unchanged (no pad: the fleet width is itself
+the bucket), and the compressed cycle's inputs are byte-equal to the
+dense cycle's.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gie_tpu.sched.types import EndpointBatch
+
+
+def global_slots(sel: jax.Array, *, cell_cap: int, m_c: int) -> jax.Array:
+    """Compressed slot j -> global endpoint slot, i32[m_c]. Padded tail
+    positions (j >= k*cell_cap) return -1."""
+    k = int(sel.shape[0])
+    lanes = (
+        sel[:, None] * cell_cap
+        + jnp.arange(cell_cap, dtype=jnp.int32)[None, :]
+    ).reshape(-1)
+    pad = m_c - k * cell_cap
+    if pad:
+        lanes = jnp.concatenate(
+            [lanes, jnp.full((pad,), -1, jnp.int32)])
+    return lanes.astype(jnp.int32)
+
+
+def _pad_rows(x: jax.Array, pad: int, fill) -> jax.Array:
+    if not pad:
+        return x
+    widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def gather_endpoints(
+    eps: EndpointBatch, sel: jax.Array, *, cell_cap: int, m_c: int
+) -> EndpointBatch:
+    """Selected cells' endpoint rows -> EndpointBatch at width m_c."""
+    k = int(sel.shape[0])
+    pad = m_c - k * cell_cap
+
+    def rows(x: jax.Array, fill) -> jax.Array:
+        cells = int(x.shape[0]) // cell_cap
+        per_cell = x.reshape((cells, cell_cap) + x.shape[1:])
+        return _pad_rows(
+            per_cell[sel].reshape((k * cell_cap,) + x.shape[1:]), pad, fill)
+
+    return EndpointBatch(
+        metrics=rows(eps.metrics, 0.0),
+        valid=rows(eps.valid, False),
+        lora_active=rows(eps.lora_active, -1),
+        lora_waiting=rows(eps.lora_waiting, -1),
+        role=rows(eps.role, 0),
+    )
+
+
+def gather_request_cols(x: jax.Array, gslots: jax.Array) -> jax.Array:
+    """[N, fleet_m] request-by-endpoint matrix -> [N, m_c] compressed
+    columns; padded positions (gslots == -1) become the dtype zero
+    (False for the subset mask), never a clamped neighbor's value."""
+    vals = jnp.take(x, jnp.maximum(gslots, 0), axis=1)
+    return jnp.where(gslots[None, :] >= 0, vals, jnp.zeros_like(vals))
+
+
+def gather_vec(x: jax.Array, gslots: jax.Array, fill: float) -> jax.Array:
+    """Fleet-width per-endpoint vector -> compressed vector; padded
+    positions take `fill` (0 load, 1.0 cold sinkhorn duals)."""
+    vals = x[jnp.maximum(gslots, 0)]
+    return jnp.where(gslots >= 0, vals, jnp.full_like(vals, fill))
+
+
+def scatter_vec(
+    full: jax.Array, gslots: jax.Array, compressed: jax.Array
+) -> jax.Array:
+    """Write compressed per-endpoint values back to fleet width; padded
+    positions scatter to the drop sentinel and touch nothing."""
+    m = int(full.shape[0])
+    safe = jnp.where(gslots >= 0, gslots, m)
+    return full.at[safe].set(compressed, mode="drop")
+
+
+def gather_words(
+    present: jax.Array, sel: jax.Array, *, cell_cap: int, m_c: int
+) -> jax.Array:
+    """Exact mode: per-endpoint packed presence u32[S, fleet_m/32] ->
+    compressed u32[S, m_c/32] (word-aligned: cell_cap is a multiple of
+    32, so a cell's presence is whole words and the gather is exact)."""
+    wpc = cell_cap // 32
+    k = int(sel.shape[0])
+    cells = int(present.shape[1]) // wpc
+    per_cell = present.reshape(present.shape[0], cells, wpc)
+    out = per_cell[:, sel].reshape(present.shape[0], k * wpc)
+    pad = m_c // 32 - k * wpc
+    if pad:
+        out = jnp.concatenate(
+            [out, jnp.zeros((out.shape[0], pad), jnp.uint32)], axis=1)
+    return out
+
+
+def scatter_words(
+    present: jax.Array,
+    sel: jax.Array,
+    compressed: jax.Array,
+    new_keys_differ: jax.Array,  # bool[S] rows the compressed insert recycled
+    *,
+    cell_cap: int,
+) -> jax.Array:
+    """Inverse of gather_words. Rows whose KEY the compressed insert
+    recycled are cleared across ALL fleet words first: the insert's
+    row-clear only reached the gathered columns, and a recycled slot
+    must not keep the evicted key's presence bits for cells that were
+    not selected this wave (they would read as false positives under
+    the new key)."""
+    wpc = cell_cap // 32
+    k = int(sel.shape[0])
+    cleared = jnp.where(new_keys_differ[:, None], jnp.uint32(0), present)
+    cells = int(present.shape[1]) // wpc
+    per_cell = cleared.reshape(present.shape[0], cells, wpc)
+    new_cols = compressed[:, : k * wpc].reshape(present.shape[0], k, wpc)
+    return per_cell.at[:, sel].set(new_cols).reshape(present.shape)
+
+
+def compact_presence(
+    present: jax.Array, *, cell_cap: int, out_cells: int | None = None
+) -> jax.Array:
+    """Seed the fleet-level sketch from the packed per-endpoint table:
+    u32[S, m/32] -> u32[S, out_cells/32], bit c = "some endpoint of cell
+    c holds this chunk". The exact->sketch migration path (and the storm
+    twin's way of carrying prefix affinity across a fleet grow):
+    `out_cells` >= the source cell count pads the sketch out to the grown
+    fleet's cell axis (the source cells of a 1024-slot dense table are
+    fewer than a packing word, so the pad is also what word-aligns)."""
+    wpc = cell_cap // 32
+    s = int(present.shape[0])
+    cells = int(present.shape[1]) // wpc
+    if out_cells is None:
+        out_cells = cells
+    if out_cells < cells or out_cells % 32:
+        raise ValueError(
+            f"out_cells {out_cells} must be a multiple of 32 covering the "
+            f"{cells} source cells")
+    merged = jax.lax.reduce(
+        present.reshape(s, cells, wpc), jnp.uint32(0),
+        jax.lax.bitwise_or, dimensions=(2,))
+    bits = (merged != 0).astype(jnp.uint32)                  # [S, cells]
+    if out_cells > cells:
+        bits = jnp.pad(bits, ((0, 0), (0, out_cells - cells)))
+    shifted = bits.reshape(s, out_cells // 32, 32) << jnp.arange(
+        32, dtype=jnp.uint32)
+    return jax.lax.reduce(
+        shifted, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(2,))
+
+
+def broadcast_presence(
+    cell_present: jax.Array,  # u32[S, cells/32] cell-bit sketch table
+    sel: jax.Array,
+    *,
+    cell_cap: int,
+    m_c: int,
+) -> jax.Array:
+    """Sketch mode: expand selected cells' sketch bits to compressed
+    per-endpoint words u32[S, m_c/32] — every slot of a sketch-hit cell
+    reads as present (cluster-granularity affinity, exactly the grain
+    the federation's fed.prefix import already works at)."""
+    wpc = cell_cap // 32
+    k = int(sel.shape[0])
+    word = (sel // 32).astype(jnp.int32)
+    bit = jnp.uint32(1) << (sel % 32).astype(jnp.uint32)
+    hit = (cell_present[:, word] & bit[None, :]) != 0        # bool[S, k]
+    words = jnp.where(
+        hit[:, :, None], jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    out = jnp.broadcast_to(
+        words, (cell_present.shape[0], k, wpc)
+    ).reshape(cell_present.shape[0], k * wpc)
+    pad = m_c // 32 - k * wpc
+    if pad:
+        out = jnp.concatenate(
+            [out, jnp.zeros((out.shape[0], pad), jnp.uint32)], axis=1)
+    return out

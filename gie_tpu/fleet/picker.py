@@ -1,0 +1,466 @@
+"""FleetPicker: the hierarchical two-level pick cycle, as a Scheduler.
+
+`fleet_cycle` has the SAME signature as profile.scheduling_cycle —
+(state, reqs, eps, weights, key, predictor_params) -> (result, state) —
+so FleetPicker subclasses the Scheduler facade and swaps the compiled
+program: locking, bucket warmup, async dispatch, completion feedback,
+checkpointing and the replication digest surface are all inherited
+unchanged, and the batching collector cannot tell the difference (the
+default-off path never constructs this class at all).
+
+Two resolution modes, chosen by the carried state's presence width:
+
+  exact  — fleet_m is a dense M bucket: the state IS a dense SchedState,
+           the coarse stage derives cell rows/sketches from it on the
+           fly, and the compressed stage gathers true per-endpoint
+           presence words. This is the parity mode: top-K covering every
+           cell makes every gather the identity and the picks
+           bitwise-identical to the dense cycle.
+  sketch — fleet_m exceeds the largest M bucket (the 100k+ regime): the
+           prefix index lives at CELL granularity (PrefixTable whose
+           packed axis is cells, seeded from the dense table by
+           compress.compact_presence on the grow migration), per-
+           endpoint affinity inside a selected cell is the cell's
+           sketch bit, and inserts happen at cell grain.
+
+The compressed block is deliberately solved UNSHARDED (it is at most one
+M bucket wide — that is the whole point of compression), which is what
+lets the pallas sinkhorn kernel run under a meshed deployment again: the
+inner cycle is invoked mesh-free, so profile.py's `use_pallas and mesh
+is None` gate passes (PR 15 residual; docs/FLEET.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+from typing import Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gie_tpu.fleet.cells import build_cell_rows, cell_match_from_table
+from gie_tpu.fleet.coarse import coarse_total, select_cells
+from gie_tpu.fleet.compress import (
+    broadcast_presence,
+    compact_presence,
+    gather_endpoints,
+    gather_request_cols,
+    gather_vec,
+    gather_words,
+    global_slots,
+    scatter_vec,
+    scatter_words,
+)
+from gie_tpu.sched import constants as C
+from gie_tpu.sched import prefix
+from gie_tpu.sched.profile import ProfileConfig, Scheduler, scheduling_cycle
+from gie_tpu.sched.types import (
+    PickResult,
+    PrefixTable,
+    SchedState,
+    Weights,
+    m_bucket_for,
+    resize_state,
+)
+
+
+@flax.struct.dataclass
+class FleetAux:
+    """Per-request coarse-stage provenance, carried on PickResult.fleet
+    (flight-record fields: candidate cells + their coarse scores; the
+    compression ratio is static per width and stamped host-side)."""
+
+    cells: jax.Array   # i32[N, K] candidate cells, best first
+    scores: jax.Array  # f32[N, K] their coarse scores
+
+
+def _is_sketch(state: SchedState) -> bool:
+    """Presence width tells the mode: a dense table packs fleet_m bits
+    per row; the sketch table packs one bit per CELL."""
+    return int(state.prefix.present.shape[1]) * 32 != int(
+        state.assumed_load.shape[0])
+
+
+def fleet_cycle(
+    state: SchedState,
+    reqs,
+    eps,
+    weights: Weights,
+    key: jax.Array,
+    predictor_params,
+    *,
+    cfg: ProfileConfig,
+    predictor_fn,
+    cell_cap: int,
+    topk: int,
+) -> tuple[PickResult, SchedState]:
+    """One hierarchical pick cycle. Pure; jit-compiled per (N-bucket,
+    fleet width, cfg) exactly like the dense cycle it wraps."""
+    fleet_m = int(eps.valid.shape[0])
+    cells = fleet_m // cell_cap
+    k_sel = min(topk, cells)
+    m_c = m_bucket_for(k_sel * cell_cap)
+    sketch = _is_sketch(state)
+
+    # ---- Coarse stage: bounded cell rows -> top-K candidates ----------
+    rows = build_cell_rows(eps, state.assumed_load, cell_cap=cell_cap)
+    if not cfg.enable_prefix:
+        pref_cells = jnp.zeros((reqs.valid.shape[0], cells), jnp.float32)
+    elif sketch:
+        # The sketch table's packed axis IS the cell axis — the dense
+        # matcher runs on it unchanged.
+        pref_cells = prefix.match_scores(
+            state.prefix, reqs, state.tick, max_age=cfg.prefix_max_age)
+    else:
+        pref_cells = cell_match_from_table(
+            state.prefix, reqs, state.tick,
+            cell_cap=cell_cap, max_age=cfg.prefix_max_age)
+    coarse = coarse_total(
+        rows, pref_cells, reqs, weights,
+        queue_norm=cfg.queue_norm, load_norm=cfg.load_norm)
+    sel, cand_cells, cand_scores = select_cells(
+        coarse, rows, reqs, eps, cell_cap=cell_cap, k=k_sel)
+
+    # ---- Compression: ascending-cell gather into one M bucket ---------
+    gslots = global_slots(sel, cell_cap=cell_cap, m_c=m_c)
+    eps_c = gather_endpoints(eps, sel, cell_cap=cell_cap, m_c=m_c)
+    reqs_c = reqs.replace(
+        subset_mask=gather_request_cols(reqs.subset_mask, gslots))
+    present_c = (
+        broadcast_presence(
+            state.prefix.present, sel, cell_cap=cell_cap, m_c=m_c)
+        if sketch
+        else gather_words(
+            state.prefix.present, sel, cell_cap=cell_cap, m_c=m_c)
+    )
+    state_c = SchedState(
+        prefix=state.prefix.replace(present=present_c),
+        assumed_load=gather_vec(state.assumed_load, gslots, 0.0),
+        rr=state.rr,
+        tick=state.tick,
+        ot_v=gather_vec(state.ot_v, gslots, 1.0),
+    )
+
+    # ---- Dense stage: the UNCHANGED scorer chain over the block -------
+    # mesh=None on purpose: the block is one M bucket, replicating it is
+    # the design (and what re-opens the pallas sinkhorn gate under mesh).
+    res_c, new_c = scheduling_cycle(
+        state_c, reqs_c, eps_c, weights, key, predictor_params,
+        cfg=cfg, predictor_fn=predictor_fn, mesh=None)
+
+    # ---- Scatter back + remap to global slots -------------------------
+    def remap(idx):
+        return jnp.where(
+            idx >= 0, jnp.take(gslots, jnp.maximum(idx, 0)), idx)
+
+    indices_g = remap(res_c.indices)
+    new_load = scatter_vec(
+        state.assumed_load * cfg.load_decay, gslots, new_c.assumed_load)
+    new_ot = scatter_vec(state.ot_v, gslots, new_c.ot_v)
+    if not cfg.enable_prefix:
+        new_prefix = state.prefix
+    elif sketch:
+        # Cell-grain insert into the sketch table; the compressed
+        # table's own insert was a broadcast throwaway.
+        primary_cell = jnp.where(
+            indices_g[:, 0] >= 0, indices_g[:, 0] // cell_cap, -1)
+        new_prefix = prefix.insert(
+            state.prefix, reqs, primary_cell, state.tick)
+    else:
+        new_prefix = PrefixTable(
+            keys=new_c.prefix.keys,
+            present=scatter_words(
+                state.prefix.present, sel, new_c.prefix.present,
+                new_c.prefix.keys != state.prefix.keys,
+                cell_cap=cell_cap),
+            ages=new_c.prefix.ages,
+        )
+    new_state = SchedState(
+        prefix=new_prefix,
+        assumed_load=new_load,
+        rr=new_c.rr,
+        tick=new_c.tick,
+        ot_v=new_ot,
+    )
+    result = PickResult(
+        indices=indices_g,
+        status=res_c.status,
+        scores=res_c.scores,
+        prefill=(remap(res_c.prefill)
+                 if res_c.prefill is not None else None),
+        affinity=res_c.affinity,
+        fleet=FleetAux(cells=cand_cells, scores=cand_scores),
+    )
+    return result, new_state
+
+
+def fleet_resize_state(
+    state: SchedState, *, m: int, cell_cap: int
+) -> SchedState:
+    """resize_state generalized across the exact<->sketch boundary.
+
+    Within a mode it is the dense migration (or its cell-table twin);
+    crossing UP seeds the sketch from the packed dense table
+    (compact_presence — surviving endpoints keep cluster-grain
+    affinity); crossing DOWN broadcasts cell bits to endpoint words
+    (every member of a warm cell starts warm, the safe direction for an
+    approximate index)."""
+    m_old = int(state.assumed_load.shape[0])
+    if m == m_old:
+        return state
+    sketch_old = _is_sketch(state)
+    sketch_new = m > C.M_BUCKETS[-1]
+    if not sketch_old and not sketch_new:
+        return resize_state(state, m)
+
+    if m > m_old:
+        load = jnp.pad(state.assumed_load, (0, m - m_old))
+        ot_v = jnp.pad(state.ot_v, (0, m - m_old), constant_values=1.0)
+    else:
+        load = state.assumed_load[:m]
+        ot_v = state.ot_v[:m]
+
+    cells_new = m // cell_cap
+    if sketch_new:
+        if sketch_old:
+            w_old, w_new = (
+                int(state.prefix.present.shape[1]), cells_new // 32)
+            present = (
+                jnp.pad(state.prefix.present,
+                        ((0, 0), (0, w_new - w_old)))
+                if w_new >= w_old
+                else state.prefix.present[:, :w_new]
+            )
+        else:
+            present = compact_presence(
+                state.prefix.present, cell_cap=cell_cap,
+                out_cells=cells_new)
+    else:
+        present = broadcast_presence(
+            state.prefix.present,
+            jnp.arange(cells_new, dtype=jnp.int32),
+            cell_cap=cell_cap, m_c=m)
+    return state.replace(
+        assumed_load=load, ot_v=ot_v,
+        prefix=state.prefix.replace(present=present))
+
+
+class FleetPicker(Scheduler):
+    """Host facade: the Scheduler, compiled to the hierarchical cycle.
+
+    `mesh` is accepted for constructor parity with Scheduler but the
+    fleet program itself runs unsharded — the compressed block is one M
+    bucket and the coarse rows are O(cells); sharding either would cost
+    more in collectives than it saves (the dense tp-sharded path remains
+    the fleet-off configuration). The deployment mesh is kept on
+    `deploy_mesh` for operators reading /debugz/fleet.
+    """
+
+    def __init__(
+        self,
+        cfg: ProfileConfig = ProfileConfig(),
+        weights: Optional[Weights] = None,
+        predictor_fn=None,
+        predictor_params=None,
+        seed: int = 0,
+        mesh=None,
+        *,
+        topk: int = 4,
+        cell_cap: int = 64,
+    ):
+        if cell_cap < 32 or cell_cap % 32:
+            raise ValueError(
+                f"fleet cell_cap must be a positive multiple of 32 "
+                f"(packed presence words are 32 endpoints wide); got "
+                f"{cell_cap}")
+        if topk < 1:
+            raise ValueError(f"fleet topk must be >= 1; got {topk}")
+        if topk * cell_cap > C.M_BUCKETS[-1]:
+            raise ValueError(
+                f"fleet topk*cell_cap = {topk * cell_cap} exceeds the "
+                f"largest compressed bucket {C.M_BUCKETS[-1]} — the "
+                f"whole candidate block must fit one dense cycle")
+        self.fleet_topk = int(topk)
+        self.fleet_cell_cap = int(cell_cap)
+        super().__init__(
+            cfg, weights, predictor_fn, predictor_params, seed, mesh=None)
+        self.deploy_mesh = mesh
+        self._jit = jax.jit(
+            functools.partial(
+                fleet_cycle, cfg=cfg, predictor_fn=predictor_fn,
+                cell_cap=self.fleet_cell_cap, topk=self.fleet_topk,
+            ),
+            donate_argnums=0,
+        )
+        self._resize = jax.jit(
+            functools.partial(
+                fleet_resize_state, cell_cap=self.fleet_cell_cap),
+            static_argnames=("m",),
+        )
+        # Sketch-mode eviction twin: load + duals only — one endpoint
+        # dying must not clear its whole CELL's sketch bit (survivors
+        # still hold the chunks).
+        self._evict_sketch = jax.jit(
+            lambda st, slot: st.replace(
+                assumed_load=st.assumed_load.at[slot].set(0.0),
+                ot_v=st.ot_v.at[slot].set(1.0),
+            ),
+            donate_argnums=0,
+        )
+        # /debugz/fleet counters, fed by the batching completer (host
+        # arrays, never under the pick lock): rank histogram of where
+        # the final pick landed in its request's candidate list, and
+        # per-cell pick tallies (reported bounded).
+        self._fleet_lock = threading.Lock()
+        self._rank_hits: collections.Counter = collections.Counter()
+        self._cell_picks: collections.Counter = collections.Counter()
+        self._fleet_waves = 0
+
+    # -- width policy ------------------------------------------------------
+
+    def _m_ok(self, m: int) -> bool:
+        if m % self.fleet_cell_cap:
+            return False
+        if m in C.M_BUCKETS:
+            return True
+        return m > C.M_BUCKETS[-1] and (m // self.fleet_cell_cap) % 32 == 0
+
+    def _init_state(self, m: int) -> SchedState:
+        if m <= C.M_BUCKETS[-1]:
+            return SchedState.init(m=m)
+        return SchedState(
+            prefix=PrefixTable.empty(
+                C.PREFIX_SLOTS, m // self.fleet_cell_cap),
+            assumed_load=jnp.zeros((m,), jnp.float32),
+            rr=jnp.zeros((), jnp.uint32),
+            tick=jnp.zeros((), jnp.uint32),
+            ot_v=jnp.ones((m,), jnp.float32),
+        )
+
+    def _fleet_width_for(self, n: int) -> int:
+        """Smallest valid width covering slot n-1: a dense M bucket while
+        those fit, else the next multiple of cell_cap*32 (cells stay a
+        multiple of the 32-bit sketch packing word)."""
+        if n <= C.M_BUCKETS[-1]:
+            return m_bucket_for(n)
+        step = self.fleet_cell_cap * 32
+        return -(-n // step) * step
+
+    def compression_ratio(self, m: int) -> float:
+        """Fraction of the fleet the dense stage actually scores at
+        width m (the per-wave flight-record/bench figure)."""
+        cells = max(m // self.fleet_cell_cap, 1)
+        k_sel = min(self.fleet_topk, cells)
+        return m_bucket_for(k_sel * self.fleet_cell_cap) / float(m)
+
+    # -- event-path overrides for the sketch regime ------------------------
+
+    def evict_endpoint(self, slot: int) -> None:
+        with self._lock:
+            if any(e[1] == slot for e in self._kv_journal):
+                self._kv_journal = collections.deque(
+                    (e for e in self._kv_journal if e[1] != slot),
+                    maxlen=self._KV_JOURNAL_MAX)
+            if slot >= self.state.m:
+                return
+            if _is_sketch(self.state):
+                self.state = self._evict_sketch(self.state, jnp.int32(slot))
+            else:
+                self.state = self._evict(self.state, jnp.int32(slot))
+
+    def clear_prefix_endpoint(self, slot: int) -> None:
+        with self._lock:
+            if slot >= self.state.m or _is_sketch(self.state):
+                # Sketch grain cannot express one endpoint's cache reset
+                # without erasing its cell-mates' affinity; the index is
+                # approximate and the stale bit ages out (prefix_max_age).
+                return
+            self.state = self._clear_prefix(self.state, jnp.int32(slot))
+
+    def _fold_prefix_events_locked(self, state, slot, stored, removed):
+        if slot >= state.m:
+            # Grow here, not in super(): the base grow path only knows
+            # dense M buckets and would reject a fleet-regime slot.
+            state = self._resize(state, m=self._fleet_width_for(slot + 1))
+        if not _is_sketch(state):
+            return super()._fold_prefix_events_locked(
+                state, slot, stored, removed)
+        # Cell-grain ingest: stored chunks set the CELL's sketch bit;
+        # removals are dropped (one endpoint evicting a chunk says
+        # nothing about its cell-mates — same one-sided rule as
+        # clear_prefix_endpoint above).
+        cell = slot // self.fleet_cell_cap
+        for start in range(0, len(stored), self._EVENT_BUCKETS[-1]):
+            part = stored[start:start + self._EVENT_BUCKETS[-1]]
+            bucket = next(
+                b for b in self._EVENT_BUCKETS if len(part) <= b)
+            padded = np.zeros((bucket,), np.uint32)
+            padded[: len(part)] = part
+            state = state.replace(prefix=self._ingest(
+                state.prefix, jnp.asarray(padded), jnp.int32(cell),
+                state.tick, remove=False))
+        return state
+
+    # -- observability -----------------------------------------------------
+
+    def note_fleet_wave(
+        self, cand_cells: np.ndarray, primary_slots: np.ndarray
+    ) -> None:
+        """Completer-side tally (host arrays, no device pull): where in
+        its candidate list did each request's final pick land."""
+        chosen_cells = primary_slots // self.fleet_cell_cap
+        picked = primary_slots >= 0
+        ranks = np.argmax(
+            cand_cells == chosen_cells[:, None], axis=1)
+        listed = (cand_cells == chosen_cells[:, None]).any(axis=1)
+        with self._fleet_lock:
+            self._fleet_waves += 1
+            for rank, ok, p in zip(ranks, listed, picked):
+                if not p:
+                    continue
+                self._rank_hits[int(rank) if ok else -1] += 1
+            for cell, p in zip(chosen_cells, picked):
+                if p:
+                    self._cell_picks[int(cell)] += 1
+
+    def fleet_report(self, max_cells: int = 32) -> dict:
+        """/debugz/fleet payload: static config + bounded tallies (the
+        cell table is truncated to the hottest `max_cells` rows plus an
+        aggregate, so the page's cardinality is bounded regardless of
+        fleet size — same rule obs-check enforces on metric labels)."""
+        m = self.state.m
+        with self._fleet_lock:
+            ranks = dict(sorted(self._rank_hits.items()))
+            hot = self._cell_picks.most_common(max_cells)
+            other = sum(self._cell_picks.values()) - sum(
+                c for _, c in hot)
+            waves = self._fleet_waves
+        return {
+            "topk": self.fleet_topk,
+            "cell_cap": self.fleet_cell_cap,
+            "cells": m // self.fleet_cell_cap,
+            "fleet_m": m,
+            "mode": "sketch" if _is_sketch(self.state) else "exact",
+            "compression_ratio": round(self.compression_ratio(m), 6),
+            "waves": waves,
+            # rank -> picks landing on the request's rank-th candidate
+            # cell; -1 = the tail filter walked outside the list.
+            "topk_hit_histogram": {str(k): v for k, v in ranks.items()},
+            "hot_cells": [
+                {"cell": c, "picks": n} for c, n in hot],
+            "other_cell_picks": max(other, 0),
+        }
+
+    def debug_report(self) -> dict:
+        report = super().debug_report()
+        report["fleet"] = {
+            "topk": self.fleet_topk,
+            "cell_cap": self.fleet_cell_cap,
+            "compression_ratio": round(
+                self.compression_ratio(self.state.m), 6),
+        }
+        return report

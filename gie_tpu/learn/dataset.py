@@ -46,6 +46,15 @@ from gie_tpu.obs.recorder import load_records
 # trains cleanly and the default is visible, not silent.
 DEFAULT_FEATURES: tuple[str, ...] = ("queue", "kv_cache", "assumed_load")
 
+# Schema-v2 breakdown (gie_tpu/obs/recorder.py SCHEMA_VERSION): the
+# device-gathered prefix/session affinity of the CHOSEN endpoint ride
+# along in ``scorers`` (PickResult.affinity — the gie-learn residual:
+# v1 policies trained blind to locality because the completer could not
+# reconstruct those columns host-side). v1 dumps train under this schema
+# too: the absent columns default to _NEUTRAL with counted
+# ``defaulted_prefix`` / ``defaulted_session`` reasons.
+AFFINITY_FEATURES: tuple[str, ...] = DEFAULT_FEATURES + ("prefix", "session")
+
 _NEUTRAL = np.float32(1.0)
 
 

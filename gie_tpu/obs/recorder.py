@@ -44,7 +44,16 @@ from typing import Optional
 # additive fields — loaders tolerate unknown fields by contract
 # (:func:`load_records`). Version history lives in docs/OBSERVABILITY.md
 # ("record schema").
-SCHEMA_VERSION = 1
+#
+# v2: ``scorers`` grew device-gathered ``prefix``/``session`` affinity
+# columns (PickResult.affinity — the v1 breakdown only carried the three
+# host-reconstructible columns, so a v2 trainer reading a v1 dump sees
+# them defaulted-and-counted by gie_tpu/learn/dataset.py, same as any
+# absent column). A meaning bump, not additive: ``scorers`` changed from
+# "everything host-derivable" to "the device blend's locality columns
+# included". Hierarchical picks may also carry a ``fleet`` provenance
+# object (candidate cells / coarse scores / compression) — additive.
+SCHEMA_VERSION = 2
 
 
 def load_records(text: str, stats: Optional[dict] = None) -> list[dict]:

@@ -77,16 +77,22 @@ def state_shardings(mesh: Mesh):
     endpoint-axis vectors (assumed load, sinkhorn column duals) tp-shard —
     the duals' explicit sharding is what lets the warm start flow through
     sharded_cycle wave to wave without an implicit replicate/reshard pair
-    around every cycle — and the packed prefix-presence words tp-shard
-    when every M bucket's word count divides tp (tp <= 2: the smallest
-    bucket packs M_BUCKETS[0]/32 words and one jitted cycle must accept
-    every bucket). Table keys/ages are M-independent and replicate; rr and
-    tick are scalars."""
+    around every cycle — and the packed prefix-presence matrix always
+    tp-shards: on the WORD axis when every M bucket's word count divides
+    tp (tp <= 2: the smallest bucket packs M_BUCKETS[0]/32 words and one
+    jitted cycle must accept every bucket), otherwise on the TABLE-SLOT
+    axis (PREFIX_SLOTS = 32768 rows divides any power-of-two tp; the
+    match gather and insert scatter both index rows independently, so the
+    slot cut costs the same collectives the replicated fallback paid in
+    full-table broadcasts — closes the PR 15 'present replicates at
+    tp > 2' residual). Table keys/ages are M-independent and replicate;
+    rr and tick are scalars."""
     repl = NamedSharding(mesh, P())
     ep = NamedSharding(mesh, P("tp"))
     tp = int(mesh.shape["tp"])
     words_ok = (C.M_BUCKETS[0] // 32) % tp == 0
-    present = NamedSharding(mesh, P(None, "tp")) if words_ok else repl
+    present = NamedSharding(
+        mesh, P(None, "tp") if words_ok else P("tp", None))
     return SchedState(
         prefix=PrefixTable(keys=repl, present=present, ages=repl),
         assumed_load=ep,
@@ -150,6 +156,11 @@ def sharded_cycle(mesh: Mesh, cfg, predictor_fn=None, donate_state: bool = False
         status=dp1,
         scores=dp2,
         prefill=dp1 if getattr(cfg, "pd_disaggregation", False) else None,
+        affinity=dp2 if getattr(cfg, "record_affinity", False) else None,
+        # The hierarchical fleet cycle never runs under sharded_cycle (its
+        # compressed block is deliberately unsharded) — dense results
+        # carry fleet=None, matching the dense pytree.
+        fleet=None,
     )
     out_sh = (result_sh, state_shardings(mesh))
     donate = (0,) if donate_state else ()

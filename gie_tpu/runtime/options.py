@@ -51,6 +51,16 @@ class Options:
     # local devices (0 = single-device). Results are bit-identical to
     # single-device (tests/test_distributed_equivalence.py).
     mesh_devices: int = 0
+    # Hierarchical two-level pick cycle (gie_tpu/fleet, docs/FLEET.md):
+    # a coarse stage over per-cell rows selects the top K candidate
+    # cells per wave, and the dense scorer chain runs only over their
+    # gathered endpoints. 0 = off, the dense path stays byte-identical;
+    # picks are bitwise-identical to dense whenever K covers every cell
+    # (tests/test_fleet.py).
+    fleet_topk: int = 0
+    # Endpoint slots per fleet cell (multiple of 32; cells are contiguous
+    # slot ranges — a federation peer's imported block or a pool shard).
+    fleet_cell_cap: int = 64
     # KV-cache event ingestion (reference roadmap item 1, remote-cache
     # interface): HTTP port accepting JSON-lines BlockStored/BlockRemoved/
     # AllBlocksCleared pushes from model servers or cache sidecars
@@ -323,6 +333,14 @@ class Options:
         parser.add_argument("--mesh-devices", type=int, default=d.mesh_devices,
                             help="dp-shard the scheduling cycle over the "
                                  "first N local devices (0 = single-device)")
+        parser.add_argument("--fleet-topk", type=int, default=d.fleet_topk,
+                            help="hierarchical pick: score only the top-K "
+                                 "candidate cells per wave (0 = off, dense "
+                                 "path byte-identical)")
+        parser.add_argument("--fleet-cell-cap", type=int,
+                            default=d.fleet_cell_cap,
+                            help="endpoint slots per fleet cell (multiple "
+                                 "of 32)")
         parser.add_argument("--kv-events-port", type=int,
                             default=d.kv_events_port,
                             help="HTTP port for KV-cache event pushes "
@@ -674,6 +692,8 @@ class Options:
             objectives=list(args.objectives),
             scheduler_config=args.scheduler_config,
             mesh_devices=args.mesh_devices,
+            fleet_topk=args.fleet_topk,
+            fleet_cell_cap=args.fleet_cell_cap,
             kv_events_port=args.kv_events_port,
             kv_events_bind=args.kv_events_bind,
             kv_events_token=args.kv_events_token,
@@ -771,6 +791,20 @@ class Options:
         # power of two to divide the request buckets (sched/profile.py).
         if self.mesh_devices > 1 and self.mesh_devices & (self.mesh_devices - 1):
             raise ValueError("--mesh-devices must be a power of two")
+        if self.fleet_topk < 0:
+            raise ValueError("--fleet-topk must be >= 0 (0 = off)")
+        if self.fleet_topk:
+            if self.fleet_cell_cap < 32 or self.fleet_cell_cap % 32:
+                raise ValueError(
+                    "--fleet-cell-cap must be a positive multiple of 32")
+            # The candidate block must fit one dense cycle (the largest
+            # compressed M bucket) — reject at startup, not first wave.
+            from gie_tpu.sched import constants as _C
+            if self.fleet_topk * self.fleet_cell_cap > _C.M_BUCKETS[-1]:
+                raise ValueError(
+                    f"--fleet-topk x --fleet-cell-cap = "
+                    f"{self.fleet_topk * self.fleet_cell_cap} exceeds the "
+                    f"largest compressed bucket {_C.M_BUCKETS[-1]}")
         if not (0 <= self.kv_events_port < 65536):
             raise ValueError("--kv-events-port out of range")
         if not (0 <= self.replication_port < 65536):

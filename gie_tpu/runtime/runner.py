@@ -147,13 +147,34 @@ class ExtProcServerRunner:
                 mesh = make_mesh(opts.mesh_devices)
                 self.log.info("multi-chip scheduling mesh",
                               shape=dict(mesh.shape))
-            self.scheduler = Scheduler(
-                cfg,
-                weights=weights,
-                predictor_fn=predictor_fn,
-                predictor_params=predictor_params,
-                mesh=mesh,
-            )
+            if opts.fleet_topk > 0:
+                # Hierarchical two-level pick (gie_tpu/fleet,
+                # docs/FLEET.md): coarse cell stage + candidate-compressed
+                # dense stage. Drop-in Scheduler facade; with
+                # fleet_topk == 0 this branch never runs and the dense
+                # path is byte-identical to the flag not existing.
+                from gie_tpu.fleet import FleetPicker
+
+                self.scheduler = FleetPicker(
+                    cfg,
+                    weights=weights,
+                    predictor_fn=predictor_fn,
+                    predictor_params=predictor_params,
+                    mesh=mesh,
+                    topk=opts.fleet_topk,
+                    cell_cap=opts.fleet_cell_cap,
+                )
+                self.log.info(
+                    "fleet picker armed", topk=opts.fleet_topk,
+                    cell_cap=opts.fleet_cell_cap)
+            else:
+                self.scheduler = Scheduler(
+                    cfg,
+                    weights=weights,
+                    predictor_fn=predictor_fn,
+                    predictor_params=predictor_params,
+                    mesh=mesh,
+                )
             if self.trainer is not None:
                 # A restored checkpoint carries its confidence state: apply
                 # it now, or a converged opted-in column would sit at weight
@@ -624,6 +645,14 @@ class ExtProcServerRunner:
             # flag — the full spill-policy explanation.
             providers["federation"] = (
                 lambda q: self.fed_exchange.report())
+        if hasattr(self.scheduler, "fleet_report"):
+            # /debugz/fleet (docs/FLEET.md): fleet geometry, compression
+            # ratio, the top-K hit histogram (K-bounded) and the hottest
+            # cells (row-bounded) — cardinality stays fixed no matter how
+            # many cells the fleet grows, same rule obs-check enforces.
+            providers["fleet"] = (
+                lambda q: self.scheduler.fleet_report(
+                    max_cells=int(q.get("cells", "32"))))
         return providers
 
     def _policy_report(self) -> dict:

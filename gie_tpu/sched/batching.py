@@ -1418,6 +1418,26 @@ class BatchingTPUPicker:
         prefill_np = (
             np.asarray(result.prefill) if result.prefill is not None else None
         )
+        # Device-gathered affinity provenance (flight-record schema v2,
+        # ProfileConfig.record_affinity): (prefix, session) scorer values
+        # at the chosen endpoint, already host-side with the result —
+        # the completer never re-derives them.
+        affinity_np = (
+            np.asarray(result.affinity)
+            if getattr(result, "affinity", None) is not None else None
+        )
+        # Hierarchical fleet provenance (gie_tpu/fleet): per-request
+        # candidate cells feed both the flight record and the picker's
+        # /debugz/fleet tallies, so they materialize even with obs off.
+        fleet_aux = getattr(result, "fleet", None)
+        fleet_cells = fleet_scores = fleet_ratio = None
+        if fleet_aux is not None:
+            fleet_cells = np.asarray(fleet_aux.cells)
+            fleet_scores = np.asarray(fleet_aux.scores)
+            ratio_fn = getattr(self.scheduler, "compression_ratio", None)
+            if ratio_fn is not None:
+                fleet_ratio = round(
+                    ratio_fn(int(np.asarray(wave.eps_metrics).shape[0])), 6)
         # Ranked-fallback-tail hygiene flags, read once per wave: the
         # subset mask constrained the PRIMARY at dispatch, but the ranked
         # tail spans the whole pool — quarantined or DRAINING endpoints
@@ -1644,13 +1664,36 @@ class BatchingTPUPicker:
                             breakdown["assumed_load"] = round(
                                 min(max(1.0 - al / cfg.load_norm, 0.0),
                                     1.0), 5)
+                        if affinity_np is not None:
+                            # Device-side columns, not host approximations:
+                            # the prefix fraction depends on the live table
+                            # and session on the rendezvous hash — neither
+                            # is reconstructible from the metrics rows.
+                            breakdown["prefix"] = round(
+                                float(affinity_np[i][0]), 5)
+                            breakdown["session"] = round(
+                                float(affinity_np[i][1]), 5)
                         rec["scorers"] = breakdown
                         rec["queue_depth"] = q
                         rec["kv_util"] = kvu
+                        if fleet_cells is not None:
+                            rec["fleet"] = {
+                                "cells": [int(c) for c in fleet_cells[i]],
+                                "cell_scores": [
+                                    round(float(v), 5)
+                                    for v in fleet_scores[i]],
+                                "compression": fleet_ratio,
+                            }
                         if prefill_np is not None:
                             rec["prefill_slot"] = int(prefill_np[i])
                         res.record = recorder.append(rec)
                     item.result = res
+        if fleet_cells is not None:
+            note = getattr(self.scheduler, "note_fleet_wave", None)
+            if note is not None:
+                # One host-side tally per wave for /debugz/fleet's top-K
+                # hit histogram; arrays are already materialized above.
+                note(fleet_cells, indices[:, 0])
         # Admission runs BEFORE waiters wake: a shed decision must replace
         # the result, never race the caller reading it. The "ok" outcome is
         # counted here — after admission — so a shed pick increments only

@@ -99,6 +99,12 @@ class ProfileConfig:
     # Static so each form is its own trace; the weights stay dynamic either
     # way, so swapping a trained artifact in never recompiles.
     scorer: str = "blend"
+    # Gather the chosen endpoint's prefix-match and session columns at the
+    # primary pick, inside the cycle (PickResult.affinity — flight-record
+    # schema v2). The device already holds both columns; recomputing them
+    # host-side for the recorder would be a second (approximate) source of
+    # truth. Off = affinity stays None and the compiled pytree matches v1.
+    record_affinity: bool = True
 
     def __post_init__(self) -> None:
         # The noise temperatures are what guarantee pairwise-distinct
@@ -133,6 +139,29 @@ class ProfileConfig:
             raise ValueError(
                 "scorer='learned' is incompatible with pd_disaggregation: "
                 "the dual pick de-blends the linear total arithmetically")
+
+
+def _affinity_columns(
+    named: dict, primary: jax.Array, picked_ok: jax.Array
+) -> jax.Array:
+    """Flight-record affinity provenance -> f32[N, 2]: the (prefix,
+    session) scorer values at each request's primary pick. Disabled
+    columns read as 0.0 (exactly what the recorder's tolerant loader
+    defaults absent v1 columns to), non-OK rows likewise."""
+    n = primary.shape[0]
+    zero = jnp.zeros((n,), jnp.float32)
+    safe = jnp.maximum(primary, 0)[:, None]
+
+    def at_primary(col):
+        if col is None:
+            return zero
+        return jnp.take_along_axis(col, safe, axis=1)[:, 0]
+
+    pair = jnp.stack(
+        [at_primary(named.get("prefix")), at_primary(named.get("session"))],
+        axis=-1,
+    )
+    return jnp.where(picked_ok[:, None], pair, 0.0)
 
 
 def request_cost(reqs: RequestBatch) -> jax.Array:
@@ -349,6 +378,9 @@ def scheduling_cycle(
     m = state.assumed_load.shape[0]
     primary = result.indices[:, 0]                  # i32[N], -1 on non-OK
     picked_ok = primary >= 0
+    if cfg.record_affinity:
+        result = result.replace(
+            affinity=_affinity_columns(named, primary, picked_ok))
     cost = jnp.where(picked_ok, request_cost(reqs), 0.0)
     slot = jnp.where(picked_ok, primary, m - 1)
     added = jnp.zeros((m,), jnp.float32).at[slot].add(cost)
@@ -503,6 +535,13 @@ def _pd_cycle(
         status=status,
         scores=d_res.scores,
         prefill=jnp.where(ok, p_primary, -1),
+        # Affinity is a PREFILL-side property (the locality columns were
+        # dropped from the decode blend on purpose) — gather at the
+        # prefill pick, not the decode destination.
+        affinity=(
+            _affinity_columns(named, p_primary, ok)
+            if cfg.record_affinity else None
+        ),
     )
     return result, new_state
 
@@ -609,7 +648,9 @@ class Scheduler:
         self.predictor_params = predictor_params
         # State starts at the smallest M bucket; the first pick migrates it
         # to whatever width the caller's EndpointBatch arrives with.
-        self.state = SchedState.init(m=C.M_BUCKETS[0])
+        # (_init_state, not SchedState.init directly: subclasses carry a
+        # differently-shaped prefix index — fleet.FleetPicker's sketch.)
+        self.state = self._init_state(C.M_BUCKETS[0])
         self._key = jax.random.PRNGKey(seed)
         self._lock = threading.Lock()
         # (monotonic ts, slot, stored, removed) of recent KV events —
@@ -680,13 +721,23 @@ class Scheduler:
         # consumers need.
         self.warm_inline_compiles = 0
 
+    # Width-policy hooks — the two places the facade assumes "endpoint
+    # width = dense M bucket", factored out so fleet.FleetPicker (whose
+    # widths run past the dense buckets and whose prefix index is a
+    # cell-granular sketch there) overrides policy, not plumbing.
+    def _m_ok(self, m: int) -> bool:
+        return m in C.M_BUCKETS
+
+    def _init_state(self, m: int) -> SchedState:
+        return SchedState.init(m=m)
+
     def _warm(self, reqs: RequestBatch, eps: EndpointBatch) -> None:
         """Compile a bucket shape OUTSIDE the state lock by running the cycle
         on a throwaway state, so first-use compilation never stalls
         concurrent pick()/complete() calls. The throwaway state is donated
         and discarded; the live state is untouched."""
         self._jit(
-            SchedState.init(m=int(eps.valid.shape[0])), reqs, eps,
+            self._init_state(int(eps.valid.shape[0])), reqs, eps,
             self.weights, jax.random.PRNGKey(0), self.predictor_params,
         )
 
@@ -765,9 +816,10 @@ class Scheduler:
         bucket = bucket_for(max(n, self._min_bucket))
         reqs = pad_requests(reqs, bucket)
         m = int(eps.valid.shape[0])
-        if m not in C.M_BUCKETS:
+        if not self._m_ok(m):
             raise ValueError(
-                f"EndpointBatch width {m} is not an M bucket {C.M_BUCKETS}")
+                f"EndpointBatch width {m} is not an M bucket {C.M_BUCKETS} "
+                f"(or a valid fleet width for this scheduler)")
         if int(reqs.subset_mask.shape[1]) != m:
             raise ValueError(
                 f"subset_mask width {reqs.subset_mask.shape[1]} != "

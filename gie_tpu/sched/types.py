@@ -181,6 +181,17 @@ class PickResult:
     # run prefill on (x-gateway-prefill-endpoint). None in classic mode so
     # the pytree structure — and every compiled cycle — is unchanged.
     prefill: object = None  # i32[N] | None
+    # Device-side affinity provenance (flight-record schema v2,
+    # ProfileConfig.record_affinity): the chosen endpoint's prefix-match
+    # and session columns, gathered at the primary pick inside the cycle
+    # so the recorder never recomputes (or worse, approximates) them
+    # host-side. None when disabled — same pytree-stability rule as
+    # `prefill`.
+    affinity: object = None  # f32[N, 2] (prefix, session) | None
+    # Hierarchical two-level picks only (gie_tpu/fleet): per-request
+    # coarse-stage candidate cells + scores (fleet.FleetAux). None on the
+    # dense cycle, so the default-off path's compiled pytree is unchanged.
+    fleet: object = None  # FleetAux | None
 
 
 @flax.struct.dataclass

@@ -130,6 +130,14 @@ class FederationSpec:
     peer_name: str = "west"
     n_pods: int = 3
     ip_base: str = "10.79.0"
+    # Fleet-scale storms (gie-fleet, docs/FLEET.md): run N peer clusters
+    # instead of one. Peer 0 keeps `peer_name` and ALL the single-peer
+    # machinery (partition, zombie split-brain, the pinned decision
+    # fingerprints are byte-identical at n_peers=1); peers 1..N-1 are
+    # named `{peer_name}{i}`, publish through their own real
+    # FederationPublisher each, and always answer (the chaos events stay
+    # scoped to peer 0).
+    n_peers: int = 1
     # Cross-cluster penalty in queue-depth units (storm-scale default:
     # small enough that a saturated local pool actually spills).
     penalty: float = 2.0
@@ -205,6 +213,12 @@ class EngineConfig:
     # pinned pre-learn decision fingerprint.
     scorer: str = "blend"
     policy_weights: tuple = ()
+    # gie-fleet (docs/FLEET.md): > 0 serves the storm through the
+    # hierarchical FleetPicker (coarse cell stage + candidate-compressed
+    # dense stage) with that top-K. 0 — the default, preserving every
+    # pinned pre-fleet decision fingerprint — keeps the flat Scheduler.
+    fleet_topk: int = 0
+    fleet_cell_cap: int = 64
 
     def fast_ladder(self) -> LadderConfig:
         return LadderConfig(
@@ -522,7 +536,20 @@ class StormEngine:
             from gie_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(cfg.mesh_devices)
-        self.scheduler = Scheduler(prof, weights=weights, mesh=mesh)
+        if cfg.fleet_topk > 0:
+            # gie-fleet (docs/FLEET.md): the hierarchical two-level pick
+            # cycle — coarse cell stage, then the unchanged dense chain
+            # over the gathered candidate block. With a covering top-K
+            # the decision fingerprint is bitwise-identical to the flat
+            # scheduler's (the parity contract tests/test_storm.py pins
+            # across 16 simulated clusters).
+            from gie_tpu.fleet import FleetPicker
+
+            self.scheduler = FleetPicker(
+                prof, weights=weights, mesh=mesh,
+                topk=cfg.fleet_topk, cell_cap=cfg.fleet_cell_cap)
+        else:
+            self.scheduler = Scheduler(prof, weights=weights, mesh=mesh)
         # Virtual mode hands every subsystem the same clock; real mode
         # keeps each subsystem's historical default (monotonic for the
         # resilience layer, wall time for the store's row stamps).
@@ -552,7 +579,9 @@ class StormEngine:
         # -- federation peer cluster (gie-fed, docs/FEDERATION.md) ---------
         self.fed_state = self.fed_exchange = None
         self.peer_pub = self.peer_server = None
+        self.peer_pubs: dict = {}
         self._peer_hostports: set[str] = set()
+        self._peer_cluster: dict[str, str] = {}
         self._fed_partitioned = False
         self._zombie_pub = None
         self._zombie_alternator = 0
@@ -565,45 +594,68 @@ class StormEngine:
             )
             from gie_tpu.federation import summary as fed_summary
 
-            # Peer fleet: same stub dict (the data plane routes by
-            # hostport), never the local datastore — the peer's pods
-            # become schedulable only through the digest import.
+            # Peer fleets: same stub dict (the data plane routes by
+            # hostport), never the local datastore — a peer's pods
+            # become schedulable only through the digest import. Peer 0
+            # keeps fed.peer_name / fed.ip_base (the classic single-peer
+            # engine, byte-identical at n_peers=1); fleet-scale storms
+            # add peers "{peer_name}{i}" on bumped second-octet subnets.
             stub_cfg = pool.stub_cfgs()[0]
-            for i in range(fed.n_pods):
-                hostport = f"{fed.ip_base}.{i + 1}:8000"
-                self._stubs[hostport] = _StubSlot(
-                    VLLMStub(stub_cfg, name=f"{fed.peer_name}-p{i}"))
-                self._stubs[hostport].stub.hostport = hostport
-                self._peer_hostports.add(hostport)
+            octets = fed.ip_base.split(".")
+            peer_specs: list[tuple[str, str]] = []
+            for p in range(max(1, fed.n_peers)):
+                name = fed.peer_name if p == 0 else f"{fed.peer_name}{p}"
+                ip_base = (fed.ip_base if p == 0 else
+                           f"{octets[0]}.{int(octets[1]) + p}.{octets[2]}")
+                peer_specs.append((name, ip_base))
+            peer_hosts: dict[str, list[str]] = {}
+            for name, ip_base in peer_specs:
+                hosts = []
+                for i in range(fed.n_pods):
+                    hostport = f"{ip_base}.{i + 1}:8000"
+                    self._stubs[hostport] = _StubSlot(
+                        VLLMStub(stub_cfg, name=f"{name}-p{i}"))
+                    self._stubs[hostport].stub.hostport = hostport
+                    self._peer_hostports.add(hostport)
+                    self._peer_cluster[hostport] = name
+                    hosts.append(hostport)
+                peer_hosts[name] = sorted(hosts)
 
-            def _peer_meta():
-                return fed_summary.encode_meta(
-                    self.peer_pub.era, False, fed.peer_name)
+            def _make_sections(name: str, hosts: list[str]):
+                def _peer_meta():
+                    return fed_summary.encode_meta(
+                        self.peer_pubs[name].era, False, name)
 
-            def _peer_load():
-                rows = []
-                with self._world_lock:
-                    for hostport in sorted(self._peer_hostports):
-                        slot = self._stubs.get(hostport)
-                        if slot is None or not slot.alive:
-                            continue
-                        rows.append((hostport,
-                                     float(len(slot.stub.queue)),
-                                     float(slot.stub.kv_utilization()),
-                                     False))
-                return fed_summary.encode_load(
-                    rows, max_endpoints=64)
+                def _peer_load():
+                    rows = []
+                    with self._world_lock:
+                        for hostport in hosts:
+                            slot = self._stubs.get(hostport)
+                            if slot is None or not slot.alive:
+                                continue
+                            rows.append((hostport,
+                                         float(len(slot.stub.queue)),
+                                         float(slot.stub.kv_utilization()),
+                                         False))
+                    return fed_summary.encode_load(
+                        rows, max_endpoints=64)
 
-            self.peer_pub = FederationPublisher(
-                {fed_summary.META_SECTION: _peer_meta,
-                 fed_summary.LOAD_SECTION: _peer_load},
-                era_seq=1,
-                # Deterministic era token: the pair's ordering semantics
-                # never read it, but a reproducible scorecard should not
-                # carry run-unique randomness.
-                era_token=(self.program.seed & 0x7FFF_FFFF) or 1,
-                clock=self.clock)
-            self.peer_pub.refresh()
+                return {fed_summary.META_SECTION: _peer_meta,
+                        fed_summary.LOAD_SECTION: _peer_load}
+
+            for p, (name, _ip) in enumerate(peer_specs):
+                self.peer_pubs[name] = FederationPublisher(
+                    _make_sections(name, peer_hosts[name]),
+                    era_seq=1,
+                    # Deterministic era token: the pair's ordering
+                    # semantics never read it, but a reproducible
+                    # scorecard should not carry run-unique randomness.
+                    era_token=((self.program.seed + p) & 0x7FFF_FFFF) or 1,
+                    clock=self.clock)
+                self.peer_pubs[name].refresh()
+            # The first peer IS the classic peer: every single-peer seam
+            # (partition, zombie, the scorecard's peer_era) aliases it.
+            self.peer_pub = self.peer_pubs[fed.peer_name]
             self.fed_state = FederationState(
                 self.datastore, self.metrics_store,
                 scheduler=self.scheduler,
@@ -619,8 +671,12 @@ class StormEngine:
                 # The transport is the injected in-process fetch (the
                 # same serve() surface the HTTP handler fronts; real-
                 # wire long-poll is pinned by tests/test_federation.py)
-                # — the partition/zombie machinery needs the seam.
-                peers={fed.peer_name: "storm://peer"},
+                # — the partition/zombie machinery needs the seam. The
+                # first peer keeps the historic bare URL (pinned
+                # fingerprints); extra peers route by path suffix.
+                peers={name: ("storm://peer" if name == fed.peer_name
+                              else f"storm://peer/{name}")
+                       for name in self.peer_pubs},
                 serve=False,
                 interval_s=fed.interval_s,
                 wait_s=fed.wait_s,
@@ -707,15 +763,19 @@ class StormEngine:
                 ep.slot, f"http://{ep.hostport}/metrics", VLLM)
 
     def _cluster_of(self, hostport: str) -> str:
-        return (self.cfg.federation.peer_name
-                if hostport in self._peer_hostports else "local")
+        return self._peer_cluster.get(hostport, "local")
 
     def _fed_fetch(self, url, since, era, etag, wait_s):
         """PeerLink transport for federation storms: the real peer
-        publisher over an in-process call, with the partition flag
-        severing it and — after a split-brain heal — the ZOMBIE old-era
+        publishers over an in-process call. The FIRST peer (bare
+        "storm://peer" URL) carries the chaos seams — the partition flag
+        severing it and, after a split-brain heal, the ZOMBIE old-era
         publisher answering alternate polls (the deterministic
-        interleave whose convergence the scorecard pins)."""
+        interleave whose convergence the scorecard pins). Extra fleet
+        peers ("storm://peer/<name>") always answer."""
+        name = url.rsplit("/", 1)[-1] if url.count("/") > 2 else None
+        if name is not None and name in self.peer_pubs:
+            return self._serve_peer(name, since, era, etag, wait_s)
         if self._fed_partitioned:
             raise ConnectionError("storm: peer link partitioned")
         if self._zombie_pub is not None:
@@ -728,6 +788,11 @@ class StormEngine:
 
     def _fed_exchange_fetch(self, url, since, era, etag, wait_s):
         return self.peer_pub.serve(
+            since=since, era=era, if_none_match=etag,
+            wait_s=min(wait_s, 0.2))
+
+    def _serve_peer(self, name, since, era, etag, wait_s):
+        return self.peer_pubs[name].serve(
             since=since, era=era, if_none_match=etag,
             wait_s=min(wait_s, 0.2))
 
@@ -1101,8 +1166,9 @@ class StormEngine:
         self._fed_started = True
         self.fed_exchange.start()
         deadline = self.clock.now() + 5.0
-        link = next(iter(self.fed_exchange.links.values()))
-        while self.clock.now() < deadline and link.installs == 0:
+        links = list(self.fed_exchange.links.values())
+        while (self.clock.now() < deadline
+               and any(link.installs == 0 for link in links)):
             self.clock.sleep(0.02)
 
     def _spawn_worker(self, a) -> threading.Thread:
@@ -1267,7 +1333,8 @@ class StormEngine:
                     # verdict timeline the partition property is
                     # asserted on.
                     try:
-                        self.peer_pub.refresh()
+                        for pub in self.peer_pubs.values():
+                            pub.refresh()
                         self.fed_state.observe()
                     except Exception:
                         pass
@@ -1427,6 +1494,11 @@ class StormEngine:
             # spread + admission queueing — the storm-ci monotone-
             # throughput and no-skew assertions read these.
             card["extproc"] = self._admission.report()
+        if hasattr(self.scheduler, "fleet_report"):
+            # Hierarchical-picker section (gie-fleet): coarse-stage
+            # provenance — top-K hit ranks, hot cells, compression — the
+            # fleet storm's mis-spill and parity assertions read these.
+            card["fleet"] = self.scheduler.fleet_report()
         if self.fed_state is not None:
             # Per-cluster federation section (gie-fed): the four pinned
             # properties — spill with CRITICAL locality, drain bleed,
@@ -1446,6 +1518,7 @@ class StormEngine:
                     crit_remote += n
             card["federation"] = {
                 "peer": fed.peer_name,
+                "peers": sorted(self.peer_pubs),
                 "local_only_after_s": fed.local_only_after_s,
                 "picks": picks_by_cluster,
                 "serves": dict(self._fed_serves),
@@ -1477,6 +1550,9 @@ _STORM_DRIVE_KEYS = frozenset({
     "autoscale_interval_s",
     # gie-wire: the multi-core admission model (0 workers = off).
     "extproc_workers", "extproc_admission_s",
+    # gie-fleet: the hierarchical two-level picker (0 topk = off) and
+    # the sharded-cycle path it composes with.
+    "fleet_topk", "fleet_cell_cap", "mesh_devices",
 })
 
 
@@ -1516,7 +1592,9 @@ def engine_from_drive(storm: dict, *, seed: int,
                       ("world_dt_s", float),
                       ("autoscale_interval_s", float),
                       ("extproc_workers", int),
-                      ("extproc_admission_s", float)):
+                      ("extproc_admission_s", float),
+                      ("fleet_topk", int), ("fleet_cell_cap", int),
+                      ("mesh_devices", int)):
         if key in storm:
             cfg = dataclasses.replace(cfg, **{key: cast(storm[key])})
     if "federation" in storm:

@@ -254,6 +254,28 @@ def test_warm_start_duals_sharded_parity():
     assert not np.array_equal(iterates[0], iterates[1])
 
 
+def test_prefix_presence_tp_sharded_never_replicated():
+    """ISSUE 18 satellite (closes the PR 15 residual): the packed
+    prefix-presence matrix tp-shards at EVERY power-of-two mesh size —
+    on the word axis while the smallest bucket's word count divides tp,
+    on the table-slot axis beyond that — never silently replicating the
+    32768-row table per device."""
+    from jax.sharding import PartitionSpec as P
+
+    from gie_tpu.parallel.mesh import state_shardings
+    from gie_tpu.sched import constants as C
+
+    assert len(jax.devices()) >= 8
+    words = C.M_BUCKETS[0] // 32
+    for tp in (1, 2, 4, 8):
+        spec = state_shardings(make_mesh(tp, tp=tp)).prefix.present.spec
+        assert spec != P(), f"present replicated at tp={tp}"
+        if words % tp == 0:
+            assert spec == P(None, "tp"), (tp, spec)
+        else:
+            assert spec == P("tp", None), (tp, spec)
+
+
 def test_pd_cycle_sharded_equivalence():
     """The dual prefill/decode pick must survive dp-sharding bit-for-bit
     (both picks, status merge, and split load charging)."""

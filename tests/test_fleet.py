@@ -1,0 +1,430 @@
+"""gie-fleet test suite (ISSUE 18, docs/FLEET.md).
+
+Four tiers:
+
+  cells      bounded cell-index construction — per-cell means over valid
+             slots only, the LoRA residency bloom, dead-cell masking.
+  compress   gather/scatter round-trips: covering selection is the
+             identity permutation, recycled prefix rows clear FLEET-wide,
+             compact<->broadcast presence crossing the exact/sketch
+             boundary, fleet_resize_state's four transitions.
+  recall     the seeded coarse-recall property: the dense cycle's argmax
+             endpoint's cell appears in the request's top-K candidate
+             list — monotone in K, exact at covering K.
+  parity     the keystone: with K covering every cell, the hierarchical
+             cycle is BITWISE the dense cycle — matrix over mesh size
+             {1, 2, 4, 8} x picker {topk, sinkhorn, random} x ragged M,
+             including carried state across waves (non-pallas configs:
+             the pallas sinkhorn matches XLA only to atol, by design).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gie_tpu.fleet import (
+    FleetPicker,
+    broadcast_presence,
+    build_cell_rows,
+    coarse_total,
+    compact_presence,
+    fleet_cycle,
+    select_cells,
+)
+from gie_tpu.fleet.compress import (
+    gather_vec,
+    gather_words,
+    global_slots,
+    scatter_vec,
+    scatter_words,
+)
+from gie_tpu.fleet.picker import _is_sketch, fleet_resize_state
+from gie_tpu.parallel.mesh import make_mesh
+from gie_tpu.sched import Scheduler
+from gie_tpu.sched import constants as C
+from gie_tpu.sched.profile import ProfileConfig, scheduling_cycle
+from gie_tpu.sched.types import PrefixTable, SchedState, Weights
+from gie_tpu.utils.testing import make_endpoints, make_requests
+
+
+def _prompts(n, wave=0, families=4, reps=30):
+    return [b"S%d " % (i % families) * reps + b"w%d q%d" % (wave, i)
+            for i in range(n)]
+
+
+# ==========================================================================
+# cells: bounded index construction
+# ==========================================================================
+
+
+def test_cell_rows_means_over_valid_slots_only():
+    m_live, cap = 40, 32          # cell 0 full, cell 1 holds 8 of 32
+    queue = np.arange(m_live, dtype=np.float32)
+    kv = np.linspace(0.1, 0.9, m_live).astype(np.float32)
+    eps = make_endpoints(
+        m_live, queue=queue.tolist(), kv=kv.tolist(), m_slots=64)
+    load = jnp.asarray(np.arange(64, dtype=np.float32))
+    rows = build_cell_rows(eps, load, cell_cap=cap)
+    assert rows.queue.shape == (2,)
+    np.testing.assert_allclose(rows.n_valid, [32.0, 8.0])
+    np.testing.assert_allclose(
+        rows.queue, [queue[:32].mean(), queue[32:].mean()], rtol=1e-6)
+    np.testing.assert_allclose(
+        rows.kv, [kv[:32].mean(), kv[32:].mean()], rtol=1e-6)
+    # Load means divide by the VALID population, not cell_cap — dead
+    # slots carry load 0 but must not dilute the cell's signal.
+    np.testing.assert_allclose(
+        rows.load,
+        [np.arange(32).mean(), np.arange(32, 40).sum() / 8.0], rtol=1e-6)
+    assert bool(rows.valid[0]) and bool(rows.valid[1])
+
+
+def test_cell_rows_dead_cell_masked():
+    eps = make_endpoints(32, queue=[1.0] * 32, m_slots=64)
+    rows = build_cell_rows(eps, jnp.zeros(64), cell_cap=32)
+    assert bool(rows.valid[0]) and not bool(rows.valid[1])
+    assert float(rows.n_valid[1]) == 0.0
+
+
+def test_cell_rows_lora_residency_bloom():
+    eps = make_endpoints(
+        64, max_lora=8,
+        lora_active=[[5]] + [[]] * 63, m_slots=64)
+    rows = build_cell_rows(eps, jnp.zeros(64), cell_cap=32)
+    assert int(rows.lora[0]) & (1 << 5)
+    assert int(rows.lora[1]) == 0
+
+
+# ==========================================================================
+# compress: gathers, scatters, presence crossings
+# ==========================================================================
+
+
+def test_covering_selection_is_identity_regardless_of_scores():
+    cells, cap = 4, 32
+    m = cells * cap
+    rng = np.random.default_rng(0)
+    eps = make_endpoints(m, queue=rng.integers(0, 9, m).tolist(),
+                         m_slots=m)
+    reqs = make_requests(8, prompts=_prompts(8), m_slots=m)
+    rows = build_cell_rows(eps, jnp.zeros(m), cell_cap=cap)
+    coarse = jnp.asarray(
+        rng.standard_normal((8, cells)), jnp.float32) * 1e3
+    sel, cand, _scores = select_cells(
+        coarse, rows, reqs, eps, cell_cap=cap, k=cells)
+    np.testing.assert_array_equal(np.asarray(sel), np.arange(cells))
+    assert cand.shape == (8, cells)
+    # And the gather built from it is the identity slot map.
+    np.testing.assert_array_equal(
+        np.asarray(global_slots(sel, cell_cap=cap, m_c=m)), np.arange(m))
+
+
+def test_gather_scatter_vec_roundtrip_with_padding():
+    cap = 32
+    sel = jnp.asarray([1, 3], jnp.int32)
+    gslots = global_slots(sel, cell_cap=cap, m_c=C.M_BUCKETS[0])
+    assert gslots.shape == (64,)
+    full = jnp.asarray(np.arange(128, dtype=np.float32))
+    comp = gather_vec(full, gslots, fill=-7.0)
+    np.testing.assert_array_equal(np.asarray(comp[:32]),
+                                  np.arange(32, 64))
+    np.testing.assert_array_equal(np.asarray(comp[32:]),
+                                  np.arange(96, 128))
+    back = scatter_vec(full * 0.0, gslots, comp + 1.0)
+    expect = np.zeros(128, np.float32)
+    expect[32:64] = np.arange(32, 64) + 1
+    expect[96:128] = np.arange(96, 128) + 1
+    np.testing.assert_array_equal(np.asarray(back), expect)
+
+
+def test_scatter_words_clears_recycled_rows_fleet_wide():
+    cap, m = 32, 128
+    p_slots = 4
+    present = jnp.asarray(
+        np.full((p_slots, m // 32), 0xFFFF_FFFF, np.uint32))
+    sel = jnp.asarray([1, 3], jnp.int32)
+    comp = gather_words(present, sel, cell_cap=cap, m_c=64)
+    assert comp.shape == (p_slots, 2)
+    new_cols = jnp.zeros_like(comp).at[0, :].set(jnp.uint32(0x1))
+    # Row 1's key was recycled by the compressed insert: its OLD bits —
+    # including the ones in cells 0 and 2 the gather never touched —
+    # must clear, or a new chunk key inherits a stale endpoint set.
+    differ = jnp.asarray([False, True, False, False])
+    out = np.asarray(scatter_words(
+        present, sel, new_cols, differ, cell_cap=cap))
+    assert out[1, 0] == 0 and out[1, 2] == 0          # cleared fleet-wide
+    assert out[1, 1] == 0 and out[1, 3] == 0          # took new cols
+    assert out[0, 0] == 0xFFFF_FFFF                    # untouched cells
+    assert out[0, 1] == 0x1 and out[0, 3] == 0x1       # gathered cols land
+    assert (out[2:] [:, [0, 2]] == 0xFFFF_FFFF).all()
+
+
+def test_compact_broadcast_presence_roundtrip():
+    rng = np.random.default_rng(1)
+    m, cap = 128, 32
+    cells = m // cap
+    dense = jnp.asarray(
+        rng.integers(0, 2**32, (8, m // 32), dtype=np.uint32))
+    # 4 source cells word-align up to a 32-cell sketch axis.
+    cell_bits = compact_presence(dense, cell_cap=cap, out_cells=32)
+    assert cell_bits.shape == (8, 1)
+    back = broadcast_presence(
+        cell_bits, jnp.arange(cells, dtype=jnp.int32),
+        cell_cap=cap, m_c=m)
+    # Broadcast is the warm superset: every member of a warm cell warm.
+    assert (np.asarray(back) & np.asarray(dense) == np.asarray(dense)).all()
+    # And compacting the broadcast is a fixed point.
+    np.testing.assert_array_equal(
+        np.asarray(compact_presence(back, cell_cap=cap, out_cells=32)),
+        np.asarray(cell_bits))
+
+
+def test_fleet_resize_state_four_transitions():
+    cap = 32
+    exact = SchedState.init(m=64)
+    exact = exact.replace(
+        assumed_load=jnp.arange(64, dtype=jnp.float32),
+        prefix=exact.prefix.replace(
+            keys=exact.prefix.keys.at[0].set(jnp.uint32(0xABC)),
+            present=exact.prefix.present.at[0, 1].set(
+                jnp.uint32(1 << 3))))   # slot 35 holds chunk 0xABC
+
+    # exact -> exact: the dense migration.
+    up = fleet_resize_state(exact, m=256, cell_cap=cap)
+    assert not _is_sketch(up)
+    np.testing.assert_array_equal(
+        np.asarray(up.assumed_load[:64]), np.arange(64))
+
+    # exact -> sketch: surviving endpoints keep cluster-grain affinity.
+    sk = fleet_resize_state(exact, m=2048, cell_cap=cap)
+    assert _is_sketch(sk)
+    cells = 2048 // cap
+    assert sk.prefix.present.shape[1] == cells // 32
+    word = int(np.asarray(sk.prefix.present)[0, 0])
+    assert word & (1 << 1)             # slot 35 -> cell 1 bit survives
+    np.testing.assert_array_equal(
+        np.asarray(sk.assumed_load[:64]), np.arange(64))
+
+    # sketch -> sketch: cell axis pads (still a multiple of 32).
+    sk2 = fleet_resize_state(sk, m=4096, cell_cap=cap)
+    assert _is_sketch(sk2)
+    assert int(np.asarray(sk2.prefix.present)[0, 0]) & (1 << 1)
+
+    # sketch -> exact: every member of a warm cell starts warm.
+    down = fleet_resize_state(sk, m=64, cell_cap=cap)
+    assert not _is_sketch(down)
+    row = np.asarray(down.prefix.present)[0]
+    assert row[1] == 0xFFFF_FFFF       # cell 1's members all warm
+    assert row[0] == 0
+
+
+# ==========================================================================
+# recall: the coarse stage finds the dense argmax's cell
+# ==========================================================================
+
+
+def test_coarse_recall_monotone_and_exact_at_covering_k():
+    """The property the coarse stage exists for: a cell is a cluster, so
+    load is CORRELATED within a cell — per-cell base queue/kv plus small
+    within-cell jitter (an i.i.d.-uniform fleet has no cell structure and
+    the cell mean says nothing about the cell max; that regime is covered
+    by the covering-K parity contract instead). Each request carries a
+    subset hint spanning 4 of the 8 cells, so the dense winner — and the
+    eligibility-masked candidate list — varies per request."""
+    cap = 32
+    m = 256                            # 8 cells, a real M bucket
+    cells = m // cap
+    n = 64
+    rng = np.random.default_rng(42)
+    base_q = np.asarray([2.0, 34.0, 10.0, 28.0, 6.0, 38.0, 18.0, 26.0])
+    base_kv = np.asarray([0.1, 0.8, 0.3, 0.7, 0.15, 0.85, 0.5, 0.6])
+    queue = (np.repeat(base_q, cap)
+             + rng.uniform(0.0, 4.0, m)).astype(np.float32)
+    kv = np.clip(np.repeat(base_kv, cap)
+                 + rng.uniform(0.0, 0.05, m), 0.0, 0.95).astype(np.float32)
+    eps = make_endpoints(m, queue=queue.tolist(), kv=kv.tolist(),
+                         m_slots=m)
+    subsets = []
+    for _ in range(n):
+        allowed = rng.choice(cells, size=4, replace=False)
+        subsets.append(
+            [int(c) * cap + s for c in allowed for s in range(cap)])
+    reqs = make_requests(n, prompts=_prompts(n), subset=subsets,
+                         m_slots=m)
+    weights = Weights.default()
+    cfg = ProfileConfig()
+    state = SchedState.init(m=m)
+
+    res, _ = jax.jit(functools.partial(
+        scheduling_cycle, cfg=cfg, predictor_fn=None))(
+            state, reqs, eps, weights, jax.random.PRNGKey(7), None)
+    primary = np.asarray(res.indices)[:, 0]
+    picked = primary >= 0
+    assert picked.sum() > 32, "storm of unpicked rows — vacuous"
+    true_cell = primary[picked] // cap
+    assert len(np.unique(true_cell)) > 1, "degenerate: one winner cell"
+
+    rows = build_cell_rows(eps, state.assumed_load, cell_cap=cap)
+    coarse = coarse_total(
+        rows, jnp.zeros((n, cells), jnp.float32), reqs, weights,
+        queue_norm=cfg.queue_norm, load_norm=cfg.load_norm)
+    recalls = []
+    for k in range(1, cells + 1):
+        _sel, cand, _sc = select_cells(
+            coarse, rows, reqs, eps, cell_cap=cap, k=k)
+        hit = (np.asarray(cand)[picked] == true_cell[:, None]).any(axis=1)
+        recalls.append(float(hit.mean()))
+    assert recalls == sorted(recalls), recalls      # monotone in K
+    assert recalls[-1] == 1.0, recalls              # covering K is exact
+    # Seeded floors: with cell-correlated load the winner's cell leads
+    # the candidate list almost immediately.
+    assert recalls[0] >= 0.9, recalls
+    assert recalls[1] == 1.0, recalls
+
+
+# ==========================================================================
+# parity: covering K == bitwise dense, across the deployment matrix
+# ==========================================================================
+
+
+@pytest.mark.parametrize("mesh_size", [1, 2, 4, 8])
+@pytest.mark.parametrize("picker", ["topk", "sinkhorn"])
+def test_fleet_parity_matrix_covering_k(mesh_size, picker):
+    """Scheduler(mesh) vs FleetPicker(mesh) on a ragged fleet (41 live
+    endpoints on the 64 bucket) with K covering both cells: indices,
+    status, scores, and carried state must be ARRAY-EQUAL across two
+    state-carrying waves. Non-pallas configs only — the pallas sinkhorn
+    matches XLA to atol, not bitwise."""
+    assert len(jax.devices()) >= 8
+    cfg = ProfileConfig(picker=picker)
+    mesh = make_mesh(mesh_size) if mesh_size > 1 else None
+    rng = np.random.default_rng(11)
+    m = 41
+    eps = make_endpoints(
+        m,
+        queue=rng.integers(0, 30, m).tolist(),
+        kv=rng.uniform(0, 0.9, m).tolist(),
+        m_slots=64)
+    dense = Scheduler(cfg, seed=5, mesh=mesh)
+    fleet = FleetPicker(cfg, seed=5, mesh=mesh, topk=2, cell_cap=32)
+    for wave in range(2):
+        reqs = make_requests(24, prompts=_prompts(24, wave=wave),
+                             m_slots=64)
+        r1 = dense.pick(reqs, eps)
+        r2 = fleet.pick(reqs, eps)
+        np.testing.assert_array_equal(
+            np.asarray(r1.indices), np.asarray(r2.indices))
+        np.testing.assert_array_equal(
+            np.asarray(r1.status), np.asarray(r2.status))
+        np.testing.assert_array_equal(
+            np.asarray(r1.scores), np.asarray(r2.scores))
+    np.testing.assert_array_equal(
+        dense.snapshot_assumed_load(), fleet.snapshot_assumed_load())
+    np.testing.assert_array_equal(
+        np.asarray(dense.state.prefix.keys),
+        np.asarray(fleet.state.prefix.keys))
+    np.testing.assert_array_equal(
+        np.asarray(dense.state.prefix.present),
+        np.asarray(fleet.state.prefix.present))
+
+
+def test_fleet_parity_random_picker_and_aux_provenance():
+    """The random picker threads the SAME rng key through both paths;
+    the fleet result additionally carries per-request candidate-cell
+    provenance with in-range cells and finite scores."""
+    cfg = ProfileConfig(picker="random")
+    dense = Scheduler(cfg, seed=9)
+    fleet = FleetPicker(cfg, seed=9, topk=2, cell_cap=32)
+    eps = make_endpoints(64, queue=list(range(64)), m_slots=64)
+    reqs = make_requests(16, prompts=_prompts(16), m_slots=64)
+    r1 = dense.pick(reqs, eps)
+    r2 = fleet.pick(reqs, eps)
+    np.testing.assert_array_equal(
+        np.asarray(r1.indices), np.asarray(r2.indices))
+    assert r2.fleet is not None
+    cand = np.asarray(r2.fleet.cells)
+    assert cand.shape == (16, 2)
+    assert ((cand >= 0) & (cand < 2)).all()
+    assert np.isfinite(np.asarray(r2.fleet.scores)).all()
+    assert r1.fleet is None            # dense path carries no fleet aux
+
+
+def test_fleet_sketch_mode_serves_every_picker():
+    """Past the largest dense bucket (m=2048 > M_MAX): sketch-state
+    picks land on live global slots for every picker, the compression
+    ratio reflects the candidate block, and the event paths (complete /
+    evict / clear-prefix) stay serviceable."""
+    m, cap, topk = 2048, 64, 4
+    rng = np.random.default_rng(3)
+    eps = make_endpoints(
+        m,
+        queue=rng.integers(0, 30, m).tolist(),
+        kv=rng.uniform(0, 0.9, m).tolist(),
+        m_slots=m)
+    for picker in ("topk", "sinkhorn", "random"):
+        sched = FleetPicker(
+            ProfileConfig(picker=picker), seed=2, topk=topk, cell_cap=cap)
+        reqs = make_requests(16, prompts=_prompts(16), m_slots=m)
+        res = sched.pick(reqs, eps)
+        primary = np.asarray(res.indices)[:, 0]
+        ok = primary >= 0
+        assert ok.any()
+        assert (primary[ok] < m).all()
+        assert _is_sketch(sched.state)
+        assert sched.compression_ratio(m) == pytest.approx(
+            (topk * cap) / m)
+        sched.complete(int(primary[ok][0]), 1.0)
+        sched.evict_endpoint(int(primary[ok][0]))
+        sched.clear_prefix_endpoint(3)          # sketch no-op, no raise
+        report = sched.fleet_report()
+        assert report["mode"] == "sketch"
+        assert report["cells"] == m // cap
+
+
+def test_fleet_picker_validation_and_report():
+    with pytest.raises(ValueError):
+        FleetPicker(cell_cap=31)
+    with pytest.raises(ValueError):
+        FleetPicker(topk=0)
+    with pytest.raises(ValueError):
+        FleetPicker(topk=64, cell_cap=1024)    # block exceeds M_BUCKETS[-1]
+    sched = FleetPicker(topk=2, cell_cap=32)
+    report = sched.debug_report()
+    assert report["fleet"]["topk"] == 2
+    fr = sched.fleet_report()
+    assert fr["waves"] == 0 and fr["mode"] == "exact"
+
+
+def test_affinity_columns_recorded_on_pick():
+    """Schema-v2 provenance (gie-learn residual): every picked row
+    carries the device-gathered prefix/session columns of its CHOSEN
+    endpoint; unpicked rows stay zero; record_affinity=False drops the
+    leaf entirely (pytree-stable None, like prefill)."""
+    sched = Scheduler(ProfileConfig(), seed=1)
+    eps = make_endpoints(8, queue=list(range(8)))
+    reqs = make_requests(6, prompts=_prompts(6))
+    res = sched.pick(reqs, eps)
+    aff = np.asarray(res.affinity)
+    assert aff.shape == (6, 2)
+    assert np.isfinite(aff).all()
+    primary = np.asarray(res.indices)[:, 0]
+    assert (aff[primary < 0] == 0.0).all()
+    off = Scheduler(ProfileConfig(record_affinity=False), seed=1)
+    assert off.pick(reqs, eps).affinity is None
+
+
+def test_fleet_options_validation():
+    from gie_tpu.runtime.options import Options
+
+    Options(pool_name="p", fleet_topk=4, fleet_cell_cap=64).validate()
+    with pytest.raises(ValueError):
+        Options(pool_name="p", fleet_topk=4, fleet_cell_cap=33).validate()
+    with pytest.raises(ValueError):
+        Options(pool_name="p", fleet_topk=64,
+                fleet_cell_cap=1024).validate()
+    assert Options(pool_name="p").fleet_topk == 0    # default off

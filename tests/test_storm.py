@@ -1197,6 +1197,90 @@ def test_fed_scenarios_ship_and_compile_deterministically():
     FederationSpec(**scn.drive["storm"]["federation"])
 
 
+# ==========================================================================
+# gie-fleet fleet-scale storm (ISSUE 18, docs/FLEET.md): 16 simulated
+# clusters under the hierarchical FleetPicker — goodput parity with the
+# flat dense scheduler (covering top-K => identical decision
+# fingerprint), zero CRITICAL-band mis-spills, coarse-stage provenance.
+# ==========================================================================
+
+
+@pytest.fixture(scope="module")
+def fleet_storm(tmp_path_factory):
+    """ONE storm-fleet replay (3 local pods + 15 two-pod peer clusters
+    on the virtual clock, FleetPicker armed) plus the SAME storm re-run
+    with the flat dense scheduler (fleet knobs stripped from the drive)
+    — the goodput-parity baseline."""
+    from gie_tpu.resilience import scenarios
+    from gie_tpu.storm.engine import engine_from_drive, run_scenario
+
+    faults.uninstall()
+    dump_dir = str(tmp_path_factory.mktemp("fleetstorm"))
+    fleet = run_scenario("storm-fleet", dump_dir=dump_dir)
+    scn = scenarios.load("storm-fleet")
+    dense_drive = dict(scn.drive["storm"])
+    dense_drive.pop("fleet_topk")
+    dense_drive.pop("fleet_cell_cap", None)
+    eng = engine_from_drive(dense_drive, seed=scn.seed,
+                            name="storm-fleet-dense")
+    try:
+        dense = eng.run()
+    finally:
+        eng.close()
+    return fleet.scorecard, dense.scorecard
+
+
+def test_fleet_storm_16_clusters_no_critical_misspill(fleet_storm):
+    """16 simulated clusters (local + 15 imported peers): the crowd
+    spills onto the fleet with zero client-visible errors, and not one
+    CRITICAL pick crosses a cluster boundary while local candidates
+    exist — the mis-spill half of the fleet acceptance."""
+    card, _dense = fleet_storm
+    fed = card["federation"]
+    assert len(fed["peers"]) == 15, fed["peers"]  # + local = 16 clusters
+    assert card["client_5xx"] == 0, card["client_5xx_detail"]
+    assert card["resets"] == 0 and card["timeouts"] == 0
+    remote = sum(per["total"] for cluster, per in fed["picks"].items()
+                 if cluster != "local")
+    assert remote > 0, fed["picks"]
+    assert fed["critical_remote_picks"] == 0
+    assert fed["picks"]["local"]["bands"].get("critical", 0) > 0, (
+        "the storm never offered CRITICAL traffic — vacuous")
+    SC.validate(card)
+
+
+def test_fleet_storm_goodput_parity_with_dense_baseline(fleet_storm):
+    """Covering top-K (K * cell_cap >= M): the hierarchical pick cycle
+    is BITWISE the dense cycle (docs/FLEET.md parity contract), so the
+    whole virtual storm — every pick, shed, and breaker outcome — lands
+    on the IDENTICAL decision fingerprint as the flat scheduler."""
+    card, dense = fleet_storm
+    assert card["virtual_time"] is True and dense["virtual_time"] is True
+    assert "fleet" in card and "fleet" not in dense
+    assert card["schedule_fingerprint"] == dense["schedule_fingerprint"]
+    assert card["decision_fingerprint"] == dense["decision_fingerprint"], (
+        "the hierarchical picker changed a decision the covering-K "
+        "parity contract pins")
+    for k in ("arrivals", "ok", "shed", "completed", "client_5xx"):
+        assert card[k] == dense[k], (k, card[k], dense[k])
+
+
+def test_fleet_storm_scorecard_provenance(fleet_storm):
+    """The scorecard's fleet section records the coarse stage: exact
+    mode at this M, covering compression, and every landed pick's cell
+    inside its request's candidate list (no -1 ranks at covering K)."""
+    card, _dense = fleet_storm
+    fleet = card["fleet"]
+    assert fleet["mode"] == "exact"
+    assert fleet["topk"] == 2 and fleet["cell_cap"] == 32
+    assert fleet["compression_ratio"] == 1.0  # covering K at this M
+    assert fleet["waves"] > 0
+    hist = fleet["topk_hit_histogram"]
+    assert sum(hist.values()) > 0
+    assert hist.get("-1", 0) == 0, hist
+    assert sum(e["picks"] for e in fleet["hot_cells"]) > 0
+
+
 def test_cluster_drain_and_partition_shapes():
     drain = S.ClusterDrain(at_s=2.0)
     assert [e.kind for e in drain.control_events(5.0)] == ["cluster_drain"]
